@@ -1,0 +1,320 @@
+"""Imperative autograd: define-by-run tape over jax.vjp.
+
+Reference: ``src/imperative/imperative.cc`` (``Imperative::RecordOp/Backward``,
+AGInfo tape nodes) + ``python/mxnet/autograd.py`` (paths TBV — SURVEY.md §2.1).
+
+TPU-native redesign: instead of building an NNVM gradient graph and replaying
+FCompute backward kernels through an engine, each recorded op stores the
+``jax.vjp`` of its **own pure function** — forward runs eagerly, and
+``backward()`` walks the tape calling the stored vjps. The residuals live in
+PJRT buffers exactly like cuDNN workspace saved-tensors do in the reference.
+``create_graph=True`` (higher-order grad) re-enters recording during the
+backward walk, so grad-of-grad works through the same machinery.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "backward", "grad", "mark_variables", "set_recording",
+           "set_training"]
+
+
+class _AGState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _AGState()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    old, _STATE.recording = _STATE.recording, bool(flag)
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    old, _STATE.training = _STATE.training, bool(flag)
+    return old
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._old_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._old_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._old_rec)
+        if self._train is not None:
+            set_training(self._old_train)
+
+
+def record(train_mode: bool = True) -> _Scope:
+    """``with autograd.record():`` — turn on recording (+train mode)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class _Node:
+    """One recorded op. parents[i] is (node, out_index) or None per input."""
+
+    __slots__ = ("vjp_fn", "parents", "out_avals", "outputs", "name")
+
+    def __init__(self, vjp_fn, parents, out_avals, name):
+        self.vjp_fn = vjp_fn
+        self.parents = parents
+        self.out_avals = out_avals  # list of (shape, dtype)
+        self.outputs = None  # weakrefs set lazily for variable deposit
+        self.name = name
+
+
+class _VarNode:
+    """A leaf created by attach_grad; deposits cotangents into .grad."""
+
+    __slots__ = ("ref", "name")
+
+    def __init__(self, arr):
+        self.ref = weakref.ref(arr)
+        self.name = "var"
+
+
+def _mark_variable(arr) -> None:
+    arr._ag_node = (_VarNode(arr), 0)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = r
+        _mark_variable(v)
+
+
+def _record_op(opdef, inputs, datas, kwargs):
+    """Called by ndarray.invoke while recording. Computes forward via jax.vjp
+    and returns wrapped outputs with tape nodes attached."""
+    from .ndarray.ndarray import NDArray, _wrap_result
+
+    parents = []
+    any_parent = False
+    for x in inputs:
+        if isinstance(x, NDArray) and x._ag_node is not None:
+            parents.append(x._ag_node)
+            any_parent = True
+        else:
+            parents.append(None)
+    if not any_parent:
+        result = opdef.fn(*datas, **kwargs)
+        return _wrap_result(result, inputs)
+
+    # Only differentiate w.r.t. float inputs; pass others through as closures.
+    diff_idx = [i for i, d in enumerate(datas)
+                if hasattr(d, "dtype") and jnp.issubdtype(jnp.asarray(d).dtype, jnp.inexact)]
+    if not diff_idx:
+        result = opdef.fn(*datas, **kwargs)
+        return _wrap_result(result, inputs)
+
+    def closed(*diff_args):
+        full = list(datas)
+        for i, a in zip(diff_idx, diff_args):
+            full[i] = a
+        return opdef.fn(*full, **kwargs)
+
+    with _Scope(False, None):  # do not re-record inside vjp tracing
+        out, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
+    multi = isinstance(out, (list, tuple))
+    outs = list(out) if multi else [out]
+    avals = [(o.shape, o.dtype) for o in outs]
+    node = _Node(vjp_fn, [(parents[i], i) for i in diff_idx], avals, opdef.name)
+    # parents entries: (parent_ag, input_pos)
+    wrapped = []
+    like = next((x for x in inputs if isinstance(x, NDArray)), None)
+    for i, o in enumerate(outs):
+        w = NDArray(o, ctx=like._ctx if like is not None else None)
+        w._ag_node = (node, i)
+        wrapped.append(w)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def backward(heads: Sequence, head_grads: Optional[Sequence] = None,
+             retain_graph: bool = False, train_mode: bool = True) -> None:
+    """Compute gradients of heads w.r.t. all attached variables, depositing
+    into ``.grad`` per each variable's grad_req ('write' or 'add')."""
+    _run_backward(heads, head_grads, retain_graph, create_graph=False, deposit=True)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode: bool = True) -> List:
+    """Return gradients of heads w.r.t. ``variables`` (no .grad deposit).
+
+    With ``create_graph=True`` the backward pass itself is recorded, enabling
+    higher-order gradients.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order imperative grad) is not yet supported; "
+            "use jax.grad composition on a hybridized block instead")
+    if retain_graph is None:
+        retain_graph = create_graph
+    var_list = list(variables) if isinstance(variables, (list, tuple)) else [variables]
+    grads = _run_backward(heads, head_grads, retain_graph, create_graph, deposit=False,
+                          wanted=var_list)
+    return grads if isinstance(variables, (list, tuple)) else grads[0]
+
+
+def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted=None):
+    from .ndarray.ndarray import NDArray
+
+    heads = list(heads) if isinstance(heads, (list, tuple)) else [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    head_grads = [g._data if isinstance(g, NDArray) else g for g in head_grads]
+
+    # Seed cotangents
+    cotangents = {}  # id(node) -> list per output
+    node_by_id = {}
+
+    def seed(node, idx, ct):
+        lst = cotangents.setdefault(id(node), [None] * len(getattr(node, "out_avals", [None])))
+        if isinstance(node, _VarNode):
+            lst = cotangents.setdefault(id(node), [None])
+        if lst[idx] is None:
+            lst[idx] = ct
+        else:
+            lst[idx] = lst[idx] + ct
+        node_by_id[id(node)] = node
+
+    for h, hg in zip(heads, head_grads):
+        if h._ag_node is None:
+            continue
+        node, idx = h._ag_node
+        ct = hg if hg is not None else jnp.ones(h.shape, h.dtype)
+        seed(node, idx, ct)
+
+    if not node_by_id:
+        raise ValueError("cannot differentiate: no recorded computation reaches the heads "
+                         "(did you call attach_grad() and compute inside autograd.record()?)")
+
+    # Topological order via iterative DFS (tapes can be 10k+ ops deep — e.g.
+    # unrolled RNNs — so no recursion).
+    visited, order = set(), []
+    stack = []
+    for h in heads:
+        if h._ag_node is not None and not isinstance(h._ag_node[0], _VarNode):
+            stack.append((h._ag_node[0], False))
+    while stack:
+        node, expanded = stack.pop()
+        if isinstance(node, _VarNode):
+            continue
+        if expanded:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for parent_entry in node.parents:
+            pag, _pos = parent_entry
+            if pag is not None and id(pag[0]) not in visited:
+                stack.append((pag[0], False))
+
+    var_grads = {}  # id(varnode) -> cotangent
+
+    def deposit_var(vnode, ct):
+        key = id(vnode)
+        var_grads[key] = ct if key not in var_grads else var_grads[key] + ct
+        node_by_id[key] = vnode
+
+    # seed direct-variable heads
+    for h, hg in zip(heads, head_grads):
+        if h._ag_node is not None and isinstance(h._ag_node[0], _VarNode):
+            deposit_var(h._ag_node[0], hg if hg is not None else jnp.ones(h.shape, h.dtype))
+
+    rec_scope = record(train_mode) if create_graph else _Scope(False, None)
+    with rec_scope:
+        for node in reversed(order):
+            cts = cotangents.get(id(node))
+            if cts is None:
+                continue
+            full_cts = []
+            for i, aval in enumerate(node.out_avals):
+                c = cts[i] if i < len(cts) and cts[i] is not None else jnp.zeros(aval[0], aval[1])
+                full_cts.append(c)
+            arg = tuple(full_cts) if len(full_cts) > 1 else full_cts[0]
+            in_cts = node.vjp_fn(arg)
+            for (parent_entry, _inpos), ict in zip(node.parents, in_cts):
+                if parent_entry is None or ict is None:
+                    continue
+                pnode, pidx = parent_entry
+                if isinstance(pnode, _VarNode):
+                    deposit_var(pnode, ict)
+                else:
+                    seed(pnode, pidx, ict)
+            if not retain_graph:
+                node.vjp_fn = None
+
+    if deposit:
+        for key, ct in var_grads.items():
+            vnode = node_by_id[key]
+            arr = vnode.ref()
+            if arr is None or arr._grad_req == "null":
+                continue
+            if arr._grad_req == "add":
+                arr._grad._set_data(arr._grad._data + ct)
+            else:
+                arr._grad._set_data(jnp.asarray(ct, arr.dtype))
+        return None
+
+    out = []
+    for v in wanted or []:
+        if v._ag_node is None or not isinstance(v._ag_node[0], _VarNode):
+            raise ValueError("grad() target was not attached via attach_grad()")
+        ct = var_grads.get(id(v._ag_node[0]))
+        if ct is None:
+            ct = jnp.zeros(v.shape, v.dtype)
+        g = NDArrayCls()(ct)
+        out.append(g)
+    return out
+
+
+def NDArrayCls():
+    from .ndarray.ndarray import NDArray
+
+    return NDArray

@@ -1,0 +1,18 @@
+"""Operator library: pure-JAX implementations behind the registry.
+
+Importing this package registers every op family (the analog of the
+reference's static NNVM_REGISTER_OP initializers in src/operator/**, TBV).
+"""
+from . import registry  # noqa: F401
+from .registry import get_op, has_op, list_ops, register, alias, coerce_kwargs  # noqa: F401
+
+# Register op families (order matters only for aliases).
+from . import elemwise  # noqa: F401
+from . import reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import indexing  # noqa: F401
+from . import init_ops  # noqa: F401
+from . import ordering  # noqa: F401
+from . import nn  # noqa: F401
+from . import sequence  # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,115 @@
+"""Indexing operator family: take/Embedding/one_hot/pick/gather_nd/scatter_nd.
+
+Reference: ``src/operator/tensor/indexing_op*`` (TBV — SURVEY.md §2.2).
+TPU note: all of these lower to XLA gather/scatter; Embedding's backward is a
+scatter-add, which XLA handles natively (the reference needs AddTakeGrad CUDA
+kernels for this).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    ax = int(axis)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[ax] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[ax])
+    return jnp.take(a, idx, axis=ax)
+
+
+@register("Embedding")
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import dtype_np
+
+    d = int(depth)
+    idx = indices.astype(jnp.int32)
+    oh = jnp.arange(d, dtype=jnp.int32) == idx[..., None]
+    return jnp.where(oh, on_value, off_value).astype(dtype_np(dtype))
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    ax = int(axis) % data.ndim
+    idx = index.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, data.shape[ax] - 1)
+    else:
+        idx = jnp.mod(idx, data.shape[ax])
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, ax), axis=ax)
+    return picked if keepdims else jnp.squeeze(picked, axis=ax)
+
+
+@register("gather_nd")
+def _gather_nd(data, indices):
+    # indices: (M, ...) — first axis indexes the leading M dims of data
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
+
+
+@register("_backward_gather_nd", aliases=["gather_nd_grad"])
+def _gather_nd_accumulate(data, indices, shape=()):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+@register("take_along_axis")
+def _take_along_axis(data, indices, axis=0):
+    return jnp.take_along_axis(data, indices.astype(jnp.int32), axis=int(axis))
+
+
+@register("_contrib_boolean_mask", aliases=["boolean_mask"], differentiable=False)
+def _boolean_mask(data, index, axis=0):
+    # Data-dependent output shape: returns padded-to-count semantics is not
+    # possible eagerly-traced; eager path computes concretely (host sync).
+    import numpy as np
+
+    mask = np.asarray(index) != 0
+    return jnp.compress(mask, data, axis=int(axis))
+
+
+@register("_contrib_index_copy")
+def _index_copy(old, index, new):
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_array", differentiable=False)
+def _index_array(data, axes=None):
+    shape = data.shape
+    axes = tuple(axes) if axes is not None else tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype(jnp.int64 if False else jnp.int32)
+
+
+@register("_contrib_allclose", differentiable=False)
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=bool(equal_nan)).astype(jnp.float32).reshape(1)

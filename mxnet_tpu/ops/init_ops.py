@@ -1,0 +1,44 @@
+"""Creation ops: zeros/ones/full/arange/eye/linspace.
+
+Reference: ``src/operator/tensor/init_op*`` (TBV — SURVEY.md §2.2). These take
+no tensor inputs; the eager frontend supplies ctx/dtype kwargs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .registry import register
+
+
+@register("_zeros", aliases=["zeros"])
+def _zeros(shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(shape, dtype=dtype_np(dtype))
+
+
+@register("_ones", aliases=["ones"])
+def _ones(shape=(), dtype="float32", ctx=None):
+    return jnp.ones(shape, dtype=dtype_np(dtype))
+
+
+@register("_full", aliases=["full"])
+def _full(shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(shape, value, dtype=dtype_np(dtype))
+
+
+@register("_arange", aliases=["arange"])
+def _arange(start=0, stop=None, step=1.0, repeat=1, infer_range=False, dtype="float32", ctx=None):
+    r = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    if int(repeat) > 1:
+        r = jnp.repeat(r, int(repeat))
+    return r
+
+
+@register("_linspace", aliases=["linspace"])
+def _linspace(start=0, stop=1, num=50, endpoint=True, dtype="float32", ctx=None):
+    return jnp.linspace(start, stop, int(num), endpoint=bool(endpoint), dtype=dtype_np(dtype))
+
+
+@register("_eye", aliases=["eye"])
+def _eye(N=0, M=0, k=0, dtype="float32", ctx=None):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=dtype_np(dtype))

@@ -1,0 +1,320 @@
+"""Matrix / shape-manipulation operator family.
+
+Reference: ``src/operator/tensor/matrix_op*``, ``dot*``, ``la_op*`` (TBV —
+SURVEY.md §2.2). Includes the reference's special ``Reshape`` codes
+(0 / -1 / -2 / -3 / -4), slice family, dot/batch_dot (MXU-bound on TPU).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+# ---------------------------------------------------------------------------
+# Reshape with the reference's magic codes (docs: mx.nd.reshape).
+# ---------------------------------------------------------------------------
+
+def _infer_reshape(data_shape, shape, reverse=False):
+    if reverse:
+        # Right-to-left inference: reverse the dims and the token list, but a
+        # (-4, d1, d2) split-triple is a unit — keep it intact with d1/d2
+        # swapped so the final un-reversal restores the requested order.
+        groups, j = [], 0
+        shape = list(shape)
+        while j < len(shape):
+            if shape[j] == -4:
+                groups.append([-4, shape[j + 2], shape[j + 1]])
+                j += 3
+            else:
+                groups.append([shape[j]])
+                j += 1
+        data_shape = tuple(reversed(data_shape))
+        shape = [t for g in reversed(groups) for t in g]
+    out = []
+    i = 0  # index into data_shape
+    j = 0
+    shape = list(shape)
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(data_shape[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(data_shape[i:]); i = len(data_shape)
+        elif s == -3:
+            out.append(data_shape[i] * data_shape[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = shape[j + 1], shape[j + 2]
+            if d1 == -1:
+                d1 = data_shape[i] // d2
+            elif d2 == -1:
+                d2 = data_shape[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    if out.count(-1) == 1:
+        import numpy as _np
+
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in data_shape:
+            total *= v
+        out[out.index(-1)] = int(total // known) if known else 0
+    if reverse:
+        out = list(reversed(out))
+    return tuple(out)
+
+
+@register("Reshape", aliases=["reshape"])
+def _reshape(data, shape=None, reverse=False, target_shape=None, keep_highest=False):
+    if shape is None and target_shape is not None:  # legacy param
+        shape = target_shape
+    new_shape = _infer_reshape(data.shape, tuple(shape), reverse=bool(reverse))
+    return jnp.reshape(data, new_shape)
+
+
+@register("Flatten", aliases=["flatten"])
+def _flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(data, axes=None):
+    if axes is None or axes == ():
+        axes = tuple(reversed(range(data.ndim)))
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def _expand_dims(data, axis=0):
+    return jnp.expand_dims(data, int(axis))
+
+
+@register("squeeze")
+def _squeeze(data, axis=None):
+    if axis is None:
+        return jnp.squeeze(data)
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("swapaxes", aliases=["SwapAxis"])
+def _swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, int(dim1), int(dim2))
+
+
+@register("flip", aliases=["reverse"])
+def _flip(data, axis=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axis)
+
+
+@register("tile")
+def _tile(data, reps=()):
+    return jnp.tile(data, tuple(reps))
+
+
+@register("repeat")
+def _repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, int(repeats), axis=None if axis is None else int(axis))
+
+
+@register("Pad", aliases=["pad"])
+def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = tuple(pad_width)
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pairs, mode="constant", constant_values=constant_value)
+    return jnp.pad(data, pairs, mode=jmode)
+
+
+@register("Concat", aliases=["concat"])
+def _concat(*data, dim=1, num_args=None):
+    return jnp.concatenate(data, axis=int(dim))
+
+
+@register("stack")
+def _stack(*data, axis=0, num_args=None):
+    return jnp.stack(data, axis=int(axis))
+
+
+def _split_n_out(kw):
+    n = int(kw.get("num_outputs", 1))
+    return 1 if kw.get("squeeze_axis") and n == 1 else n
+
+
+@register("SliceChannel", aliases=["split"], num_outputs=lambda kw: int(kw.get("num_outputs", 1)))
+def _split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    axis = int(axis)
+    parts = jnp.split(data, int(num_outputs), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("split_v2", num_outputs=lambda kw: _split_v2_n(kw))
+def _split_v2(data, indices=(), axis=1, squeeze_axis=False, sections=0):
+    axis = int(axis)
+    if sections:
+        parts = jnp.split(data, int(sections), axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+def _split_v2_n(kw):
+    if kw.get("sections"):
+        return int(kw["sections"])
+    return len(tuple(kw.get("indices", ()))) + 1
+
+
+@register("slice", aliases=["crop"])
+def _slice(data, begin=(), end=(), step=None):
+    ndim = data.ndim
+    begin = tuple(begin) + (None,) * (ndim - len(begin))
+    end = tuple(end) + (None,) * (ndim - len(end))
+    step = tuple(step) + (None,) * (ndim - len(step)) if step else (None,) * ndim
+    idx = tuple(slice(b, e, s if s != 0 else None) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis")
+def _slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[int(axis)] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(data, shape_like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(min(data.ndim, shape_like.ndim)))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a % data.ndim] = slice(0, shape_like.shape[a % shape_like.ndim])
+    return data[tuple(idx)]
+
+
+@register("where")
+def _where(condition, x, y):
+    return jnp.where(condition != 0, x, y)
+
+
+@register("diag")
+def _diag(data, k=0, axis1=0, axis2=1):
+    if data.ndim == 1:
+        return jnp.diag(data, k=int(k))
+    return jnp.diagonal(data, offset=int(k), axis1=int(axis1), axis2=int(axis2))
+
+
+@register("depth_to_space")
+def _depth_to_space(data, block_size=1):
+    b = int(block_size)
+    n, c, h, w = data.shape
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(data, block_size=1):
+    b = int(block_size)
+    n, c, h, w = data.shape
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot — the MXU ops. bf16 inputs hit the systolic array directly;
+# fp32 uses default XLA precision (can be raised via jax.default_matmul_precision).
+# ---------------------------------------------------------------------------
+
+@register("dot")
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # reference dot: contract last axis of a with first axis of b (tensordot)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+# linalg subset (reference tensor/la_op*, TBV)
+@register("_linalg_gemm2", aliases=["linalg_gemm2"])
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_gemm", aliases=["linalg_gemm"])
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"])
+def _linalg_potrf(A, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register("_linalg_trsm", aliases=["linalg_trsm"])
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    from jax.scipy.linalg import solve_triangular
+
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    low = bool(lower) != bool(transpose)
+    if rightside:
+        x = solve_triangular(jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2), lower=not low)
+        return jnp.swapaxes(x, -1, -2)
+    return solve_triangular(a, alpha * B, lower=low)
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"])
+def _linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("khatri_rao")
+def _khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("moments", num_outputs=2)
+def _moments(data, axes=None, keepdims=False):
+    axes = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=axes, keepdims=bool(keepdims))
+    var = jnp.var(data, axis=axes, keepdims=bool(keepdims))
+    return mean, var
+
+
+@register("histogram", num_outputs=2, differentiable=False)
+def _histogram(data, bins=None, bin_cnt=None, range=None):
+    if bin_cnt is not None:
+        cnt, edges = jnp.histogram(data.reshape(-1), bins=int(bin_cnt), range=tuple(range))
+    else:
+        cnt, edges = jnp.histogram(data.reshape(-1), bins=bins)
+    return cnt, edges

@@ -1,0 +1,573 @@
+"""Neural-net operator family: FullyConnected / Convolution / BatchNorm /
+Pooling / softmax family / Dropout / LayerNorm / activations.
+
+Reference: ``src/operator/nn/*`` + cuDNN wrappers ``src/operator/nn/cudnn/``
+(TBV — SURVEY.md §2.1/§2.2). TPU redesign notes:
+
+- Convolution → ``lax.conv_general_dilated`` with NCHW dimension numbers; XLA
+  picks MXU-friendly internal layouts on TPU, replacing cuDNN algo autotuning
+  (the reference's CuDNNAlgoReg cache) with ahead-of-time compilation.
+- BatchNorm/LayerNorm are open-coded reductions — XLA fuses them; no fused
+  cuDNN kernel is needed.
+- Dropout draws from the framework RNG stream (mxnet_tpu.random), which is
+  trace-safe: under jit the key is a tracer folded per call-site.
+- Train/test behavior (BatchNorm, Dropout) is resolved from autograd's
+  train-mode scope at call time; hybridized graphs key their jit cache on it.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+
+def _is_training():
+    from .. import autograd
+
+    return autograd.is_training()
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected — the MXU workhorse.
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected")
+def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+@register("Activation")
+def _activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jnp.logaddexp(data, 0.0)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "silu" or act_type == "swish":
+        return data * jax.nn.sigmoid(data)
+    raise ValueError(f"unknown act_type {act_type!r}")
+
+
+@register("LeakyReLU")
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * (jnp.exp(data) - 1))
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and g.size > 1:  # per-channel on axis 1
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "selu":
+        a, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, a * (jnp.exp(data) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        s = (lower_bound + upper_bound) / 2.0  # eval-mode deterministic slope
+        return jnp.where(data >= 0, data, s * data)
+    raise ValueError(f"unknown act_type {act_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+
+def _length_mask(data, length, axis):
+    # mask positions >= length along `axis`; length has data's shape minus
+    # that axis (reference softmax use_length path)
+    ax = axis % data.ndim
+    pos = jnp.arange(data.shape[ax]).reshape((-1,) + (1,) * (data.ndim - 1 - ax))
+    if length.ndim == data.ndim - 1:
+        ln = jnp.expand_dims(length, ax)
+    elif length.ndim == data.ndim:
+        ln = length
+    else:
+        raise ValueError(
+            f"length ndim {length.ndim} incompatible with data ndim {data.ndim}")
+    return pos < ln
+
+
+@register("softmax")
+def _softmax(data, length=None, axis=-1, temperature=None, dtype=None, use_length=False):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        mask = _length_mask(x, length.astype(jnp.int32), int(axis))
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=int(axis))
+        out = jnp.where(mask, out, 0.0)
+    else:
+        out = jax.nn.softmax(x, axis=int(axis))
+    if dtype is not None:
+        from ..base import dtype_np
+
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+@register("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
+    x = data if not temperature or temperature == 1.0 else data / temperature
+    out = jax.nn.log_softmax(x, axis=int(axis))
+    if dtype is not None:
+        from ..base import dtype_np
+
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+@register("softmin")
+def _softmin(data, axis=-1, temperature=None, dtype=None):
+    return _softmax(-data, axis=axis, temperature=temperature, dtype=dtype)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output, use_ignore,
+                        preserve_shape, normalization, out_grad, smooth_alpha):
+    if multi_output:
+        out = jax.nn.softmax(data, axis=1)
+    elif preserve_shape:
+        out = jax.nn.softmax(data, axis=-1)
+    else:
+        out = jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+    return out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+def _softmax_output_core(data, label, grad_scale, ignore_label, multi_output, use_ignore,
+                         preserve_shape, normalization, out_grad, smooth_alpha):
+    return _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                               use_ignore, preserve_shape, normalization, out_grad,
+                               smooth_alpha)
+
+
+def _so_fwd(data, label, *nd):
+    out = _softmax_output_fwd(data, label, *nd)
+    return out, (out, label)
+
+
+def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore, preserve_shape,
+            normalization, out_grad, smooth_alpha, res, g):
+    out, label = res
+    # fused softmax-cross-entropy gradient: p - onehot(label)
+    if multi_output:
+        axis, lab = 1, label.astype(jnp.int32)
+        nclass = out.shape[1]
+        oh = jax.nn.one_hot(lab, nclass, axis=1, dtype=out.dtype)
+    else:
+        axis = out.ndim - 1
+        lab = label.astype(jnp.int32)
+        nclass = out.shape[-1]
+        oh = jax.nn.one_hot(lab.reshape(out.shape[:-1]), nclass, dtype=out.dtype)
+    if smooth_alpha:
+        oh = oh * (1 - smooth_alpha) + smooth_alpha / (nclass - 1) * (1 - oh)
+    grad = out - oh
+    if use_ignore:
+        keep = (label != ignore_label).astype(out.dtype)
+        keep = jnp.expand_dims(keep, axis) if keep.ndim < out.ndim else keep
+        grad = grad * keep
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid" and use_ignore:
+        nvalid = jnp.maximum(jnp.sum(label != ignore_label), 1).astype(out.dtype)
+        grad = grad / nvalid
+    grad = grad * scale
+    return grad, jnp.zeros_like(label)
+
+
+_softmax_output_core.defvjp(_so_fwd, _so_bwd)
+
+
+@register("SoftmaxOutput", aliases=["Softmax"])
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
+                    use_ignore=False, preserve_shape=False, normalization="null",
+                    out_grad=False, smooth_alpha=0.0):
+    """Fused softmax + cross-entropy-gradient op (reference softmax_output.cc TBV)."""
+    return _softmax_output_core(data, label, float(grad_scale), float(ignore_label),
+                                bool(multi_output), bool(use_ignore), bool(preserve_shape),
+                                normalization, bool(out_grad), float(smooth_alpha))
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return jnp.sum(nll).reshape(1)
+
+
+# ---------------------------------------------------------------------------
+# Regression outputs (identity forward, fused grads)
+# ---------------------------------------------------------------------------
+
+def _make_regression_output(err_grad):
+    @partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return core_fwd(data, label, grad_scale)[0]
+
+    def core_fwd(data, label, grad_scale):
+        out = jax.nn.sigmoid(data) if err_grad == "logistic" else data
+        return out, (out, label)
+
+    def core_bwd(grad_scale, res, g):
+        out, label = res
+        lab = label.reshape(out.shape)
+        if err_grad == "mae":
+            grad = jnp.sign(out - lab)
+        else:  # linear & logistic share (out - label)
+            grad = out - lab
+        num_out = out.size // out.shape[0]
+        return (grad * (grad_scale / num_out), jnp.zeros_like(label))
+
+    def fwd(data, label, grad_scale):
+        out, res = core_fwd(data, label, grad_scale)
+        return out, res
+
+    core.defvjp(fwd, core_bwd)
+
+    def op(data, label, grad_scale=1.0):
+        return core(data, label, float(grad_scale))
+
+    return op
+
+
+register("LinearRegressionOutput")(_make_regression_output("linear"))
+register("MAERegressionOutput")(_make_regression_output("mae"))
+register("LogisticRegressionOutput")(_make_regression_output("logistic"))
+
+
+@register("SVMOutput")
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0, use_linear=False):
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+def _bn_n_out(kw):
+    return 3 if kw.get("output_mean_var") else 1
+
+
+@register("BatchNorm", num_outputs=_bn_n_out)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+                fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
+                cudnn_off=False, min_calib_range=None, max_calib_range=None, _train=None):
+    """Reference semantics: returns out, or (out, batch_mean, batch_var) when
+    output_mean_var=True. Moving-stat update is done by the caller (Gluon
+    layer / executor) — functionally, unlike the reference's in-place aux
+    mutation (src/operator/nn/batch_norm.cc TBV); the Gluon layer requests
+    output_mean_var to get the stats it folds into the moving averages."""
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    train = _is_training() if _train is None else _train
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    bshape = tuple(data.shape[i] if i == ax else 1 for i in range(data.ndim))
+    if train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) * gamma.reshape(bshape) \
+        + beta.reshape(bshape)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm")
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = int(axis) % data.ndim
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    bshape = tuple(data.shape[i] if i == ax else 1 for i in range(data.ndim))
+    return (data - mean) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("InstanceNorm")
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("GroupNorm")
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[0], data.shape[1]
+    g = int(num_groups)
+    x = data.reshape((n, g, c // g) + data.shape[2:])
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    bshape = (1, c) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("RMSNorm")
+def _rms_norm(data, gamma, axis=-1, eps=1e-6):
+    ax = int(axis) % data.ndim
+    ms = jnp.mean(jnp.square(data), axis=ax, keepdims=True)
+    bshape = tuple(data.shape[i] if i == ax else 1 for i in range(data.ndim))
+    return data * lax.rsqrt(ms + eps) * gamma.reshape(bshape)
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+@register("Dropout")
+def _dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, _train=None):
+    train = _is_training() if _train is None else _train
+    if (not train and mode != "always") or p <= 0.0:
+        return data
+    from ..random import next_key
+
+    key = next_key()
+    if axes:
+        shape = tuple(1 if i in tuple(axes) else s for i, s in enumerate(data.shape))
+    else:
+        shape = data.shape
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return jnp.where(keep, data / (1.0 - p), 0.0).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution / Pooling
+# ---------------------------------------------------------------------------
+
+def _conv_dims(ndim):
+    # NC + spatial; kernel OI + spatial
+    sp = "DHW"[3 - (ndim - 2):]
+    return ("NC" + sp, "OI" + sp, "NC" + sp)
+
+
+@register("Convolution")
+def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                 num_filter=0, num_group=1, workspace=1024, no_bias=False,
+                 cudnn_tune=None, cudnn_off=False, layout=None):
+    nsp = data.ndim - 2
+    stride = tuple(stride) if stride else (1,) * nsp
+    dilate = tuple(dilate) if dilate else (1,) * nsp
+    pad = tuple(pad) if pad else (0,) * nsp
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dims(data.ndim))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        lhs_dilation=(1,) * nsp, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=int(num_group),
+        preferred_element_type=None)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+@register("Deconvolution")
+def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                   adj=(), target_shape=(), num_filter=0, num_group=1, workspace=512,
+                   no_bias=True, cudnn_tune=None, cudnn_off=False, layout=None):
+    """Transposed convolution = gradient of Convolution w.r.t. its input.
+
+    weight layout matches the reference: (in_channels, out_channels/g, *kernel).
+    """
+    nsp = data.ndim - 2
+    stride = tuple(stride) if stride else (1,) * nsp
+    dilate = tuple(dilate) if dilate else (1,) * nsp
+    pad = tuple(pad) if pad else (0,) * nsp
+    adj = tuple(adj) if adj else (0,) * nsp
+    kernel = tuple(kernel) if kernel else weight.shape[2:]
+    g = int(num_group)
+    # lax transposed conv: lhs_dilation=stride, padding adjusted
+    pads = []
+    for k, p, a, d in zip(kernel, pad, adj, dilate):
+        keff = (k - 1) * d + 1
+        pads.append((keff - 1 - p, keff - 1 - p + a))
+    # weight (I, O/g, *k) -> flip spatial, to (O, I/g, *k) conv on dilated input
+    w = jnp.flip(weight, axis=tuple(range(2, weight.ndim)))
+    if g > 1:
+        i, og = weight.shape[0], weight.shape[1]
+        w = w.reshape((g, i // g, og) + kernel)
+        w = jnp.moveaxis(w, 2, 1).reshape((g * og, i // g) + kernel)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, _conv_dims(data.ndim))
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nsp, padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=g)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nsp)
+    return out
+
+
+@register("Pooling")
+def _pooling(data, kernel=(), stride=(), pad=(), pool_type="max", global_pool=False,
+             pooling_convention="valid", cudnn_off=False, p_value=2,
+             count_include_pad=True, layout=None):
+    nsp = data.ndim - 2
+    if global_pool:
+        red = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=red, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.sum(data, axis=red, keepdims=True)
+            if pool_type == "avg":
+                r = r / (data.size // (data.shape[0] * data.shape[1]))
+            return r
+        if pool_type == "lp":
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(data), p_value), axis=red,
+                                     keepdims=True), 1.0 / p_value)
+    kernel = tuple(kernel)
+    stride = tuple(stride) if stride else (1,) * nsp
+    pad = tuple(pad) if pad else (0,) * nsp
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode output: pad right edge up so ceil((x+2p-k)/s)+1 windows fit
+        pads = [(0, 0), (0, 0)]
+        for i in range(nsp):
+            x = data.shape[2 + i]
+            import math
+
+            out_sz = int(math.ceil((x + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - x - pad[i]
+            pads.append((pad[i], max(need, pad[i])))
+    else:
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max, window,
+                                 strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value), jnp.asarray(0, data.dtype),
+                              lax.add, window, strides, pads)
+        return jnp.power(s, 1.0 / p_value)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+@register("UpSampling")
+def _upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+                multi_input_mode="concat", workspace=512):
+    data = args[0]
+    s = int(scale)
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+        if len(args) > 1 and multi_input_mode == "concat":
+            outs = [out]
+            for d in args[1:]:
+                si = out.shape[2] // d.shape[2]
+                outs.append(jnp.repeat(jnp.repeat(d, si, axis=2), si, axis=3))
+            out = jnp.concatenate(outs, axis=1)
+        return out
+    if sample_type == "bilinear":
+        weight = args[1]
+        n, c, h, w = data.shape
+        return jax.image.resize(data, (n, c, h * s, w * s), method="bilinear")
+    raise ValueError(f"unknown sample_type {sample_type!r}")
+
+
+@register("BilinearSampler")
+def _bilinear_sampler(data, grid, cudnn_off=False):
+    # grid in [-1, 1], shape (N, 2, H, W) — reference bilinear_sampler.cc (TBV)
+    n, c, hin, win = data.shape
+    gx = (grid[:, 0] + 1) * (win - 1) / 2
+    gy = (grid[:, 1] + 1) * (hin - 1) / 2
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0; wy = gy - y0
+
+    def gather(yy, xx):
+        yi = jnp.clip(yy.astype(jnp.int32), 0, hin - 1)
+        xi = jnp.clip(xx.astype(jnp.int32), 0, win - 1)
+        valid = ((yy >= 0) & (yy <= hin - 1) & (xx >= 0) & (xx <= win - 1)).astype(data.dtype)
+        v = jax.vmap(lambda img, y, x: img[:, y, x])(data, yi, xi)  # (N, C, H, W)
+        return v * valid[:, None]
+
+    out = (gather(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+           + gather(y0, x1) * ((1 - wy) * wx)[:, None]
+           + gather(y1, x0) * (wy * (1 - wx))[:, None]
+           + gather(y1, x1) * (wy * wx)[:, None])
+    return out
+
+
+@register("GridGenerator")
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    h, w = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, h), jnp.linspace(-1, 1, w), indexing="ij")
+        ones = jnp.ones_like(xs)
+        base = jnp.stack([xs, ys, ones], axis=0).reshape(3, -1)  # (3, H*W)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, H*W)
+        return out.reshape(n, 2, h, w)
+    return data  # warp type passes through
+
+
+@register("SpatialTransformer")
+def _spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=False):
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+@register("Correlation", num_outputs=1)
+def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1, stride2=1,
+                 pad_size=0, is_multiply=True):
+    raise NotImplementedError("Correlation op is not yet implemented on TPU")
+
+
+@register("LRN")
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    n = int(nsize)
+    sq = jnp.square(data)
+    pad = n // 2
+    sqp = jnp.pad(sq, ((0, 0), (pad, pad), (0, 0), (0, 0)))
+    win = sum(sqp[:, i:i + data.shape[1]] for i in range(n))
+    return data / jnp.power(knorm + alpha / n * win, beta)

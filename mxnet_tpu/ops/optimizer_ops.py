@@ -1,0 +1,205 @@
+"""Fused optimizer-update ops.
+
+Reference: ``src/operator/optimizer_op.*`` (TBV — SURVEY.md §2.2): sgd_update,
+sgd_mom_update, mp_* (fp16 with fp32 master weights), adam, lamb, ftrl, signum,
+multi-tensor variants. Functional redesign: each op returns the updated
+(weight, *states) instead of mutating in place; the optimizer frontend assigns
+back, and inside a jit'd train step XLA fuses all of them into the step program
+(the reference's whole reason for fusing these by hand).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _grad_prep(grad, wd, weight, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update")
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", num_outputs=2)
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0, lazy_update=True):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * g
+    return weight + mom, mom
+
+
+@register("nag_mom_update", num_outputs=2)
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * g
+    return weight + momentum * mom - lr * g, mom
+
+
+@register("mp_sgd_update", num_outputs=2)
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    g = _grad_prep(grad.astype(jnp.float32), wd, weight32, rescale_grad, clip_gradient)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", num_outputs=3)
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _grad_prep(grad.astype(jnp.float32), wd, weight32, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * g
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register("adam_update", num_outputs=3)
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
+
+
+@register("adamw_update", aliases=["_adamw_update", "_contrib_adamw_update"], num_outputs=3)
+def _adamw_update(weight, grad, mean, var, rescale_grad=None, lr=0.001, beta1=0.9,
+                  beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0, clip_gradient=-1.0):
+    rg = rescale_grad if not hasattr(rescale_grad, "shape") else rescale_grad.reshape(())
+    if rg is None:
+        rg = 1.0
+    g = grad * rg
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * mean / (jnp.sqrt(var) + epsilon) + wd * weight)
+    return w, mean, var
+
+
+@register("rmsprop_update", num_outputs=2)
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n
+
+
+@register("rmspropalex_update", num_outputs=4)
+def _rmspropalex_update(weight, grad, n, g_, delta, lr=0.001, gamma1=0.95, gamma2=0.9,
+                        epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                        clip_weights=-1.0):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    g_ = gamma1 * g_ + (1 - gamma1) * g
+    delta = gamma2 * delta - lr * g / jnp.sqrt(n - jnp.square(g_) + epsilon)
+    w = weight + delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n, g_, delta
+
+
+@register("ftrl_update", num_outputs=3)
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n_new = n + jnp.square(g)
+    z = z + g - (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr * weight
+    w = jnp.where(
+        jnp.abs(z) > lamda1,
+        -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(n_new)) / lr + wd),
+        0.0,
+    )
+    return w.astype(weight.dtype), z, n_new
+
+
+@register("signsgd_update")
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", num_outputs=2)
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom)
+    return w, mom
+
+
+@register("lamb_update_phase1")
+def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                        t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = mean_new, var_new
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    return m / (jnp.sqrt(v) + epsilon) + wd * weight
+
+
+@register("lamb_update_phase2")
+def _lamb_update_phase2(weight, g, r1, r2, lr=0.01, lower_bound=-1.0, upper_bound=-1.0):
+    r1v = r1.reshape(())
+    r2v = r2.reshape(())
+    if lower_bound is not None and lower_bound > 0:
+        r1v = jnp.maximum(r1v, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1v = jnp.minimum(r1v, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1v > 0, r2v > 0), r1v / r2v, 1.0)
+    return weight - lr * ratio * g
+
+
+@register("adagrad_update", aliases=["_sparse_adagrad_update"], num_outputs=2)
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    history = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(history) + epsilon), history
+
+
+@register("adadelta_update", aliases=["adaalpha_update"], num_outputs=3)
+def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5, wd=0.0,
+                     rescale_grad=1.0, clip_gradient=-1.0):
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(acc_g + epsilon) * g
+    acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta, acc_g, acc_delta
+
+
+@register("ftml_update", num_outputs=4)
+def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
+                 t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = (1 - beta1 ** t) / lr * (jnp.sqrt(v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -z / d_new, d_new, v, z

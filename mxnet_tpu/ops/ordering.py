@@ -1,0 +1,80 @@
+"""Ordering ops: topk / sort / argsort.
+
+Reference: ``src/operator/tensor/ordering_op*`` (TBV — SURVEY.md §2.2; §7 hard
+part #4). TPU design: XLA sort is a fully-static bitonic/stable sort — no
+data-dependent shapes — so topk/sort map directly; ``ret_typ='mask'`` uses a
+scatter over the sorted indices.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _topk_n_out(kw):
+    return 2 if kw.get("ret_typ", "indices") == "both" else 1
+
+
+@register("topk", num_outputs=_topk_n_out, differentiable=False)
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import dtype_np
+
+    ax = data.ndim - 1 if axis is None else int(axis) % data.ndim
+    k = int(k)
+    if k <= 0:
+        k = data.shape[ax]
+    x = jnp.moveaxis(data, ax, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-x, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(x, k)
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(dtype_np(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        xm = jnp.moveaxis(jnp.zeros_like(data), ax, -1)
+        ii = jnp.moveaxis(idx, ax, -1).astype(jnp.int32)
+        mask = jnp.take_along_axis(xm, ii, axis=-1)  # shape probe
+        flatm = xm.reshape(-1, xm.shape[-1])
+        flati = ii.reshape(-1, ii.shape[-1])
+        out = flatm.at[jnp.arange(flatm.shape[0])[:, None], flati].set(1.0)
+        return jnp.moveaxis(out.reshape(xm.shape), -1, ax)
+    raise ValueError(f"unknown ret_typ {ret_typ!r}")
+
+
+@register("sort")
+def _sort(data, axis=-1, is_ascend=True):
+    ax = data.ndim - 1 if axis is None else int(axis)
+    s = jnp.sort(data, axis=ax)
+    return s if is_ascend else jnp.flip(s, axis=ax)
+
+
+@register("argsort", differentiable=False)
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_np
+
+    ax = data.ndim - 1 if axis is None else int(axis)
+    idx = jnp.argsort(data, axis=ax, stable=True)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=ax)
+    return idx.astype(dtype_np(dtype))
+
+
+@register("_unravel_index", aliases=["unravel_index"], differentiable=False)
+def _unravel(data, shape=()):
+    idx = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(idx, axis=0).astype(jnp.float32)
+
+
+@register("_ravel_multi_index", aliases=["ravel_multi_index"], differentiable=False)
+def _ravel(data, shape=()):
+    coords = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
+    return jnp.asarray(jnp.ravel_multi_index(coords, tuple(shape), mode="clip")).astype(jnp.float32)

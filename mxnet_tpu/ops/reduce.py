@@ -1,0 +1,136 @@
+"""Reduction operator family.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op*`` (TBV — SURVEY.md §2.2).
+Semantics kept: ``axis=None`` reduces all; ``exclude=True`` reduces the axes
+NOT listed (a reference-specific flag); reductions keep input dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+def _norm_axes(axis, ndim, exclude=False):
+    if axis is None:
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _make_reduce(jfn):
+    def op(data, axis=None, keepdims=False, exclude=False):
+        axes = _norm_axes(axis, data.ndim, exclude)
+        return jfn(data, axis=axes, keepdims=bool(keepdims))
+
+    return op
+
+
+for _name, _jfn in {
+    "sum": jnp.sum,
+    "mean": jnp.mean,
+    "prod": jnp.prod,
+    "max": jnp.max,
+    "min": jnp.min,
+    "nansum": jnp.nansum,
+    "nanprod": jnp.nanprod,
+}.items():
+    register(_name)(_make_reduce(_jfn))
+
+alias("sum", "sum_axis", "_np_sum")
+alias("max", "max_axis")
+alias("min", "min_axis")
+
+
+@register("norm")
+def _norm(data, ord=2, axis=None, keepdims=False, out_dtype=None):
+    axes = None if axis is None else (_norm_axes(axis, data.ndim))
+    if ord == 1:
+        r = jnp.sum(jnp.abs(data), axis=axes, keepdims=bool(keepdims))
+    else:
+        r = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=bool(keepdims)))
+    if out_dtype is not None:
+        from ..base import dtype_np
+
+        r = r.astype(dtype_np(out_dtype))
+    return r
+
+
+def _make_arg_reduce(jfn):
+    def op(data, axis=None, keepdims=False):
+        if axis is None:
+            r = jfn(data.reshape(-1), axis=0)
+            if keepdims:
+                r = r.reshape((1,) * data.ndim)
+        else:
+            r = jfn(data, axis=int(axis))
+            if keepdims:
+                r = jnp.expand_dims(r, int(axis))
+        # reference returns float32 indices (mshadow legacy) — kept for parity
+        return r.astype(jnp.float32)
+
+    return op
+
+
+register("argmax", differentiable=False)(_make_arg_reduce(jnp.argmax))
+register("argmin", differentiable=False)(_make_arg_reduce(jnp.argmin))
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("broadcast_axis", aliases=["broadcast_axes"])
+def _broadcast_axis(data, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a % data.ndim] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+@register("broadcast_to")
+def _broadcast_to(data, shape=()):
+    # reference allows 0 in target shape meaning "keep input dim"
+    tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    shape = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la % lhs.ndim] = rhs.shape[ra % rhs.ndim]
+    return jnp.broadcast_to(lhs, tuple(shape))
+
+
+@register("logsumexp", aliases=["log_sum_exp"])
+def _logsumexp(data, axis=None, keepdims=False):
+    from jax.scipy.special import logsumexp
+
+    axes = _norm_axes(axis, data.ndim) if axis is not None else None
+    return logsumexp(data, axis=axes, keepdims=bool(keepdims))
+
+
+@register("L2Normalization")
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    # reference src/operator/l2_normalization.cc (TBV)
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise ValueError(f"unknown L2Normalization mode {mode!r}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm

@@ -1,0 +1,122 @@
+"""Central operator registry — the NNVM-registry analog.
+
+In the reference, every operator is registered once in C++
+(``NNVM_REGISTER_OP(...)`` in ``src/operator/**``, path TBV — SURVEY.md §2.2)
+with FCompute/FGradient/FInferShape attributes, and the Python ``mx.nd``/
+``mx.sym`` wrappers are *generated at import time* from that registry.
+
+TPU-native redesign: an op is a **single pure JAX function** over jax.Arrays.
+That one definition serves every consumer:
+
+- eager dispatch (``mx.nd.*``)            — call it on concrete arrays;
+- autograd (``FGradient``)                — ``jax.vjp`` of the same function;
+- hybridize / symbolic executor (jit)     — trace it;
+- shape/type inference (``FInferShape``)  — ``jax.eval_shape``;
+- sharding/multi-chip                     — it composes with shard_map/pjit.
+
+There is no separate kernel per backend: XLA lowers the traced HLO onto the
+MXU; Pallas kernels plug in as just another pure function.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias", "coerce_kwargs"]
+
+
+class OpDef:
+    """One registered operator.
+
+    Attributes:
+        name: canonical op name (reference op names kept, e.g. ``broadcast_add``).
+        fn: pure function ``fn(*arrays, **kwargs) -> array | tuple(arrays)``.
+        num_outputs: static int, or callable(kwargs)->int for ops like ``RNN``.
+        ndarray_inputs: names of positional tensor inputs (for symbol binding).
+        differentiable: False disables autograd recording (e.g. ``argmax``).
+    """
+
+    __slots__ = ("name", "fn", "num_outputs", "ndarray_inputs", "differentiable", "param_types")
+
+    def __init__(self, name, fn, num_outputs=1, ndarray_inputs=None, differentiable=True,
+                 param_types=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.ndarray_inputs = ndarray_inputs
+        self.differentiable = differentiable
+        self.param_types = param_types or {}
+
+    def n_out(self, kwargs) -> int:
+        if callable(self.num_outputs):
+            return self.num_outputs(kwargs)
+        return self.num_outputs
+
+    def __repr__(self):
+        return f"<Op {self.name}>"
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(name: str, num_outputs=1, aliases: Optional[List[str]] = None,
+             ndarray_inputs=None, differentiable=True):
+    """Decorator registering a pure-JAX op under a reference op name."""
+
+    def deco(fn: Callable):
+        op = OpDef(name, fn, num_outputs, ndarray_inputs, differentiable)
+        _REGISTRY[name] = op
+        for a in aliases or ():
+            _REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def alias(existing: str, *names: str) -> None:
+    op = _REGISTRY[existing]
+    for n in names:
+        _REGISTRY[n] = op
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"operator {name!r} is not implemented in mxnet_tpu "
+            f"({len(set(id(v) for v in _REGISTRY.values()))} ops registered)"
+        ) from None
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def list_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Param coercion. The reference's dmlc::Parameter layer parses op kwargs from
+# strings (symbol JSON stores all attrs as strings). coerce_kwargs gives the
+# same tolerance: "(3, 3)" -> (3, 3), "True" -> True, "2" -> 2.
+# ---------------------------------------------------------------------------
+
+def coerce_value(v: Any) -> Any:
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def coerce_kwargs(kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: coerce_value(v) for k, v in kwargs.items()}

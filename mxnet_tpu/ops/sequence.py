@@ -1,0 +1,53 @@
+"""Sequence ops: SequenceMask / SequenceLast / SequenceReverse.
+
+Reference: ``src/operator/sequence_{mask,last,reverse}*`` (TBV — SURVEY.md
+§5.7: these + bucketing are the reference's entire variable-length story).
+Layout convention kept from the reference: time-major ``(seq_len, batch, ...)``
+unless ``axis=1``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _len_mask(seq_len, batch, length):
+    # (seq_len, batch) bool: t < length[b]
+    t = jnp.arange(seq_len)[:, None]
+    return t < length.astype(jnp.int32)[None, :]
+
+
+@register("SequenceMask")
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    ax = int(axis)
+    x = jnp.swapaxes(data, 0, 1) if ax == 1 else data
+    m = _len_mask(x.shape[0], x.shape[1], sequence_length)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(m, x, jnp.asarray(value, x.dtype))
+    return jnp.swapaxes(out, 0, 1) if ax == 1 else out
+
+
+@register("SequenceLast")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    ax = int(axis)
+    x = jnp.swapaxes(data, 0, 1) if ax == 1 else data
+    if not use_sequence_length or sequence_length is None:
+        return x[-1]
+    idx = jnp.clip(sequence_length.astype(jnp.int32) - 1, 0, x.shape[0] - 1)  # (batch,)
+    return jnp.take_along_axis(x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    x = data  # reference only supports axis=0 (time-major)
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(x, axis=0)
+    T = x.shape[0]
+    ln = sequence_length.astype(jnp.int32)[None, :]  # (1, batch)
+    t = jnp.arange(T)[:, None]
+    src = jnp.where(t < ln, ln - 1 - t, t)  # reverse first len steps, keep rest
+    src = src.reshape((T, -1) + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, jnp.broadcast_to(src, x.shape), axis=0)

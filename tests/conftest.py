@@ -1,0 +1,32 @@
+"""Test config: force CPU with 8 virtual devices so multi-chip sharding logic
+is exercised without TPU hardware (SURVEY.md §4: the reference's analog is the
+dmlc local tracker forking a PS cluster on localhost).
+
+Note: this image preloads jax via sitecustomize with JAX_PLATFORMS=axon, so
+env vars are too late — jax.config.update is required.
+"""
+import os
+
+os.environ.setdefault("MXNET_TEST_ON_CPU", "1")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Reference with_seed() decorator analog: seed numpy + framework RNG per
+    test; repro a failure by exporting MXNET_TEST_SEED."""
+    seed = int(os.environ.get("MXNET_TEST_SEED", "0")) or np.random.randint(0, 2**31)
+    np.random.seed(seed)
+    import mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield

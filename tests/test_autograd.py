@@ -1,0 +1,141 @@
+"""Autograd tape tests (reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 2)
+
+
+def test_chain_and_broadcast_grad():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(4, 2).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w)
+        z = nd.relu(y).sum()
+    z.backward()
+    mask = (x.asnumpy() @ w.asnumpy() > 0).astype(np.float32)
+    assert_almost_equal(x.grad, mask @ w.asnumpy().T, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(w.grad, x.asnumpy().T @ mask, rtol=1e-4, atol=1e-4)
+
+
+def test_grad_req_add():
+    x = nd.array([2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * x
+        y.backward()
+    assert_almost_equal(x.grad, np.array([12.0], np.float32))
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(out_grad=nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([30.0, 300.0], np.float32))
+
+
+def test_detach_and_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = nd.BlockGrad(y) + x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([1.0], np.float32))
+
+
+def test_pause_scope():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            c = x * 100  # not recorded
+        y = y + c.detach()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2.0], np.float32))
+
+
+def test_training_mode_flags():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training() and not autograd.is_recording()
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, [x])
+    assert_almost_equal(g, np.array([27.0], np.float32))
+    assert x.grad.asnumpy()[0] == 0.0  # grad() does not deposit
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.random.rand(2, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=2, axis=1)
+        y = parts[0].sum() + 2 * parts[1].sum()
+    y.backward()
+    exp = np.concatenate([np.ones((2, 3)), 2 * np.ones((2, 3))], axis=1).astype(np.float32)
+    assert_almost_equal(x.grad, exp)
+
+
+def test_numeric_gradient_mlp():
+    w = np.random.rand(4, 3).astype(np.float32)
+    check_numeric_gradient(lambda a: nd.tanh(nd.dot(a, nd.array(w))),
+                           [np.random.rand(2, 4).astype(np.float32)])
+
+
+def test_getitem_grad():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = x[0] * 2
+    y.backward()
+    assert_almost_equal(x.grad, np.array([[2, 2, 2], [0, 0, 0]], np.float32))
+
+
+def test_mutation_does_not_corrupt_tape():
+    # MXNet needs engine write-locks for this; immutability gives it free.
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    x[:] = 100.0  # mutate after recording
+    y.backward()
+    assert_almost_equal(x.grad, np.array([2.0, 4.0], np.float32))
+
+
+def test_softmax_output_fused_grad():
+    data = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array(np.array([0, 1, 2, 3], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    p = np.exp(data.asnumpy() - data.asnumpy().max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    oh = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    assert_almost_equal(data.grad, p - oh, rtol=1e-4, atol=1e-4)

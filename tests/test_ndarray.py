@@ -1,0 +1,219 @@
+"""NDArray core semantics tests (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_create_and_asnumpy():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert same(a, np.array([[1, 2], [3, 4]], dtype=np.float32))
+
+
+def test_creation_helpers():
+    assert same(nd.zeros((2, 3)), np.zeros((2, 3)))
+    assert same(nd.ones((2, 3)), np.ones((2, 3)))
+    assert same(nd.full((2,), 7.0), np.full((2,), 7.0, dtype=np.float32))
+    assert same(nd.arange(5), np.arange(5, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert same(a + b, np.array([5, 7, 9], np.float32))
+    assert same(a - b, np.array([-3, -3, -3], np.float32))
+    assert same(a * b, np.array([4, 10, 18], np.float32))
+    assert_almost_equal(a / b, np.array([0.25, 0.4, 0.5], np.float32))
+    assert same(a + 1, np.array([2, 3, 4], np.float32))
+    assert same(2 * a, np.array([2, 4, 6], np.float32))
+    assert same(1 - a, np.array([0, -1, -2], np.float32))
+    assert_almost_equal(1 / a, np.array([1, 0.5, 1 / 3], np.float32))
+    assert same(a ** 2, np.array([1, 4, 9], np.float32))
+    assert same(-a, np.array([-1, -2, -3], np.float32))
+
+
+def test_inplace_ops():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    assert same(a, np.array([2, 3], np.float32))
+    a *= 2
+    assert same(a, np.array([4, 6], np.float32))
+    a[:] = 0
+    assert same(a, np.zeros(2, np.float32))
+
+
+def test_setitem_getitem():
+    a = nd.zeros((3, 4))
+    a[1] = 5
+    assert same(a[1], np.full(4, 5, np.float32))
+    a[0, 2] = 3
+    assert a[0, 2].asscalar() == 3
+    b = a[0:2]
+    assert b.shape == (2, 4)
+    a[:, 1] = 9
+    assert same(a[:, 1], np.full(3, 9, np.float32))
+
+
+def test_reshape_magic_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert a.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+
+
+def test_broadcast():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    assert nd.broadcast_to(a, shape=(2, 4, 3)).shape == (2, 4, 3)
+
+
+def test_reductions():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum())
+    assert_almost_equal(a.sum(axis=1), x.sum(axis=1))
+    assert_almost_equal(nd.sum(a, axis=(0, 2)), x.sum(axis=(0, 2)))
+    assert_almost_equal(nd.sum(a, axis=1, exclude=True), x.sum(axis=(0, 2)))
+    assert_almost_equal(a.mean(axis=0, keepdims=True), x.mean(axis=0, keepdims=True))
+    assert_almost_equal(a.max(), x.max())
+    assert_almost_equal(nd.norm(a), np.sqrt((x ** 2).sum()), rtol=1e-4)
+
+
+def test_dot():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-4, atol=1e-4)
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y.T), transpose_b=True), x @ y,
+                        rtol=1e-4, atol=1e-4)
+    bx = np.random.rand(2, 3, 4).astype(np.float32)
+    by = np.random.rand(2, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(bx), nd.array(by)), bx @ by, rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_slice_family():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = nd.array(x)
+    assert same(nd.slice(a, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert same(nd.slice_axis(a, axis=2, begin=1, end=3), x[:, :, 1:3])
+    assert same(nd.slice_like(a, nd.zeros((1, 2, 2))), x[:1, :2, :2])
+
+
+def test_concat_split_stack():
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(2, 3).astype(np.float32)
+    assert same(nd.concat(nd.array(x), nd.array(y), dim=0), np.concatenate([x, y], 0))
+    assert same(nd.stack(nd.array(x), nd.array(y), axis=0), np.stack([x, y], 0))
+    parts = nd.split(nd.array(x), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    sq = nd.split(nd.array(x), num_outputs=3, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2,)
+
+
+def test_take_embedding_onehot_pick():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    assert same(nd.take(nd.array(w), nd.array(idx)), w[[1, 3, 5]])
+    assert same(nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4),
+                w[[1, 3, 5]])
+    oh = nd.one_hot(nd.array([0, 2]), 3)
+    assert same(oh, np.array([[1, 0, 0], [0, 0, 1]], np.float32))
+    data = np.random.rand(3, 5).astype(np.float32)
+    picked = nd.pick(nd.array(data), nd.array([0, 2, 4], dtype=np.float32))
+    assert same(picked, data[np.arange(3), [0, 2, 4]])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    a = nd.array(x)
+    assert same(nd.topk(a, k=1), np.array([[0], [1]], np.float32))
+    v = nd.topk(a, k=2, ret_typ="value")
+    assert same(v, np.array([[3, 2], [5, 4]], np.float32))
+    assert same(nd.sort(a), np.sort(x, -1))
+    assert same(nd.argsort(a), np.argsort(x, -1).astype(np.float32))
+
+
+def test_elemwise_math():
+    x = np.random.rand(4, 5).astype(np.float32) + 0.5
+    a = nd.array(x)
+    assert_almost_equal(nd.exp(a), np.exp(x), rtol=1e-4)
+    assert_almost_equal(nd.log(a), np.log(x), rtol=1e-4)
+    assert_almost_equal(nd.sqrt(a), np.sqrt(x), rtol=1e-4)
+    assert_almost_equal(nd.rsqrt(a), 1 / np.sqrt(x), rtol=1e-4)
+    assert_almost_equal(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-4)
+    assert same(nd.relu(nd.array([-1.0, 1.0])), np.array([0, 1], np.float32))
+    assert_almost_equal(nd.clip(a, a_min=0.6, a_max=1.0), np.clip(x, 0.6, 1.0))
+
+
+def test_transpose_swap_expand():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert same(a.T, x.T)
+    assert same(nd.transpose(a, axes=(1, 0, 2)), x.transpose(1, 0, 2))
+    assert same(nd.swapaxes(a, dim1=0, dim2=2), x.swapaxes(0, 2))
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert nd.squeeze(a.expand_dims(0)).shape == (2, 3, 4)
+
+
+def test_where_comparisons():
+    x = nd.array([1.0, 5.0, 3.0])
+    y = nd.array([4.0, 2.0, 3.0])
+    assert same(x > y, np.array([0, 1, 0], np.float32))
+    assert same(x <= y, np.array([1, 0, 1], np.float32))
+    assert same(nd.where(x > y, x, y), np.array([4, 5, 3], np.float32))
+
+
+def test_astype_cast():
+    a = nd.array([1.5, 2.5])
+    assert a.astype("int32").dtype == np.int32
+    assert nd.cast(a, dtype="float16").dtype == np.float16
+
+
+def test_save_load(tmp_path):
+    p = str(tmp_path / "arrs")
+    d = {"w": nd.array([1.0, 2.0]), "b": nd.array([3.0])}
+    nd.save(p, d)
+    loaded = nd.load(p)
+    assert set(loaded) == {"w", "b"}
+    assert same(loaded["w"], d["w"])
+
+
+def test_context_and_async():
+    a = nd.array([1.0], ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    a.wait_to_read()
+    nd.waitall()
+    assert float(a.asscalar()) == 1.0
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)  # (T, B, D)
+    ln = nd.array([2.0, 4.0])
+    masked = nd.SequenceMask(nd.array(x), ln, use_sequence_length=True, value=-1.0)
+    out = masked.asnumpy()
+    assert (out[2:, 0] == -1).all() and (out[:, 1] == x[:, 1]).all()
+    last = nd.SequenceLast(nd.array(x), ln, use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[3, 1]]))
+    rev = nd.SequenceReverse(nd.array(x), ln, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x[1, 0])
+    assert_almost_equal(rev.asnumpy()[3, 1], x[0, 1])
+
+
+def test_iter_len_bool():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert len(a) == 2
+    rows = list(a)
+    assert same(rows[1], np.array([3, 4], np.float32))
+    assert bool(nd.array([1.0]))
+    with pytest.raises(ValueError):
+        bool(a)

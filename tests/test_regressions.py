@@ -1,0 +1,70 @@
+"""Regression tests from code review of the core (mutation-under-record,
+deep tapes, reverse reshape, BatchNorm arity, batched multinomial)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_inplace_mutation_keeps_grad_chain():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        x *= 2
+        y = x * x  # y = (2x)^2, dy/dx = 8x = 24
+    y.backward()
+    assert x.grad.asnumpy()[0] == 24.0
+
+
+def test_setitem_under_record_grad():
+    x = nd.array([1.0, 2.0])
+    v = nd.array([5.0])
+    x.attach_grad()
+    v.attach_grad()
+    with autograd.record():
+        x[0:1] = v
+        y = (x * x).sum()
+    y.backward()
+    # grad w.r.t. original x: position 0 overwritten -> 0; position 1 -> 2*x1
+    assert_almost_equal(x.grad, np.array([0.0, 4.0], np.float32))
+    assert_almost_equal(v.grad, np.array([10.0], np.float32))
+
+
+def test_deep_tape_no_recursion_error():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x
+        for _ in range(3000):
+            y = y * 1.001
+    y.backward()
+    assert np.isfinite(x.grad.asnumpy()[0])
+
+
+def test_reverse_reshape_with_split():
+    r = nd.zeros((2, 12)).reshape(shape=(0, -4, 3, -1), reverse=True)
+    assert r.size == 24
+    # plain right-to-left inference
+    assert nd.zeros((10, 20)).reshape(shape=(-1, 0), reverse=True).shape == (10, 20)
+
+
+def test_batchnorm_output_arity():
+    args = (nd.ones((2, 3, 4, 4)), nd.ones((3,)), nd.zeros((3,)), nd.zeros((3,)),
+            nd.ones((3,)))
+    out = nd.BatchNorm(*args)
+    assert isinstance(out, nd.NDArray)
+    o3 = nd.BatchNorm(*args, output_mean_var=True)
+    assert len(o3) == 3
+
+
+def test_multinomial_batched_get_prob():
+    d, lp = mx.random.multinomial(nd.array([[0.2, 0.8], [0.5, 0.5]]), shape=3,
+                                  get_prob=True)
+    assert d.shape == (2, 3) and lp.shape == (2, 3)
+    assert (lp.asnumpy() <= 0).all()
+
+
+def test_compare_with_none():
+    assert (nd.ones((2,)) == None) is False  # noqa: E711
+    assert (nd.ones((2,)) != None) is True  # noqa: E711
