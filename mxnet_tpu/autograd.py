@@ -94,14 +94,15 @@ def predict_mode() -> _Scope:
 class _Node:
     """One recorded op. parents[i] is (node, out_index) or None per input."""
 
-    __slots__ = ("vjp_fn", "parents", "out_avals", "outputs", "name")
+    __slots__ = ("vjp_fn", "parents", "out_avals", "outputs", "name", "out_is_tuple")
 
-    def __init__(self, vjp_fn, parents, out_avals, name):
+    def __init__(self, vjp_fn, parents, out_avals, name, out_is_tuple=False):
         self.vjp_fn = vjp_fn
         self.parents = parents
         self.out_avals = out_avals  # list of (shape, dtype)
         self.outputs = None  # weakrefs set lazily for variable deposit
         self.name = name
+        self.out_is_tuple = out_is_tuple
 
 
 class _VarNode:
@@ -157,12 +158,17 @@ def _record_op(opdef, inputs, datas, kwargs):
             full[i] = a
         return opdef.fn(*full, **kwargs)
 
+    def closed_norm(*diff_args):
+        r = closed(*diff_args)
+        return tuple(r) if isinstance(r, list) else r  # keep vjp pytree a tuple
+
     with _Scope(False, None):  # do not re-record inside vjp tracing
-        out, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
-    multi = isinstance(out, (list, tuple))
+        out, vjp_fn = jax.vjp(closed_norm, *[datas[i] for i in diff_idx])
+    multi = isinstance(out, tuple)
     outs = list(out) if multi else [out]
     avals = [(o.shape, o.dtype) for o in outs]
-    node = _Node(vjp_fn, [(parents[i], i) for i in diff_idx], avals, opdef.name)
+    node = _Node(vjp_fn, [(parents[i], i) for i in diff_idx], avals, opdef.name,
+                 out_is_tuple=multi)
     # parents entries: (parent_ag, input_pos)
     wrapped = []
     like = next((x for x in inputs if isinstance(x, NDArray)), None)
@@ -277,7 +283,7 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
             for i, aval in enumerate(node.out_avals):
                 c = cts[i] if i < len(cts) and cts[i] is not None else jnp.zeros(aval[0], aval[1])
                 full_cts.append(c)
-            arg = tuple(full_cts) if len(full_cts) > 1 else full_cts[0]
+            arg = tuple(full_cts) if node.out_is_tuple else full_cts[0]
             in_cts = node.vjp_fn(arg)
             for (parent_entry, _inpos), ict in zip(node.parents, in_cts):
                 if parent_entry is None or ict is None:

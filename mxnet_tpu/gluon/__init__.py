@@ -1,0 +1,17 @@
+"""Gluon — the imperative high-level API (reference python/mxnet/gluon/)."""
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import Parameter, Constant, ParameterDict  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("rnn", "data", "model_zoo", "contrib"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

@@ -1,0 +1,454 @@
+"""Gluon Block / HybridBlock / CachedOp — the imperative model API.
+
+Reference: ``python/mxnet/gluon/block.py`` + ``src/imperative/cached_op.cc``
+(TBV — SURVEY.md §2.1, §3.1-3.2).
+
+TPU redesign of hybridize (the keystone — SURVEY.md §7 phase 2):
+
+- A non-hybridized HybridBlock runs op-by-op eagerly (each op is an XLA
+  executable; correct but per-op dispatch overhead, like the reference's
+  engine path).
+- ``hybridize()`` swaps in a :class:`CachedOp`. Instead of tracing into an
+  NNVM graph and replaying engine pushes, CachedOp **purifies** the forward:
+  parameters and inputs become function arguments, parameter mutations during
+  the trace (BatchNorm moving stats) become extra outputs, RNG draws fold a
+  traced key — then the whole thing is ``jax.jit``-compiled once per
+  (shapes, dtypes, train-mode) key. XLA fusion replaces both the reference's
+  CachedOp static-alloc optimization and its memory planner.
+- Under ``autograd.record``, the hybridized call is recorded as ONE tape op
+  whose vjp is the vjp of the purified function — so ``loss.backward()``
+  deposits directly into parameter ``.grad``s.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+import numpy as np
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray
+from ..ndarray.ndarray import invoke
+from ..ops.registry import OpDef
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+
+class _BlockScope(threading.local):
+    def __init__(self):
+        self.counters = {}
+
+    def alloc_prefix(self, hint):
+        n = self.counters.get(hint, 0)
+        self.counters[hint] = n + 1
+        return f"{hint}{n}_"
+
+
+_SCOPE = _BlockScope()
+
+
+def _flatten_nds(args):
+    flat, fmt = [], []
+    for a in args:
+        if isinstance(a, NDArray):
+            flat.append(a)
+            fmt.append(None)
+        elif isinstance(a, (list, tuple)):
+            f, m = _flatten_nds(a)
+            flat.extend(f)
+            fmt.append((type(a), m))
+        else:
+            fmt.append(("const", a))
+    return flat, fmt
+
+
+def _unflatten_nds(flat_iter, fmt):
+    out = []
+    for f in fmt:
+        if f is None:
+            out.append(next(flat_iter))
+        elif isinstance(f, tuple) and f[0] == "const":
+            out.append(f[1])
+        else:
+            typ, m = f
+            out.append(typ(_unflatten_nds(flat_iter, m)))
+    return out
+
+
+class Block:
+    """Base class for all layers/models (reference gluon.Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        cls = self.__class__.__name__.lower()
+        self._prefix = prefix if prefix is not None else _SCOPE.alloc_prefix(cls)
+        self._params = ParameterDict(self._prefix, shared=params)
+        self._children = OrderedDict()
+        self._reg_params = OrderedDict()  # attr name -> Parameter (direct)
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # -- attribute magic: registering children and params ----------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+                self._params._params[value.name] = value
+        super().__setattr__(name, value)
+
+    # -- naming ----------------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._prefix.rstrip("_")
+
+    def name_scope(self):
+        class _NS:
+            def __enter__(s):
+                return s
+
+            def __exit__(s, *a):
+                pass
+
+        return _NS()
+
+    # -- params ----------------------------------------------------------
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        pattern = re.compile(select) if select else None
+        for p in self._iter_params():
+            if pattern is None or pattern.match(p.name):
+                ret._params[p.name] = p
+        return ret
+
+    def _iter_params(self):
+        seen = set()
+        for p in self._params.values():
+            if id(p) not in seen:
+                seen.add(id(p))
+                yield p
+        for c in self._children.values():
+            for p in c._iter_params():
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    yield p
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def cast(self, dtype):
+        for p in self._iter_params():
+            p.cast(dtype)
+        for c in self._children.values():
+            pass  # params already covered recursively
+        self._cast_hook(dtype)
+
+    def _cast_hook(self, dtype):
+        for c in self._children.values():
+            c._cast_hook(dtype)
+
+    # -- persistence ------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        from ..ndarray import save as nd_save
+
+        nd_save(filename, {p.name: p.data() for p in self._iter_params()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..ndarray import load as nd_load
+
+        loaded = nd_load(filename)
+        mine = {p.name: p for p in self._iter_params()}
+        for name, param in mine.items():
+            if name in loaded:
+                if param._data is None:
+                    param.shape = loaded[name].shape
+                    param.initialize(ctx=ctx)
+                param.set_data(loaded[name])
+            elif not allow_missing:
+                raise KeyError(f"Parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(mine)
+            if extra:
+                raise KeyError(f"{filename} contains extra parameters {sorted(extra)}")
+
+    # -- call ------------------------------------------------------------
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def __call__(self, *args, **kwargs):
+        for h in self._forward_pre_hooks:
+            h(self, args)
+        out = self.forward(*args, **kwargs)
+        for h in self._forward_hooks:
+            h(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def hybridize(self, active=True, **kwargs):
+        for c in self._children.values():
+            c.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        lines = [f"{'Layer':<40}{'Params':>12}"]
+        total = 0
+        for p in self._iter_params():
+            n = int(np.prod(p.shape)) if p.shape else 0
+            total += n
+            lines.append(f"{p.name:<40}{n:>12}")
+        lines.append(f"{'TOTAL':<40}{total:>12}")
+        print("\n".join(lines))
+        return out
+
+    def __repr__(self):
+        kids = "\n".join(f"  ({k}): {v.__class__.__name__}" for k, v in self._children.items())
+        return f"{self.__class__.__name__}(\n{kids}\n)"
+
+    def apply(self, fn):
+        for c in self._children.values():
+            c.apply(fn)
+        fn(self)
+        return self
+
+
+class HybridBlock(Block):
+    """A Block that can be compiled (hybridized) into one XLA program."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False, **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape, **kwargs)
+        self._cached_op = None
+        super().hybridize(active, static_alloc=static_alloc, static_shape=static_shape,
+                          **kwargs)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from input shapes. Built-in layers
+        override; composite blocks resolve via their children during forward."""
+
+    def _direct_param_kwargs(self):
+        out = {}
+        for attr, p in self._reg_params.items():
+            out[attr] = p.data()
+        return out
+
+    def forward(self, x, *args, **kwargs):
+        self._ensure_init(x, *args)
+        if self._active:
+            if any(p._data is None and p._deferred_init is not None
+                   for p in self._iter_params()):
+                # Deferred shapes must be resolved OUTSIDE the jit trace
+                # (param init inside a trace would leak tracers): run this
+                # first call eagerly, which initializes everything.
+                return self._forward_eager(x, *args, **kwargs)
+            if self._cached_op is None:
+                self._cached_op = CachedOp(self)
+            return self._cached_op(x, *args)
+        return self._forward_eager(x, *args, **kwargs)
+
+    def _forward_eager(self, x, *args, **kwargs):
+        from .. import ndarray as nd_mod
+
+        try:
+            params = self._direct_param_kwargs()
+        except DeferredInitializationError:
+            self.infer_shape(x, *args)
+            params = self._direct_param_kwargs()
+        return self.hybrid_forward(nd_mod, x, *args, **params, **kwargs)
+
+    def _ensure_init(self, *args):
+        """Resolve any deferred param shapes by probing children bottom-up."""
+        for p in self._reg_params.values():
+            if p._data is None and p._deferred_init is not None:
+                self.infer_shape(*args)
+                break
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Save params (+ a json descriptor) for deployment (reference
+        HybridBlock.export — symbol.json + .params)."""
+        import json
+
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        meta = {"format": "mxnet_tpu-hybrid", "class": self.__class__.__name__}
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(meta, f)
+
+    def optimize_for(self, *args, **kwargs):
+        self.hybridize(True)
+
+
+class CachedOp:
+    """Purified + jitted forward of a HybridBlock (reference CachedOp analog).
+
+    Cache key: (train_mode, param avals, input avals). Each entry holds a
+    ``jax.jit``-compiled pure function
+    ``fn(rng_key, *param_vals, *input_vals) -> (*outputs, *aux_updates)``
+    where aux_updates are parameter mutations detected during tracing
+    (e.g. BatchNorm moving stats).
+    """
+
+    def __init__(self, block: HybridBlock):
+        self.block = block
+        self._cache = {}
+
+    def __call__(self, *inputs):
+        flat_in, fmt = _flatten_nds(inputs)
+        params = [p for p in self.block._iter_params() if p._data is not None]
+        train = autograd.is_training()
+        key = (
+            train,
+            tuple((p.data().shape, str(p.data().dtype)) for p in params),
+            tuple((x.shape, str(x.dtype)) for x in flat_in),
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(params, fmt, len(flat_in), train)
+            self._cache[key] = entry
+        rng = _new_rng()
+        all_inputs = [NDArray(rng)] + [p.data() for p in params] + list(flat_in)
+        result = invoke(entry["opdef"], all_inputs, {})
+        if not isinstance(result, tuple):
+            result = (result,)
+        n_out = entry["n_out"]
+        outs, aux = result[:n_out], result[n_out:]
+        for p_idx, a in zip(entry["aux_param_idx"], aux):
+            with autograd.pause():
+                params[p_idx].data()._set_data(a._data)
+        outs_it = iter(outs)
+        restored = _unflatten_nds(outs_it, entry["out_fmt"])
+        return restored[0] if len(restored) == 1 else tuple(restored)
+
+    def _build(self, params, in_fmt, n_in, train):
+        block = self.block
+        n_params = len(params)
+        aux_param_idx: list = []
+        out_fmt_holder: list = []
+
+        def raw_fn(rng_key, *vals):
+            import jax.random as jr
+
+            from .. import random as _random
+
+            if hasattr(jr, "wrap_key_data") and rng_key.dtype == jax.numpy.uint32:
+                rng_key = jr.wrap_key_data(rng_key)
+            pvals = vals[:n_params]
+            ivals = vals[n_params:]
+            param_nds = [p.data() for p in params]
+            saved = [(nd_._data, nd_._version) for nd_ in param_nds]
+            try:
+                for nd_, v in zip(param_nds, pvals):
+                    nd_._data = v
+                in_nds = _unflatten_nds(iter([NDArray(v) for v in ivals]), in_fmt)
+                old_rec = autograd.set_recording(False)
+                old_train = autograd.set_training(train)
+                try:
+                    with _random.trace_key_scope(rng_key):
+                        out = block._forward_eager(*in_nds)
+                finally:
+                    autograd.set_recording(old_rec)
+                    autograd.set_training(old_train)
+                flat_out, fmt = _flatten_nds([out] if not isinstance(out, tuple) else list(out))
+                out_fmt_holder.clear()
+                out_fmt_holder.extend(fmt if not isinstance(out, tuple) else fmt)
+                out_vals = [o._data for o in flat_out]
+                # detect aux mutations (params whose wrapper was rebound)
+                aux_vals = []
+                aux_param_idx.clear()
+                for i, (nd_, (old_data, _v)) in enumerate(zip(param_nds, saved)):
+                    if nd_._data is not pvals[i]:
+                        aux_param_idx.append(i)
+                        aux_vals.append(nd_._data)
+                return tuple(out_vals + aux_vals)
+            finally:
+                for nd_, (old_data, _v) in zip([p.data() for p in params], saved):
+                    nd_._data = old_data
+
+        jitted = jax.jit(raw_fn)
+
+        # Trace once eagerly via jit lowering to populate out_fmt/aux metadata.
+        # (jax.jit is lazy; we force trace with eval_shape on representative avals.)
+        def trace_probe():
+            import jax.numpy as jnp
+
+            pav = [jax.ShapeDtypeStruct(p.data().shape, p.data().dtype) for p in params]
+            rng_av = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+            # input avals come from the first real call; defer to call time
+            return pav, rng_av
+
+        opdef = OpDef(f"CachedOp_{block.name}", jitted,
+                      num_outputs=lambda kw: None)  # resolved after first call
+
+        entry = {"opdef": opdef, "aux_param_idx": aux_param_idx,
+                 "out_fmt": out_fmt_holder, "n_out": None}
+
+        # Wrap fn so first execution finalizes n_out/num_outputs metadata.
+        def finalizing_fn(*vals, **kw):
+            res = jitted(*vals, **kw)
+            n_aux = len(aux_param_idx)
+            entry["n_out"] = len(res) - n_aux
+            return res
+
+        opdef.fn = finalizing_fn
+        opdef.num_outputs = lambda kw: len(out_fmt_holder) + len(aux_param_idx)
+        return entry
+
+
+def _new_rng():
+    import jax.random as jr
+
+    from .. import random as _random
+
+    return jr.key_data(_random.next_key()) if hasattr(jr, "key_data") else _random.next_key()
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol graph + inputs (reference SymbolBlock).
+
+    Implemented after the symbolic frontend (mx.sym) — see mxnet_tpu/symbol.
+    """
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__()
+        self._outputs = outputs
+        self._inputs = inputs
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        from ..symbol import Symbol
+
+        sym = self._outputs
+        arg_map = {i.name if hasattr(i, "name") else str(i): a
+                   for i, a in zip(self._inputs, args)}
+        return sym.eval_with(arg_map)

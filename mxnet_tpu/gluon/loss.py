@@ -1,0 +1,266 @@
+"""Gluon losses.
+
+Reference: ``python/mxnet/gluon/loss.py`` (TBV — SURVEY.md §2.3). Semantics
+kept: per-sample weighting, batch_axis mean, sample_weight broadcasting.
+"""
+from __future__ import annotations
+
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss", "KLDivLoss",
+           "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
+           "TripletLoss", "CosineEmbeddingLoss", "CTCLoss"]
+
+
+def _apply_weighting(F, loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = F.broadcast_mul(loss, sample_weight)
+    if weight is not None and weight != 1.0:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(F, x, y):
+    return x.reshape(y.shape)
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(label - pred)
+        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """CE with integrated log-softmax (reference SoftmaxCrossEntropyLoss)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False, weight=None,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        if self._sparse:
+            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+        else:
+            label = _reshape_like(F, label, pred)
+            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(F, label, pred)
+        if not self._from_sigmoid:
+            # max(x,0) - x*z + log(1+exp(-|x|)) — numerically stable
+            loss = F.relu(pred) - pred * label + \
+                F.Activation(-F.abs(pred), act_type="softrelu")
+        else:
+            eps = 1e-12
+            loss = -(F.log(pred + eps) * label + F.log(1 - pred + eps) * (1 - label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        loss = label * (F.log(label + 1e-12) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = F.where(loss > self._rho,
+                       loss - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(loss))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.relu(self._margin - pred * label)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(F.relu(self._margin - pred * label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._fmt = label_format
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        if self._fmt == "binary":
+            label = 2 * label - 1
+        loss = F.Activation(-pred * label, act_type="softrelu")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(F, positive, pred)
+        negative = _reshape_like(F, negative, pred)
+        loss = F.sum(F.square(pred - positive) - F.square(pred - negative),
+                     axis=self._batch_axis, exclude=True)
+        loss = F.relu(loss + self._margin)
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        def cos(a, b):
+            num = F.sum(a * b, axis=-1)
+            den = F.sqrt(F.sum(a * a, axis=-1)) * F.sqrt(F.sum(b * b, axis=-1))
+            return num / (den + 1e-12)
+
+        sim = cos(input1, input2)
+        label = label.reshape(sim.shape)
+        loss = F.where(label == 1, 1 - sim, F.relu(sim - self._margin))
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification loss (reference contrib.CTCLoss).
+
+    Implemented with the stable log-alpha dynamic program via lax.scan.
+    Layout: pred (T, N, C) unless layout='NTC'; labels (N, L) padded with -1.
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None, label_lengths=None,
+                       sample_weight=None):
+        from ..ndarray.ndarray import invoke_fn
+
+        ntc = self._layout == "NTC"
+
+        def ctc(pred_, label_):
+            import jax.numpy as jnp
+            from jax import lax
+
+            x = pred_ if not ntc else jnp.swapaxes(pred_, 0, 1)  # (T, N, C)
+            T, N, C = x.shape
+            logp = x - jnp.max(x, axis=-1, keepdims=True)
+            logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
+            lab = label_.astype(jnp.int32)  # (N, L), -1 or 0 padding
+            L = lab.shape[1]
+            valid = lab > 0  # blank index 0, padding <=0
+            lab_len = jnp.sum(valid.astype(jnp.int32), axis=1)
+            S = 2 * L + 1
+            ext = jnp.zeros((N, S), jnp.int32)
+            ext = ext.at[:, 1::2].set(jnp.where(valid, lab, 0))
+            neg_inf = -1e30
+            a0 = jnp.full((N, S), neg_inf)
+            a0 = a0.at[:, 0].set(logp[0, :, 0])
+            a0 = a0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+
+            def step(alpha, logp_t):
+                prev1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+                prev2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+                idx = jnp.arange(S)[None, :]
+                same = jnp.concatenate(
+                    [jnp.zeros((N, 2), bool),
+                     ext[:, 2:] == ext[:, :-2]], 1)
+                allow2 = (idx % 2 == 1) & (~same)
+                m = jnp.maximum(alpha, prev1)
+                m = jnp.where(allow2, jnp.maximum(m, prev2), m)
+                s = (jnp.exp(alpha - m) + jnp.exp(prev1 - m)
+                     + jnp.where(allow2, jnp.exp(prev2 - m), 0.0))
+                new = m + jnp.log(jnp.maximum(s, 1e-38))
+                emit = jnp.take_along_axis(logp_t, ext, axis=1)
+                return new + emit, None
+
+            alphaT, _ = lax.scan(step, a0, logp[1:])
+            end1 = 2 * lab_len
+            end2 = jnp.maximum(2 * lab_len - 1, 0)
+            lse = jnp.logaddexp(
+                jnp.take_along_axis(alphaT, end1[:, None], 1)[:, 0],
+                jnp.take_along_axis(alphaT, end2[:, None], 1)[:, 0])
+            return -lse
+
+        loss = invoke_fn(ctc, [pred, label])
+        return _apply_weighting(F, loss, self._weight, sample_weight)

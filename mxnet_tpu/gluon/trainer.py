@@ -1,0 +1,118 @@
+"""Gluon Trainer — applies an optimizer to a set of Parameters.
+
+Reference: ``python/mxnet/gluon/trainer.py`` (TBV — SURVEY.md §3.2): wires
+grads to the KVStore (push/pull per key) then runs fused updates.
+
+TPU redesign: with a single logical copy per parameter, ``_allreduce_grads``
+is the KVStore hook only for multi-process (dist) kvstores; single-process
+multi-chip DP happens inside the jitted step via psum (see kvstore/ and
+parallel/). The step sequence (allreduce → update) and the public API
+(step/allreduce_grads/update/save_states/load_states, update_on_kvstore)
+match the reference.
+"""
+from __future__ import annotations
+
+from ..optimizer import Optimizer, Updater, create as opt_create
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if hasattr(params, "values"):
+            params = list(params.values())
+        self._params = [p for p in params if p.grad_req != "null"]
+        self._all_params = list(params)
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt_create(optimizer, param_dict={
+            i: p for i, p in enumerate(self._params)}, **optimizer_params)
+        self._updaters = [Updater(self._optimizer)]
+        self._kvstore_kind = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._params_to_init = list(self._params)
+
+    # ------------------------------------------------------------------
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        from ..kvstore import create as kv_create
+
+        kind = self._kvstore_kind
+        if kind is None or (isinstance(kind, str) and kind in ("device", "local")):
+            # single-process: no cross-process reduction needed — XLA collectives
+            # handle intra-process multi-chip inside the jitted step.
+            self._kvstore = None
+        elif isinstance(kind, str):
+            self._kvstore = kv_create(kind)
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        else:
+            self._kvstore = kind
+        self._kv_initialized = True
+
+    # ------------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale by 1/batch_size, allreduce, update (reference semantics)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            self._kvstore.push(i, p.data().grad)
+            if not self._update_on_kvstore:
+                self._kvstore.pull(i, out=p.data().grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, p in enumerate(self._params):
+                self._kvstore.pull(i, out=p.data())
+            return
+        for i, p in enumerate(self._params):
+            g = p.data().grad
+            if g is None:
+                if ignore_stale_grad:
+                    continue
+                raise RuntimeError(f"Parameter {p.name} has no grad")
+            updater(i, g, p.data())
+
+    # ------------------------------------------------------------------
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states())
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

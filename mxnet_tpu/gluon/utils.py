@@ -1,0 +1,73 @@
+"""Gluon utilities: split_and_load, clip_global_norm, download stub.
+
+Reference: ``python/mxnet/gluon/utils.py`` (TBV — SURVEY.md §2.4 DP row).
+On TPU, `split_and_load` exists for script compat; the idiomatic path shards
+one global batch over the Mesh via jax.sharding instead of a python-side split.
+"""
+from __future__ import annotations
+
+import math
+
+from ..context import Context
+from ..ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1", "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"batch size {size} not divisible by number of slices {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(axis=batch_axis, begin=begin, end=end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(c) for s, c in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays in place so their global L2 norm ≤ max_norm."""
+    import numpy as np
+
+    total = 0.0
+    for a in arrays:
+        n = float(a.norm().asscalar())
+        total += n * n
+    total = math.sqrt(total)
+    if check_isfinite and not np.isfinite(total):
+        import warnings
+
+        warnings.warn("nan or inf found in clip_global_norm")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    h = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise RuntimeError("download() unavailable: this environment has no egress; "
+                       "place files locally instead")

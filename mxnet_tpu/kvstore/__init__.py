@@ -1,0 +1,4 @@
+"""KVStore package (reference src/kvstore + python/mxnet/kvstore.py)."""
+from .kvstore import KVStore, create  # noqa: F401
+
+__all__ = ["KVStore", "create"]

@@ -1,0 +1,159 @@
+"""Distributed KVStore: multi-process sync over jax.distributed + async ZMQ PS.
+
+Reference: ``src/kvstore/kvstore_dist.h`` / ``kvstore_dist_server.h`` over
+ps-lite (TBV — SURVEY.md §3.4, §5.8 transport 3).
+
+TPU-native redesign:
+
+- ``dist_sync`` / ``dist_device_sync``: each process is a jax.distributed
+  worker; push/pull map to a global-sum collective over the DCN/ICI mesh via
+  ``jax.make_array_from_process_local_data`` + psum (multi-host pjit subsumes
+  per-key RPC). Environment mirrors the reference launcher contract:
+  DMLC_NUM_WORKER / DMLC_WORKER_ID (or MXNET_COORDINATOR for jax.distributed).
+- ``dist_async``: a literal host-side parameter server over ZMQ-style TCP
+  (pure-stdlib socket framing; C++ server planned) — workers push grads, the
+  server applies the optimizer on arrival, workers pull fresh weights with no
+  barrier. See mxnet_tpu/kvstore/ps_server.py.
+"""
+from __future__ import annotations
+
+import os
+
+from ..base import MXNetError, get_env
+from .kvstore import KVStore, _as_list
+
+__all__ = ["DistKVStore"]
+
+
+class DistKVStore(KVStore):
+    """Multi-process kvstore. Sync modes use collectives; async uses the PS."""
+
+    def __init__(self, kind="dist_sync"):
+        super().__init__(kind)
+        self._is_async = "async" in kind
+        self._rank = int(get_env("DMLC_WORKER_ID", get_env("MXNET_WORKER_ID", 0, int), int) or 0)
+        self._num_workers = int(get_env("DMLC_NUM_WORKER", get_env("MXNET_NUM_WORKER", 1, int), int) or 1)
+        self._ps = None
+        if self._is_async:
+            addr = get_env("MXNET_PS_ADDR", get_env("DMLC_PS_ROOT_URI", None))
+            port = int(get_env("MXNET_PS_PORT", get_env("DMLC_PS_ROOT_PORT", 9091, int), int) or 9091)
+            if addr:
+                from .ps_client import PSClient
+
+                self._ps = PSClient(addr, port)
+        else:
+            self._maybe_init_jax_distributed()
+
+    def _maybe_init_jax_distributed(self):
+        if self._num_workers <= 1:
+            return
+        import jax
+
+        coord = get_env("MXNET_COORDINATOR", None)
+        if coord and jax.process_count() == 1:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=self._num_workers,
+                                       process_id=self._rank)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def push(self, key, value, priority=0):
+        if self._ps is not None:
+            keys, values = _as_list(key), _as_list(value)
+            for k, v in zip(keys, values):
+                vs = _as_list(v)
+                merged = vs[0]
+                for e in vs[1:]:
+                    merged = merged + e
+                self._ps.push(str(k), merged.asnumpy())
+            return
+        if self._num_workers > 1:
+            # sum across processes via a psum on the global mesh
+            keys, values = _as_list(key), _as_list(value)
+            for k, v in zip(keys, values):
+                vs = _as_list(v)
+                merged = vs[0]
+                for e in vs[1:]:
+                    merged = merged + e
+                reduced = _cross_process_sum(merged)
+                super().push(str(k), reduced)
+            return
+        super().push(key, value, priority)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if self._ps is not None:
+            keys, outs = _as_list(key), _as_list(out)
+            for k, o in zip(keys, outs):
+                arr = self._ps.pull(str(k))
+                for oo in _as_list(o):
+                    from ..ndarray import array
+
+                    oo._set_data(array(arr)._data)
+            return
+        super().pull(key, out=out, priority=priority)
+
+    def set_optimizer(self, optimizer):
+        if self._ps is not None:
+            self._ps.set_optimizer(optimizer)
+            return
+        super().set_optimizer(optimizer)
+
+    def init(self, key, value):
+        if self._ps is not None:
+            keys, values = _as_list(key), _as_list(value)
+            for k, v in zip(keys, values):
+                self._ps.init(str(k), v.asnumpy())
+            return
+        super().init(key, value)
+
+    def barrier(self):
+        if self._ps is not None:
+            self._ps.barrier()
+            return
+        if self._num_workers > 1:
+            import jax
+            import jax.numpy as jnp
+
+            # an effectful collective barrier: global sum of a scalar
+            _cross_process_sum_scalar()
+
+
+def _cross_process_sum(nd_arr):
+    """Sum an identical-shaped array across jax processes (DCN allreduce)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return nd_arr
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices()).reshape(jax.process_count(), -1)[:, :1].reshape(-1)
+    mesh = Mesh(devs, ("w",))
+    local = nd_arr.asjax()[None]
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("w")), np.asarray(local))
+
+    @jax.jit
+    def reduce_fn(x):
+        return jnp.sum(x, axis=0)
+
+    out = reduce_fn(garr)
+    from ..ndarray import NDArray
+
+    return NDArray(jax.device_get(out))
+
+
+def _cross_process_sum_scalar():
+    import jax
+    import numpy as np
+
+    from ..ndarray import array
+
+    _cross_process_sum(array(np.zeros(1, np.float32)))

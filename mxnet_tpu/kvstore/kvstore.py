@@ -1,0 +1,177 @@
+"""KVStore — gradient aggregation + parameter distribution.
+
+Reference: ``src/kvstore/*`` + ``python/mxnet/kvstore.py`` (TBV — SURVEY.md
+§2.1 L7, §2.4, §5.8): modes local/device (intra-node comm), nccl (grouped
+allreduce), dist_sync / dist_async / dist_sync_device (ps-lite PS).
+
+TPU-native redesign (SURVEY.md §2.4 table):
+
+- ``local`` / ``device`` / ``nccl`` / ``ici``: single-process modes. With one
+  logical array per parameter there is nothing to reduce **between** python
+  copies — multi-chip data-parallel runs INSIDE the jitted step as an XLA
+  ``psum`` over the Mesh (see mxnet_tpu.parallel). These modes therefore keep
+  reference push/pull *semantics* (aggregation of multiple pushed values per
+  key, server-side optimizer via set_optimizer) so reference-style training
+  loops and the known-value push/pull tests work unchanged.
+- ``dist_sync`` / ``dist_async``: multi-process over ``jax.distributed`` /
+  a host-side ZMQ parameter server (mxnet_tpu.kvstore.dist).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStore:
+    """Single-process key-value store (modes: local, device, nccl, ici)."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store: Dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # -- core API ---------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _as_list(key), _as_list(value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k in self._store:
+                continue
+            self._store[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate value(s) into the key (sum over pushed values, matching
+        the reference's merge semantics); if an optimizer is set, run the
+        update instead (update_on_kvstore mode)."""
+        keys, values = _as_list(key), _as_list(value)
+        if len(keys) == 1 and len(values) > 1:
+            keys = keys * len(values)
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            vs = _as_list(v)
+            merged = vs[0]
+            for extra in vs[1:]:
+                merged = merged + extra
+            if self._updater is not None:
+                self._updater(int(k) if k.isdigit() else k, merged, self._store[k])
+            else:
+                self._pending = getattr(self, "_pending", {})
+                self._pending.setdefault(k, []).append(merged)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = _as_list(key)
+        outs = _as_list(out)
+        if len(keys) == 1 and len(outs) > 1:
+            keys = keys * len(outs)
+        for k, o in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            self._flush(k)
+            for oo in _as_list(o):
+                oo._set_data(self._store[k]._data)
+
+    def _flush(self, k):
+        pending = getattr(self, "_pending", {}).pop(k, None)
+        if pending:
+            merged = pending[0]
+            for extra in pending[1:]:
+                merged = merged + extra
+            self._store[k]._set_data(merged._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out=out if out is not None else value, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows named by row_ids (reference sparse embedding
+        path). Dense emulation: gather rows."""
+        keys = _as_list(key)
+        outs = _as_list(out)
+        rids = _as_list(row_ids)
+        for k, o, r in zip(keys, outs, rids):
+            k = str(k)
+            self._flush(k)
+            full = self._store[k]
+            rows = full.take(r.astype("int32") if hasattr(r, "astype") else r)
+            o._set_data(rows._data)
+
+    # -- optimizer-on-store ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        from ..optimizer import Updater
+
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    # -- persistence / misc ----------------------------------------------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        pass
+
+    def _barrier(self):
+        pass
+
+
+def create(name="local") -> KVStore:
+    """Create a kvstore (reference kvstore.create). Modes:
+
+    local/device/nccl/ici → single-process KVStore (multi-chip DP is an XLA
+    psum inside the step); dist_sync/dist_device_sync → multi-process
+    DistKVStore over jax.distributed collectives; dist_async → ZMQ PS client.
+    """
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device", "nccl", "ici"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_device_sync", "dist_sync_device", "dist_async",
+                "dist"):
+        from .dist import DistKVStore
+
+        return DistKVStore(name)
+    if name == "horovod":
+        raise MXNetError("horovod kvstore is not applicable on TPU; use dist_sync")
+    raise MXNetError(f"unknown kvstore type {name!r}")

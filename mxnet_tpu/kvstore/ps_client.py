@@ -1,0 +1,45 @@
+"""Worker-side client for the async parameter server (see ps_server.py)."""
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+
+import numpy as np
+
+from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PUSH, OP_SET_OPT,
+                        OP_SHUTDOWN, _pack_array, _recv_msg, _send_msg,
+                        _unpack_array)
+
+
+class PSClient:
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._lock = threading.Lock()
+
+    def _rpc(self, opcode, key="", payload=b""):
+        with self._lock:
+            _send_msg(self._sock, opcode, key, payload)
+            return _recv_msg(self._sock)
+
+    def init(self, key: str, value: np.ndarray):
+        self._rpc(OP_INIT, key, _pack_array(np.ascontiguousarray(value)))
+
+    def push(self, key: str, grad: np.ndarray):
+        self._rpc(OP_PUSH, key, _pack_array(np.ascontiguousarray(grad)))
+
+    def pull(self, key: str) -> np.ndarray:
+        _, _, payload = self._rpc(OP_PULL, key)
+        return _unpack_array(payload)
+
+    def set_optimizer(self, optimizer):
+        spec = {"name": type(optimizer).__name__.lower(),
+                "kwargs": {"learning_rate": optimizer.lr, "wd": optimizer.wd,
+                           "rescale_grad": optimizer.rescale_grad}}
+        self._rpc(OP_SET_OPT, "", pickle.dumps(spec))
+
+    def barrier(self):
+        self._rpc(OP_BARRIER)
+
+    def shutdown(self):
+        self._rpc(OP_SHUTDOWN)

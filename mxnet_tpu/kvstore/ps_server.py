@@ -1,0 +1,201 @@
+"""Host-side asynchronous parameter server (dist_async transport).
+
+Reference: ps-lite (``3rdparty/ps-lite``: ZMQ van, KVServer message loop,
+server-side optimizer — TBV, SURVEY.md §3.4). TPU-native plan keeps this
+**host-side over DCN** (north star): TPU workers push grads from host buffers,
+the server applies the optimizer on arrival (no barrier — async), workers pull
+fresh weights.
+
+Transport: length-prefixed msgpack-free binary framing over TCP sockets
+(stdlib only; the reference uses ZMQ which is not in this image). The server
+runs one thread per connection + a lock per key, matching the reference's
+per-key serialized updates. A C++ implementation of the same wire protocol
+lives in native/ps (same framing), used when built.
+
+Wire format (little-endian):
+  u32 total_len | u8 opcode | u16 key_len | key bytes | payload
+  opcodes: 0=INIT 1=PUSH 2=PULL 3=SET_OPT 4=BARRIER 5=SHUTDOWN
+  payload for INIT/PUSH: u8 ndim | u32*ndim shape | u8 dtype_code | raw bytes
+  reply for PULL: same array framing; others: u8 status
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
+
+OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN = range(6)
+
+
+def _pack_array(arr: np.ndarray) -> bytes:
+    code = DTYPE_TO_CODE[arr.dtype.name]
+    head = struct.pack("<B", arr.ndim) + struct.pack(f"<{arr.ndim}I", *arr.shape) \
+        + struct.pack("<B", code)
+    return head + arr.tobytes()
+
+
+def _unpack_array(buf: memoryview) -> np.ndarray:
+    ndim = struct.unpack_from("<B", buf, 0)[0]
+    shape = struct.unpack_from(f"<{ndim}I", buf, 1)
+    code = struct.unpack_from("<B", buf, 1 + 4 * ndim)[0]
+    dtype = np.dtype(CODE_TO_DTYPE[code])
+    data = np.frombuffer(buf, dtype=dtype, offset=2 + 4 * ndim)
+    return data.reshape(shape).copy()
+
+
+def _send_msg(sock: socket.socket, opcode: int, key: str = "", payload: bytes = b""):
+    kb = key.encode()
+    body = struct.pack("<BH", opcode, len(kb)) + kb + payload
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket):
+    (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+    body = memoryview(_recv_exact(sock, ln))
+    opcode, klen = struct.unpack_from("<BH", body, 0)
+    key = bytes(body[3:3 + klen]).decode()
+    payload = body[3 + klen:]
+    return opcode, key, payload
+
+
+class PSServer:
+    """The server process: aggregates pushes and runs the optimizer per key.
+
+    async mode (reference dist_async): every push immediately applies
+    ``updater(key, grad, weight)`` under the key's lock — no worker barrier.
+    """
+
+    def __init__(self, host="0.0.0.0", port=9091, num_workers=1):
+        self._weights: Dict[str, np.ndarray] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._updater = None
+        self._global_lock = threading.Lock()
+        self._num_workers = num_workers
+        self._barrier_count = 0
+        self._barrier_cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.5)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def start(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _handle(self, conn: socket.socket):
+        try:
+            while True:
+                opcode, key, payload = _recv_msg(conn)
+                if opcode == OP_INIT:
+                    arr = _unpack_array(payload)
+                    with self._global_lock:
+                        if key not in self._weights:
+                            self._weights[key] = arr
+                            self._locks[key] = threading.Lock()
+                    _send_msg(conn, OP_INIT, key, b"\x00")
+                elif opcode == OP_PUSH:
+                    grad = _unpack_array(payload)
+                    with self._locks[key]:
+                        if self._updater is not None:
+                            w = self._weights[key]
+                            self._apply(key, grad, w)
+                        else:
+                            self._weights[key] = self._weights[key] + grad
+                    _send_msg(conn, OP_PUSH, key, b"\x00")
+                elif opcode == OP_PULL:
+                    with self._locks.get(key, self._global_lock):
+                        arr = self._weights[key]
+                    _send_msg(conn, OP_PULL, key, _pack_array(arr))
+                elif opcode == OP_SET_OPT:
+                    self._set_optimizer_bytes(bytes(payload))
+                    _send_msg(conn, OP_SET_OPT, key, b"\x00")
+                elif opcode == OP_BARRIER:
+                    with self._barrier_cv:
+                        self._barrier_count += 1
+                        if self._barrier_count >= self._num_workers:
+                            self._barrier_count = 0
+                            self._barrier_cv.notify_all()
+                        else:
+                            self._barrier_cv.wait(timeout=60)
+                    _send_msg(conn, OP_BARRIER, key, b"\x00")
+                elif opcode == OP_SHUTDOWN:
+                    _send_msg(conn, OP_SHUTDOWN, key, b"\x00")
+                    self.stop()
+                    return
+        except (ConnectionError, OSError):
+            return
+
+    def _set_optimizer_bytes(self, blob: bytes):
+        from ..optimizer import Updater, create as opt_create
+
+        spec = pickle.loads(blob)
+        opt = opt_create(spec["name"], **spec["kwargs"])
+        self._updater = Updater(opt)
+
+    def _apply(self, key, grad, weight_np):
+        """Run the fused optimizer update on host numpy via the framework ops
+        (the server machine may have no TPU; jax-cpu executes)."""
+        from ..ndarray import array
+
+        w = array(weight_np)
+        g = array(grad)
+        self._updater(key, g, w)
+        self._weights[key] = w.asnumpy()
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description="mxnet_tpu async parameter server")
+    ap.add_argument("--port", type=int, default=9091)
+    ap.add_argument("--num-workers", type=int, default=1)
+    args = ap.parse_args()
+    srv = PSServer(port=args.port, num_workers=args.num_workers)
+    print(f"PSServer listening on :{srv.port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,293 @@
+"""Evaluation metrics (reference python/mxnet/metric.py, TBV — SURVEY.md §5.5)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "CrossEntropy", "Perplexity",
+           "F1", "MAE", "MSE", "RMSE", "PearsonCorrelation", "Loss",
+           "CompositeEvalMetric", "create"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        c = CompositeEvalMetric()
+        for m in metric:
+            c.add(create(m, *args, **kwargs))
+        return c
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    name = metric.lower()
+    aliases = {"acc": "accuracy", "top_k_accuracy": "topkaccuracy",
+               "top_k_acc": "topkaccuracy", "ce": "crossentropy",
+               "pearsonr": "pearsoncorrelation"}
+    name = aliases.get(name, name)
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown metric {metric!r}")
+    return _REGISTRY[name](*args, **kwargs)
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+    def update_dict(self, labels, preds):
+        self.update(list(labels.values()), list(preds.values()))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype(np.int64).reshape(-1)
+            label = label.astype(np.int64).reshape(-1)
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(label)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label).astype(np.int64).reshape(-1)
+            topk = np.argsort(-pred, axis=-1)[:, : self.top_k]
+            self.sum_metric += (topk == label[:, None]).any(axis=1).sum()
+            self.num_inst += len(label)
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label).astype(np.int64).reshape(-1)
+            p = pred.reshape(-1, pred.shape[-1])[np.arange(len(label)), label]
+            self.sum_metric += (-np.log(p + self.eps)).sum()
+            self.num_inst += len(label)
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label).astype(np.int64).reshape(-1)
+            p = pred.reshape(-1, pred.shape[-1])[np.arange(len(label)), label]
+            nll = -np.log(np.maximum(p, 1e-12))
+            if self.ignore_label is not None:
+                keep = label != self.ignore_label
+                nll, cnt = nll[keep], keep.sum()
+            else:
+                cnt = len(label)
+            self.sum_metric += nll.sum()
+            self.num_inst += int(cnt)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(np.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.tp = self.fp = self.fn = 0
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "tp"):
+            self.reset_stats()
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label).reshape(-1).astype(np.int64)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.reshape(-1).astype(np.int64)
+            self.tp += int(((pred == 1) & (label == 1)).sum())
+            self.fp += int(((pred == 1) & (label == 0)).sum())
+            self.fn += int(((pred == 0) & (label == 1)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        prec = self.tp / max(self.tp + self.fp, 1)
+        rec = self.tp / max(self.tp + self.fn, 1)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label)
+            self.sum_metric += np.abs(label.reshape(pred.shape) - pred).mean() * len(pred)
+            self.num_inst += len(pred)
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label)
+            self.sum_metric += ((label.reshape(pred.shape) - pred) ** 2).mean() * len(pred)
+            self.num_inst += len(pred)
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+    def get(self):
+        name, mse = super().get()
+        return name, float(np.sqrt(mse))
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self._preds, self._labels = [], []
+
+    def reset(self):
+        super().reset()
+        self._preds, self._labels = [], []
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            self._preds.append(_np(pred).reshape(-1))
+            self._labels.append(_np(label).reshape(-1))
+            self.num_inst += len(self._preds[-1])
+
+    def get(self):
+        if not self._preds:
+            return self.name, float("nan")
+        p = np.concatenate(self._preds)
+        l = np.concatenate(self._labels)
+        return self.name, float(np.corrcoef(p, l)[0, 1])
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            v = _np(pred)
+            self.sum_metric += float(v.sum())
+            self.num_inst += v.size
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
+        super().__init__(name, **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            v = self._feval(_np(label), _np(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+@register
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values

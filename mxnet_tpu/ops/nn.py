@@ -20,6 +20,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register, alias
@@ -469,11 +470,15 @@ def _pooling(data, kernel=(), stride=(), pad=(), pool_type="max", global_pool=Fa
     else:
         pads = [(0, 0), (0, 0)] + [(p, p) for p in pad]
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max, window,
+        # init must be a CONCRETE numpy literal so JAX recognizes the max
+        # monoid (reduce_window_max primitive, which has a transpose rule);
+        # a traced/device init falls back to generic reduce_window, which
+        # does not differentiate.
+        init = -np.inf if jnp.issubdtype(data.dtype, jnp.floating) else np.iinfo(data.dtype).min
+        return lax.reduce_window(data, np.array(init, data.dtype), lax.max, window,
                                  strides, pads)
     if pool_type in ("avg", "sum"):
-        s = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add, window, strides, pads)
+        s = lax.reduce_window(data, np.array(0, data.dtype), lax.add, window, strides, pads)
         if pool_type == "sum":
             return s
         if count_include_pad:
@@ -482,10 +487,10 @@ def _pooling(data, kernel=(), stride=(), pad=(), pool_type="max", global_pool=Fa
                 denom *= k
             return s / denom
         ones = jnp.ones_like(data)
-        cnt = lax.reduce_window(ones, jnp.asarray(0, data.dtype), lax.add, window, strides, pads)
+        cnt = lax.reduce_window(ones, np.array(0, data.dtype), lax.add, window, strides, pads)
         return s / cnt
     if pool_type == "lp":
-        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value), jnp.asarray(0, data.dtype),
+        s = lax.reduce_window(jnp.power(jnp.abs(data), p_value), np.array(0, data.dtype),
                               lax.add, window, strides, pads)
         return jnp.power(s, 1.0 / p_value)
     raise ValueError(f"unknown pool_type {pool_type!r}")

@@ -1,0 +1,437 @@
+"""Optimizers — thin state machines over the fused update ops.
+
+Reference: ``python/mxnet/optimizer/optimizer.py`` + the fused kernels in
+``src/operator/optimizer_op.*`` (TBV — SURVEY.md §2.2/§2.3). The TPU analog of
+"fused update kernel" is that each update is one registered pure op; when the
+whole train step is jitted (Module / fused Trainer path) XLA fuses all
+parameter updates into the step program.
+
+API parity: create-by-name registry, ``update(index, weight, grad, state)``,
+multi-precision (fp16/bf16 weights with fp32 master copy), lr/wd multipliers,
+``set_learning_rate``, Updater for kvstore server-side application.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ndarray import NDArray, zeros
+from ..ndarray.ndarray import invoke
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp", "AdaGrad",
+           "AdaDelta", "Ftrl", "FTML", "Signum", "create", "register", "Updater",
+           "get_updater"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    name = name.lower()
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown optimizer {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01, rescale_grad=1.0, clip_gradient=None,
+                 lr_scheduler=None, wd=0.0, momentum=0.0, param_idx2name=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0, **kwargs):
+        self.lr = learning_rate
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient if clip_gradient is not None else -1.0
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- bookkeeping -----------------------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= p.lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            wd *= p.wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise RuntimeError("cannot set lr directly when lr_scheduler is active")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        return self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    # -- state -----------------------------------------------------------
+    def _use_mp(self, weight):
+        return self.multi_precision and weight.dtype in (np.float16,) or \
+            (self.multi_precision and str(weight.dtype) == "bfloat16")
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self._use_mp(weight):
+            w32 = weight.astype(np.float32)
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self._use_mp(weight):
+            inner_state, w32 = state
+            g32 = grad.astype(np.float32)
+            self.update(index, w32, g32, inner_state)
+            weight._set_data(w32.astype(weight.dtype)._data)
+        else:
+            self.update(index, weight, grad, state)
+
+    def _common(self, index):
+        self._update_count(index)
+        return self._get_lr(index), self._get_wd(index)
+
+
+@register
+class SGD(Optimizer):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient)
+        if state is not None:
+            invoke("sgd_mom_update", [weight, grad, state],
+                   {**kw, "momentum": self.momentum,
+                    "out": (weight, state)})
+        else:
+            invoke("sgd_update", [weight, grad], {**kw, "out": weight})
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        invoke("nag_mom_update", [weight, grad, state],
+               {"lr": lr, "wd": wd, "momentum": self.momentum,
+                "rescale_grad": self.rescale_grad, "clip_gradient": self.clip_gradient,
+                "out": (weight, state)})
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        t = self._index_update_count[index]
+        lr *= np.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        mean, var = state
+        invoke("adam_update", [weight, grad, mean, var],
+               {"lr": lr, "beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "wd": wd, "rescale_grad": self.rescale_grad,
+                "clip_gradient": self.clip_gradient, "out": (weight, mean, var)})
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference contrib adamw_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        t = self._index_update_count[index]
+        coef = float(np.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t))
+        mean, var = state
+        invoke("adamw_update", [weight, grad, mean, var],
+               {"lr": lr * coef, "beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "wd": wd, "eta": 1.0,
+                "rescale_grad": self.rescale_grad,
+                "clip_gradient": self.clip_gradient, "out": (weight, mean, var)})
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (reference lamb_update_*)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound if lower_bound is not None else -1.0
+        self.upper_bound = upper_bound if upper_bound is not None else -1.0
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = invoke("lamb_update_phase1", [weight, grad, mean, var],
+                   {"beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon,
+                    "t": t, "bias_correction": self.bias_correction, "wd": wd,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": self.clip_gradient})
+        # phase1 returns only the update direction; recompute m/v for state
+        mean._set_data((self.beta1 * mean + (1 - self.beta1) *
+                        (grad * self.rescale_grad))._data)
+        var._set_data((self.beta2 * var + (1 - self.beta2) *
+                       (grad * self.rescale_grad) ** 2)._data)
+        r1 = weight.norm()
+        r2 = g.norm()
+        invoke("lamb_update_phase2", [weight, g, r1, r2],
+               {"lr": lr, "lower_bound": self.lower_bound,
+                "upper_bound": self.upper_bound, "out": weight})
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon = gamma1, gamma2, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights if clip_weights is not None else -1.0
+
+    def create_state(self, index, weight):
+        z = lambda: zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        if self.centered:
+            return (z(), z(), z())
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        base = {"lr": lr, "wd": wd, "gamma1": self.gamma1, "epsilon": self.epsilon,
+                "rescale_grad": self.rescale_grad, "clip_gradient": self.clip_gradient,
+                "clip_weights": self.clip_weights}
+        if self.centered:
+            n, g_, delta = state
+            invoke("rmspropalex_update", [weight, grad, n, g_, delta],
+                   {**base, "gamma2": self.gamma2, "out": (weight, n, g_, delta)})
+        else:
+            (n,) = state
+            invoke("rmsprop_update", [weight, grad, n], {**base, "out": (weight, n)})
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        invoke("adagrad_update", [weight, grad, state],
+               {"lr": lr, "wd": wd, "epsilon": self.float_stable_eps,
+                "rescale_grad": self.rescale_grad, "clip_gradient": self.clip_gradient,
+                "out": (weight, state)})
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        _, wd = self._common(index)
+        acc_g, acc_d = state
+        invoke("adadelta_update", [weight, grad, acc_g, acc_d],
+               {"rho": self.rho, "epsilon": self.epsilon, "wd": wd,
+                "rescale_grad": self.rescale_grad, "clip_gradient": self.clip_gradient,
+                "out": (weight, acc_g, acc_d)})
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        z, n = state
+        invoke("ftrl_update", [weight, grad, z, n],
+               {"lr": lr, "lamda1": self.lamda1, "beta": self.beta, "wd": wd,
+                "rescale_grad": self.rescale_grad, "clip_gradient": self.clip_gradient,
+                "out": (weight, z, n)})
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return (z(), z(), z())
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        invoke("ftml_update", [weight, grad, d, v, z],
+               {"lr": lr, "beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "t": t, "wd": wd,
+                "rescale_grad": self.rescale_grad, "clip_grad": self.clip_gradient,
+                "out": (weight, d, v, z)})
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        if state is not None:
+            invoke("signum_update", [weight, grad, state],
+                   {"lr": lr, "wd": wd, "momentum": self.momentum, "wd_lh": self.wd_lh,
+                    "rescale_grad": self.rescale_grad,
+                    "clip_gradient": self.clip_gradient, "out": (weight, state)})
+        else:
+            invoke("signsgd_update", [weight, grad],
+                   {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                    "clip_gradient": self.clip_gradient, "out": weight})
+
+
+class Updater:
+    """Applies an optimizer to (index, grad, weight) triplets — the object the
+    reference serializes to KVStore servers (set_optimizer)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps({k: _states_np(v) for k, v in self.states.items()})
+
+    def set_states(self, states):
+        import pickle
+
+        from ..ndarray import array
+
+        loaded = pickle.loads(states)
+        self.states = {k: _states_nd(v) for k, v in loaded.items()}
+
+
+def _states_np(s):
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_states_np(x) for x in s)
+    return s.asnumpy()
+
+
+def _states_nd(s):
+    from ..ndarray import array
+
+    if s is None:
+        return None
+    if isinstance(s, tuple):
+        return tuple(_states_nd(x) for x in s)
+    return array(s)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)
