@@ -55,7 +55,7 @@ class NDArray:
             data = data.astype(dtype_np(dtype))
         if ctx is not None:
             dev = Context(ctx).jax_device() if not isinstance(ctx, Context) else ctx.jax_device()
-            if not _on_device(data, dev):
+            if not isinstance(data, jax.core.Tracer) and not _on_device(data, dev):
                 data = jax.device_put(data, dev)
             self._ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
         else:
@@ -105,6 +105,10 @@ class NDArray:
     def context(self) -> Context:
         if self._ctx is not None:
             return self._ctx
+        if isinstance(self._data, jax.core.Tracer):
+            # Under jit tracing there is no physical placement; report the
+            # current default context (placement is the compiler's job).
+            return current_context()
         dev = next(iter(self._data.devices()))
         if dev.platform == "cpu":
             return Context("cpu", dev.id)
@@ -140,7 +144,7 @@ class NDArray:
 
     def as_in_context(self, ctx) -> "NDArray":
         ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
-        if ctx == self.context:
+        if isinstance(self._data, jax.core.Tracer) or ctx == self.context:
             return self
         return NDArray(jax.device_put(self._data, ctx.jax_device()), ctx=ctx)
 

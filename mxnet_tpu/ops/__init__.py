@@ -15,4 +15,5 @@ from . import init_ops  # noqa: F401
 from . import ordering  # noqa: F401
 from . import nn  # noqa: F401
 from . import sequence  # noqa: F401
+from . import rnn  # noqa: F401
 from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,185 @@
+"""Fused RNN operator — LSTM / GRU / vanilla RNN over ``lax.scan``.
+
+Reference: the monolithic ``RNN`` op (``src/operator/rnn.cc`` /
+``rnn-inl.h``, cuDNN path ``cudnnRNNForwardTraining`` — TBV, SURVEY.md §2.2).
+It is the PTB / GluonNLP workhorse: multi-layer, bidirectional, with all
+weights packed into ONE flat parameter vector (cuDNN canonical layout:
+all i2h/h2h weight matrices for every layer+direction first, then all
+biases).
+
+TPU redesign: the recurrence is a ``lax.scan`` over the time axis — XLA
+compiles it to a single fused loop on-device (the analog of cuDNN's fused
+kernel). Layers are unrolled in the trace (num_layers is small and static),
+bidirectional runs a reversed scan, and inter-layer dropout folds into the
+same program. No dynamic shapes: (T, N, C) are all static under jit, which
+is what lets the MXU see one big batched matmul per gate per step.
+
+Gate orders follow the cuDNN convention the reference inherits:
+LSTM ``[i, f, g, o]``, GRU ``[r, z, n]`` (with the GRU candidate using a
+separately-biased recurrent term, the cuDNN "linear_before_reset" variant).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = ["rnn_param_size", "rnn_unpack_params"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers=1, bidirectional=False):
+    """Total packed parameter count (reference ``rnn_param_size`` analog)."""
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        size += dirs * g * state_size * (isz + state_size + 2)
+    return size
+
+
+def rnn_unpack_params(params, mode, input_size, state_size, num_layers, bidirectional):
+    """Split the flat vector into per-(layer, direction) weight/bias tuples.
+
+    Layout (cuDNN canonical, what the reference packs/unpacks):
+    for each layer, for each direction: W_i2h (G*H, in), W_h2h (G*H, H) —
+    all weights first; then, in the same order, b_i2h (G*H), b_h2h (G*H).
+    """
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    h = state_size
+    out = []
+    off = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else h * dirs
+        layer_parts = []
+        for _ in range(dirs):
+            w_ih = lax.dynamic_slice_in_dim(params, off, g * h * isz).reshape(g * h, isz)
+            off += g * h * isz
+            w_hh = lax.dynamic_slice_in_dim(params, off, g * h * h).reshape(g * h, h)
+            off += g * h * h
+            layer_parts.append([w_ih, w_hh])
+        out.append(layer_parts)
+    for layer in range(num_layers):
+        for d in range(dirs):
+            b_ih = lax.dynamic_slice_in_dim(params, off, g * h)
+            off += g * h
+            b_hh = lax.dynamic_slice_in_dim(params, off, g * h)
+            off += g * h
+            out[layer][d].extend([b_ih, b_hh])
+    return out  # [layer][direction] = (w_ih, w_hh, b_ih, b_hh)
+
+
+def _lstm_scan(xs, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse):
+    hsz = h0.shape[-1]
+    x_proj = jnp.einsum("tni,gi->tng", xs, w_ih) + b_ih  # hoist the input GEMM
+
+    def step(carry, xp):
+        h, c = carry
+        gates = xp + h @ w_hh.T + b_hh
+        i, f, g, o = (gates[:, k * hsz:(k + 1) * hsz] for k in range(4))
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), ys = lax.scan(step, (h0, c0), x_proj, reverse=reverse)
+    return ys, h, c
+
+
+def _gru_scan(xs, h0, w_ih, w_hh, b_ih, b_hh, reverse):
+    hsz = h0.shape[-1]
+    x_proj = jnp.einsum("tni,gi->tng", xs, w_ih) + b_ih
+
+    def step(h, xp):
+        h_proj = h @ w_hh.T + b_hh
+        xr, xz, xn = (xp[:, k * hsz:(k + 1) * hsz] for k in range(3))
+        hr, hz, hn = (h_proj[:, k * hsz:(k + 1) * hsz] for k in range(3))
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h = (1.0 - z) * n + z * h
+        return h, h
+
+    h, ys = lax.scan(step, h0, x_proj, reverse=reverse)
+    return ys, h
+
+
+def _vanilla_scan(xs, h0, w_ih, w_hh, b_ih, b_hh, act, reverse):
+    x_proj = jnp.einsum("tni,gi->tng", xs, w_ih) + b_ih
+
+    def step(h, xp):
+        h = act(xp + h @ w_hh.T + b_hh)
+        return h, h
+
+    h, ys = lax.scan(step, h0, x_proj, reverse=reverse)
+    return ys, h
+
+
+def _rnn_n_out(kwargs):
+    if not kwargs.get("state_outputs", False):
+        return 1
+    return 3 if kwargs.get("mode", "lstm") == "lstm" else 2
+
+
+@register("RNN", num_outputs=_rnn_n_out)
+def _rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
+         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+         projection_size=None, lstm_state_clip_min=None, lstm_state_clip_max=None,
+         sequence_length=None, use_sequence_length=False):
+    """data (T, N, C) sequence-major; state (L*dirs, N, H); parameters flat.
+
+    Returns output (T, N, H*dirs) [+ final h [+ final c for lstm] when
+    ``state_outputs``].
+    """
+    if projection_size:
+        raise NotImplementedError("RNN projection_size is not supported")
+    t, n, input_size = data.shape
+    dirs = 2 if bidirectional else 1
+    h = state_size
+    layers = rnn_unpack_params(parameters.astype(data.dtype), mode, input_size, h,
+                               num_layers, bidirectional)
+    act = jnp.tanh if mode != "rnn_relu" else jax.nn.relu
+
+    from .nn import _is_training
+
+    train = _is_training()
+    xs = data
+    h_finals, c_finals = [], []
+    for li, layer in enumerate(layers):
+        if p and train and li > 0:
+            from ..random import next_key
+
+            keep = jax.random.bernoulli(next_key(), 1.0 - p, xs.shape)
+            xs = jnp.where(keep, xs / (1.0 - p), 0.0).astype(xs.dtype)
+        dir_outs = []
+        for d, (w_ih, w_hh, b_ih, b_hh) in enumerate(layer):
+            h0 = state[li * dirs + d]
+            rev = d == 1
+            if mode == "lstm":
+                c0 = state_cell[li * dirs + d]
+                ys, hT, cT = _lstm_scan(xs, h0, c0, w_ih, w_hh, b_ih, b_hh, rev)
+                if lstm_state_clip_min is not None or lstm_state_clip_max is not None:
+                    cT = jnp.clip(cT, lstm_state_clip_min, lstm_state_clip_max)
+                c_finals.append(cT)
+            elif mode == "gru":
+                ys, hT = _gru_scan(xs, h0, w_ih, w_hh, b_ih, b_hh, rev)
+            else:
+                ys, hT = _vanilla_scan(xs, h0, w_ih, w_hh, b_ih, b_hh, act, rev)
+            dir_outs.append(ys)
+            h_finals.append(hT)
+        xs = dir_outs[0] if dirs == 1 else jnp.concatenate(dir_outs, axis=-1)
+
+    if use_sequence_length and sequence_length is not None:
+        mask = (jnp.arange(t)[:, None] < sequence_length[None, :].astype(jnp.int32))
+        xs = jnp.where(mask[:, :, None], xs, 0.0).astype(xs.dtype)
+
+    if not state_outputs:
+        return xs
+    h_out = jnp.stack(h_finals)
+    if mode == "lstm":
+        return xs, h_out, jnp.stack(c_finals)
+    return xs, h_out

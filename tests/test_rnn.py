@@ -1,0 +1,164 @@
+"""RNN op + gluon.rnn tests.
+
+Mirrors the reference's test strategy (SURVEY.md §4): numeric checks of the
+fused RNN op against a plain-numpy recurrence, and fused-layer vs unrolled-cell
+consistency (the reference cross-checks cuDNN RNN vs unfused cells the same
+way in test_operator/test_gluon_rnn).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import rnn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, h0, c0, w_ih, w_hh, b_ih, b_hh):
+    T, N, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    ys = []
+    for t in range(T):
+        g = x[t] @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+        i, f, gg, o = (g[:, k * H:(k + 1) * H] for k in range(4))
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(gg)
+        h = _sigmoid(o) * np.tanh(c)
+        ys.append(h.copy())
+    return np.stack(ys), h, c
+
+
+def test_rnn_op_lstm_matches_numpy():
+    T, N, I, H = 5, 3, 4, 6
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, N, I).astype(np.float32)
+    w_ih = rng.randn(4 * H, I).astype(np.float32) * 0.1
+    w_hh = rng.randn(4 * H, H).astype(np.float32) * 0.1
+    b_ih = rng.randn(4 * H).astype(np.float32) * 0.1
+    b_hh = rng.randn(4 * H).astype(np.float32) * 0.1
+    params = np.concatenate([w_ih.ravel(), w_hh.ravel(), b_ih, b_hh])
+    h0 = np.zeros((1, N, H), np.float32)
+    c0 = np.zeros((1, N, H), np.float32)
+
+    out, hT, cT = nd.RNN(nd.array(x), nd.array(params), nd.array(h0), nd.array(c0),
+                         state_size=H, num_layers=1, mode="lstm", state_outputs=True)
+    ref_y, ref_h, ref_c = _np_lstm(x, h0[0], c0[0], w_ih, w_hh, b_ih, b_hh)
+    np.testing.assert_allclose(out.asnumpy(), ref_y, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hT.asnumpy()[0], ref_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cT.asnumpy()[0], ref_c, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode,nstate", [("lstm", 2), ("gru", 1), ("rnn_tanh", 1)])
+def test_rnn_op_shapes_bidirectional(mode, nstate):
+    T, N, I, H, L = 4, 2, 5, 3, 2
+    x = nd.ones((T, N, I))
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    psize = rnn_param_size(mode, I, H, num_layers=L, bidirectional=True)
+    params = nd.ones((psize,)) * 0.01
+    states = [nd.zeros((L * 2, N, H)) for _ in range(nstate)]
+    out = nd.RNN(x, params, *states, state_size=H, num_layers=L, mode=mode,
+                 bidirectional=True, state_outputs=True)
+    assert out[0].shape == (T, N, 2 * H)
+    assert out[1].shape == (L * 2, N, H)
+
+
+@pytest.mark.parametrize("cls,cell_cls", [(rnn.LSTM, rnn.LSTMCell),
+                                          (rnn.GRU, rnn.GRUCell)])
+def test_fused_layer_matches_cell_unroll(cls, cell_cls):
+    T, N, I, H = 6, 2, 3, 4
+    layer = cls(H, input_size=I)
+    layer.initialize()
+    x = nd.array(np.random.RandomState(1).randn(T, N, I).astype(np.float32))
+    out = layer(x)
+
+    cell = cell_cls(H, input_size=I)
+    cell.initialize()
+    # copy fused-layer weights into the cell (same gate layout)
+    lp = {k.split("_", 1)[1]: v for k, v in layer.collect_params().items()}
+    cp = cell.collect_params()
+    for k, v in cp.items():
+        suffix = k.split("_", 1)[1]  # i2h_weight etc
+        v.set_data(lp["l0_" + suffix].data())
+    steps, states = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(), steps.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_layout_ntc_and_states():
+    N, T, I, H = 3, 5, 4, 6
+    layer = rnn.LSTM(H, num_layers=2, layout="NTC", input_size=I)
+    layer.initialize()
+    x = nd.ones((N, T, I))
+    states = layer.begin_state(batch_size=N)
+    out, new_states = layer(x, states)
+    assert out.shape == (N, T, H)
+    assert new_states[0].shape == (2, N, H)
+    assert new_states[1].shape == (2, N, H)
+
+
+def test_lstm_hybridize_consistency():
+    T, N, I, H = 4, 2, 3, 5
+    layer = rnn.LSTM(H, num_layers=2, input_size=I)
+    layer.initialize()
+    x = nd.array(np.random.RandomState(2).randn(T, N, I).astype(np.float32))
+    eager = layer(x)
+    layer.hybridize()
+    hyb = layer(x)
+    hyb2 = layer(x)
+    np.testing.assert_allclose(eager.asnumpy(), hyb.asnumpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hyb.asnumpy(), hyb2.asnumpy(), rtol=1e-6, atol=1e-7)
+
+
+def test_lstm_backward_grads():
+    T, N, I, H = 4, 2, 3, 5
+    layer = rnn.LSTM(H, input_size=I)
+    layer.initialize()
+    x = nd.ones((T, N, I))
+    with mx.autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    for name, p in layer.collect_params().items():
+        g = p.grad()
+        assert g.shape == p.shape
+        assert np.isfinite(g.asnumpy()).all()
+    # gradients reach the first-layer input weights
+    gw = dict(layer.collect_params().items())
+    any_nonzero = any(np.abs(p.grad().asnumpy()).sum() > 0
+                      for p in layer.collect_params().values())
+    assert any_nonzero
+
+
+def test_sequential_cell_stack():
+    T, N, I, H = 3, 2, 4, 4
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, input_size=I))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.add(rnn.GRUCell(H, input_size=H))
+    stack.initialize()
+    x = nd.ones((N, T, I))
+    outs, states = stack.unroll(T, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (N, T, H)
+
+
+def test_residual_cell():
+    T, N, H = 3, 2, 4
+    cell = rnn.ResidualCell(rnn.GRUCell(H, input_size=H))
+    cell.initialize()
+    x = nd.ones((N, T, H))
+    outs, _ = cell.unroll(T, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (N, T, H)
+
+
+def test_bidirectional_cell():
+    T, N, I, H = 4, 2, 3, 5
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(H, input_size=I),
+                                 rnn.LSTMCell(H, input_size=I))
+    cell.initialize()
+    x = nd.ones((N, T, I))
+    outs, states = cell.unroll(T, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (N, T, 2 * H)
+    assert len(states) == 4
