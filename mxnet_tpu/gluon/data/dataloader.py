@@ -1,0 +1,95 @@
+"""DataLoader — batched, shuffled, prefetching loader.
+
+Reference: ``python/mxnet/gluon/data/dataloader.py`` (multiprocessing workers
+feeding a shared-memory queue — TBV SURVEY.md §2.3).
+
+TPU redesign: the reference forks worker *processes* because CPython + CUDA
+pinned-memory copies benefit from process isolation. Here workers are a
+thread pool with a bounded prefetch window: decode/augment is numpy/PIL work
+that releases the GIL, host→device transfer is async under PJRT, and forking
+after the JAX runtime initializes is unsafe. The observable API (num_workers,
+batchify_fn, last_batch, pin_memory) is kept; ``num_workers=0`` is fully
+synchronous like the reference.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+
+import numpy as np
+
+from ...ndarray import NDArray, array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        from ... import ndarray as nd
+
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    if arr.dtype == np.int64:
+        arr = arr.astype(np.int32)
+    return nd_array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None, thread_pool=False,
+                 timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size is required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with an explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError("batch_sampler is mutually exclusive with "
+                             "batch_size/shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, int(num_workers))
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def _make_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+
+        with _futures.ThreadPoolExecutor(self._num_workers) as pool:
+            pending = []
+            it = iter(self._batch_sampler)
+            try:
+                for _ in range(self._prefetch):
+                    pending.append(pool.submit(self._make_batch, next(it)))
+            except StopIteration:
+                pass
+            while pending:
+                fut = pending.pop(0)
+                try:
+                    pending.append(pool.submit(self._make_batch, next(it)))
+                except StopIteration:
+                    pass
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

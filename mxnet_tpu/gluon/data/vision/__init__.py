@@ -1,0 +1,4 @@
+"""Vision datasets + transforms (reference python/mxnet/gluon/data/vision/)."""
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,  # noqa: F401
+                       ImageFolderDataset, ImageRecordDataset, SyntheticImageDataset)
+from . import transforms  # noqa: F401

@@ -1,0 +1,100 @@
+"""gluon.data tests (reference tests/python/unittest/test_gluon_data.py analog)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import SyntheticImageDataset, transforms
+
+
+def test_array_dataset_and_loader():
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.int32)
+    ds = gdata.ArrayDataset(x, y)
+    assert len(ds) == 10
+    loader = gdata.DataLoader(ds, batch_size=3, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (3, 4)
+    assert batches[-1][0].shape == (1, 4)
+    np.testing.assert_allclose(batches[0][0].asnumpy(), x[:3])
+
+
+def test_loader_discard_rollover():
+    ds = gdata.ArrayDataset(np.arange(10, dtype=np.float32))
+    assert len(list(gdata.DataLoader(ds, batch_size=3, last_batch="discard"))) == 3
+    loader = gdata.DataLoader(ds, batch_size=3, last_batch="rollover")
+    assert len(list(loader)) == 3          # 1 sample rolls over
+    assert len(list(loader)) == 3          # 1+10 = 11 -> 3 batches, 2 roll
+
+
+def test_loader_shuffle_covers_all():
+    ds = gdata.ArrayDataset(np.arange(20, dtype=np.float32))
+    seen = np.concatenate([b.asnumpy() for b in
+                           gdata.DataLoader(ds, batch_size=4, shuffle=True)])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_loader_threaded_workers():
+    ds = SyntheticImageDataset(length=17, shape=(1, 8, 8), num_classes=4)
+    loader = gdata.DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(loader)
+    assert sum(b[0].shape[0] for b in batches) == 17
+    # determinism of the synthetic data itself
+    a0 = ds[3][0].asnumpy()
+    a1 = ds[3][0].asnumpy()
+    np.testing.assert_array_equal(a0, a1)
+
+
+def test_dataset_shard_and_take():
+    ds = gdata.ArrayDataset(np.arange(10, dtype=np.float32))
+    shards = [ds.shard(3, i) for i in range(3)]
+    assert [len(s) for s in shards] == [4, 3, 3]
+    all_vals = sorted(float(s[i]) for s in shards for i in range(len(s)))
+    assert all_vals == list(range(10))
+    assert len(ds.take(4)) == 4
+
+
+def test_transform_first_and_sampler():
+    x = np.ones((6, 2, 2, 1), np.uint8) * 255
+    y = np.arange(6, dtype=np.int32)
+    ds = gdata.ArrayDataset(x, y).transform_first(transforms.ToTensor())
+    img, label = ds[2]
+    assert img.shape == (1, 2, 2)
+    np.testing.assert_allclose(img.asnumpy(), 1.0)
+    assert label == 2
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(16),
+        transforms.CenterCrop(12),
+        transforms.RandomFlipLeftRight(),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.25, 0.25, 0.25)),
+    ])
+    img = nd.array(np.random.randint(0, 255, (20, 24, 3)).astype(np.uint8))
+    out = t(img)
+    assert out.shape == (3, 12, 12)
+    assert out.dtype == np.float32
+
+
+def test_random_resized_crop_and_jitter():
+    img = nd.array(np.random.randint(0, 255, (32, 32, 3)).astype(np.uint8))
+    out = transforms.RandomResizedCrop(16)(img)
+    assert out.shape == (16, 16, 3)
+    out = transforms.RandomColorJitter(0.4, 0.4, 0.4)(img)
+    assert out.shape == (32, 32, 3)
+
+
+def test_batch_sampler_api():
+    s = gdata.BatchSampler(gdata.SequentialSampler(7), 2, "discard")
+    assert len(s) == 3
+    assert list(s) == [[0, 1], [2, 3], [4, 5]]
+
+
+def test_filter_dataset():
+    ds = gdata.ArrayDataset(np.arange(10, dtype=np.float32))
+    even = ds.filter(lambda x: int(x) % 2 == 0)
+    assert len(even) == 5
