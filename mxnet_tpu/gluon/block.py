@@ -164,17 +164,34 @@ class Block:
             c._cast_hook(dtype)
 
     # -- persistence ------------------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        """Structural (attribute-path) parameter names, e.g. ``features.0.weight``
+        — instance-independent, the format reference save_parameters uses
+        (python/mxnet/gluon/block.py — TBV), unlike prefix names which embed
+        a global construction counter."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + attr: p for attr, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
     def save_parameters(self, filename, deduplicate=False):
         from ..ndarray import save as nd_save
 
-        nd_save(filename, {p.name: p.data() for p in self._iter_params()})
+        params = self._collect_params_with_prefix()
+        nd_save(filename, {k: p.data() for k, p in params.items()
+                           if p._data is not None})
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):
         from ..ndarray import load as nd_load
 
         loaded = nd_load(filename)
-        mine = {p.name: p for p in self._iter_params()}
+        mine = self._collect_params_with_prefix()
+        if loaded and mine and not any(k in mine for k in loaded):
+            # fall back to prefix-name matching (older save format)
+            mine = {p.name: p for p in self._iter_params()}
         for name, param in mine.items():
             if name in loaded:
                 if param._data is None:

@@ -65,6 +65,8 @@ def _activation(data, act_type="relu"):
         return jax.nn.gelu(data, approximate=False)
     if act_type == "silu" or act_type == "swish":
         return data * jax.nn.sigmoid(data)
+    if act_type == "relu6":
+        return jnp.clip(data, 0, 6)
     raise ValueError(f"unknown act_type {act_type!r}")
 
 

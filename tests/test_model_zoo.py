@@ -1,0 +1,80 @@
+"""Model zoo forward/hybridize/train-step tests (reference test_gluon_model_zoo
+analog — small inputs, thumbnail variants where supported to keep CI fast)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import get_model, vision
+
+
+@pytest.mark.parametrize("name", ["resnet18_v1", "resnet18_v2"])
+def test_resnet_thumbnail_train_step(name):
+    net = get_model(name, classes=10, thumbnail=True)
+    net.initialize()
+    x = nd.ones((2, 3, 32, 32))
+    out = net(x)
+    assert out.shape == (2, 10)
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    y = nd.array(np.array([1, 2], np.int32))
+    with mx.autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(2)
+    assert np.isfinite(loss.asnumpy()).all()
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet50_v1", 64), ("resnet50_v2", 64),
+    ("mobilenet0.25", 64), ("mobilenetv2_0.25", 64),
+    ("squeezenet1.1", 64),
+])
+def test_zoo_forward_shapes(name, size):
+    net = get_model(name, classes=7)
+    net.initialize()
+    out = net(nd.ones((1, 3, size, size)))
+    assert out.shape == (1, 7)
+
+
+def test_vgg_and_alexnet_small():
+    net = vision.vgg11(classes=5)
+    net.initialize()
+    assert net(nd.ones((1, 3, 64, 64))).shape == (1, 5)
+    net = vision.alexnet(classes=5)
+    net.initialize()
+    assert net(nd.ones((1, 3, 224, 224))).shape == (1, 5)
+
+
+def test_densenet_small():
+    net = vision.densenet121(classes=4)
+    net.initialize()
+    assert net(nd.ones((1, 3, 64, 64))).shape == (1, 4)
+
+
+def test_resnet_hybridize_consistency():
+    net = get_model("resnet18_v1", classes=10, thumbnail=True)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hyb = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hyb, rtol=1e-4, atol=1e-4)
+
+
+def test_get_model_unknown():
+    with pytest.raises(ValueError):
+        get_model("resnet_1202")
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = get_model("mobilenet0.25", classes=3)
+    net.initialize()
+    x = nd.ones((1, 3, 32, 32))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "m.params")
+    net.save_parameters(f)
+    net2 = get_model("mobilenet0.25", classes=3)
+    net2.load_parameters(f)
+    np.testing.assert_allclose(ref, net2(x).asnumpy(), rtol=1e-5, atol=1e-6)
