@@ -1,0 +1,79 @@
+"""Headline benchmark: ResNet-50 v1 fp32 training throughput (images/sec) on
+one chip, vs the reference's published per-GPU number.
+
+Baseline denominator: ~385 img/s/GPU — midpoint of the recalled 360–400
+img/s/V100 fp32 range (BASELINE.md, LOW CONFIDENCE / TBV; the reference
+mount was empty this round). The whole training step (fwd+bwd+SGD update)
+runs as ONE donated XLA program via parallel.ShardedTrainer on a 1-device
+mesh — the same code path that scales to dp×tp×sp meshes.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC_PER_GPU = 385.0
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    platform = jax.devices()[0].platform
+    # CPU fallback keeps the bench runnable in CI; real numbers come from TPU.
+    batch = int(os.environ.get("BENCH_BATCH", 64 if platform == "tpu" else 8))
+    size = int(os.environ.get("BENCH_IMAGE_SIZE", 224 if platform == "tpu" else 64))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if platform == "tpu" else 3))
+    warmup = int(os.environ.get("BENCH_WARMUP", 5 if platform == "tpu" else 1))
+
+    mx.random.seed(0)
+    net = get_model("resnet50_v1", classes=1000)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch, 3, size, size).astype(np.float32))
+    y = nd.array(rng.randint(0, 1000, batch).astype(np.int32))
+    net(x)  # resolve deferred shapes
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = par.ShardedTrainer(
+        net, loss_fn, mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+
+    last = None
+    for _ in range(warmup):
+        last = trainer.step(x, y)
+    # a host VALUE fetch is the only reliable sync through the axon tunnel
+    # (block_until_ready does not block there)
+    float(last.asnumpy())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        last = trainer.step(x, y)
+    final_loss = float(last.asnumpy())  # forces the whole donated chain
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": f"resnet50_v1 fp32 train throughput (batch={batch}, "
+                  f"{size}x{size}, 1 {platform} chip)",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / BASELINE_IMG_PER_SEC_PER_GPU, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

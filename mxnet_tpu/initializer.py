@@ -11,6 +11,8 @@ import re
 
 import numpy as np
 
+from .random import np_rng
+
 from .ndarray import NDArray, array
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal", "Orthogonal",
@@ -96,7 +98,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, name, arr):
-        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape).astype(arr.dtype)
+        arr[:] = np_rng().uniform(-self.scale, self.scale, arr.shape).astype(arr.dtype)
 
 
 @register
@@ -105,7 +107,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, name, arr):
-        arr[:] = np.random.normal(0, self.sigma, arr.shape).astype(arr.dtype)
+        arr[:] = np_rng().normal(0, self.sigma, arr.shape).astype(arr.dtype)
 
 
 @register
@@ -118,9 +120,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1, 1, (nout, nin))
+            tmp = np_rng().uniform(-1, 1, (nout, nin))
         else:
-            tmp = np.random.normal(0, 1, (nout, nin))
+            tmp = np_rng().normal(0, 1, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = (self.scale * q.reshape(arr.shape)).astype(arr.dtype)
@@ -145,9 +147,9 @@ class Xavier(Initializer):
             factor = fan_out
         scale = math.sqrt(self.magnitude / max(factor, 1))
         if self.rnd_type == "uniform":
-            arr[:] = np.random.uniform(-scale, scale, shape).astype(arr.dtype)
+            arr[:] = np_rng().uniform(-scale, scale, shape).astype(arr.dtype)
         else:
-            arr[:] = np.random.normal(0, scale, shape).astype(arr.dtype)
+            arr[:] = np_rng().normal(0, scale, shape).astype(arr.dtype)
 
 
 @register

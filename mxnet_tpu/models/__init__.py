@@ -1,0 +1,11 @@
+"""Flagship model families built on gluon + parallel.
+
+The reference's transformer/BERT workloads live in external repos
+(gluon-nlp — SURVEY.md §2.5) but drive its headline benchmarks, so the
+model family is first-class here: mesh-shardable transformer encoder/LM
+with tensor-parallel rules and sequence-parallel (ring) attention.
+"""
+from .transformer import (MultiHeadAttention, PositionwiseFFN,  # noqa: F401
+                          TransformerEncoderCell, BERTEncoder, BERTModel,
+                          TransformerLM, bert_base, bert_large, bert_tiny,
+                          transformer_lm, bert_sharding_rules)

@@ -1,0 +1,250 @@
+"""Transformer encoder / BERT / decoder-LM — the flagship family.
+
+Reference counterpart: gluon-nlp's BERTModel/TransformerEncoder (external
+repo, driven through the mx API — SURVEY.md §2.5 BERT-base config). Built
+TPU-first:
+
+- One fused QKV projection (one MXU matmul instead of three).
+- bf16-friendly: params stay fp32; cast policy applied by AMP/trainer.
+- Tensor parallel: ``bert_sharding_rules()`` shards QKV/FFN-in over the
+  mesh ``tp`` axis on the output dim and out-proj/FFN-out on the input
+  dim (Megatron layout: one all-reduce per block, inserted by XLA).
+- Sequence parallel: when the active mesh (parallel.mesh_scope) has an
+  ``sp`` axis > 1, attention runs as ring attention over the ICI
+  (parallel/ring_attention.py) — long-context support the reference lacks.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+           "BERTEncoder", "BERTModel", "TransformerLM", "bert_base", "bert_large",
+           "bert_tiny", "transformer_lm", "bert_sharding_rules"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Fused-QKV multi-head self-attention with optional ring execution."""
+
+    def __init__(self, units, num_heads, dropout=0.0, causal=False, **kwargs):
+        super().__init__(**kwargs)
+        assert units % num_heads == 0
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        self.qkv = nn.Dense(3 * units, flatten=False, use_bias=True,
+                            in_units=units, prefix=self.prefix + "qkv_")
+        self.proj = nn.Dense(units, flatten=False, use_bias=True, in_units=units,
+                             prefix=self.prefix + "proj_")
+        self._dropout = dropout
+
+    def hybrid_forward(self, F, x, mask=None):
+        # x: (B, S, U)
+        b, s, u = x.shape
+        h, d = self._heads, self._units // self._heads
+        qkv = self.qkv(x)  # (B, S, 3U)
+        qkv = qkv.reshape((b, s, 3, h, d)).transpose((2, 0, 3, 1, 4))  # (3,B,H,S,D)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+
+        from .. import parallel as par
+        from ..ndarray.ndarray import invoke_fn
+
+        mesh = par.current_mesh()
+        sp = 1
+        if mesh is not None:
+            sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp", 1)
+
+        from ..parallel.ring_attention import plain_attention
+
+        if mesh is not None and sp > 1:
+            out = invoke_fn(
+                lambda qq, kk, vv: par.sequence_sharded_attention(
+                    qq, kk, vv, mesh, causal=self._causal),
+                [q, k, v])
+        else:
+            def attn(qq, kk, vv, mm=None):
+                return plain_attention(qq, kk, vv, mask=mm, causal=self._causal)
+
+            ins = [q, k, v] + ([mask] if mask is not None else [])
+            out = invoke_fn(attn, ins)
+        out = out.transpose((0, 2, 1, 3)).reshape((b, s, u))
+        out = self.proj(out)
+        if self._dropout:
+            out = F.Dropout(out, p=self._dropout)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        self.ffn_1 = nn.Dense(hidden_size, flatten=False, in_units=units,
+                              prefix=self.prefix + "ffn1_")
+        self.ffn_2 = nn.Dense(units, flatten=False, in_units=hidden_size,
+                              prefix=self.prefix + "ffn2_")
+        self._act = activation
+        self._dropout = dropout
+
+    def hybrid_forward(self, F, x):
+        out = self.ffn_1(x)
+        out = F.Activation(out, act_type=self._act) if self._act != "gelu" \
+            else F.gelu(out, approximation="tanh")
+        out = self.ffn_2(out)
+        if self._dropout:
+            out = F.Dropout(out, p=self._dropout)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN transformer block (BERT layout)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, causal=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.attention = MultiHeadAttention(units, num_heads, dropout=dropout,
+                                            causal=causal,
+                                            prefix=self.prefix + "attn_")
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                   prefix=self.prefix + "ffn_")
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self._dropout = dropout
+
+    def hybrid_forward(self, F, x, mask=None):
+        att = self.attention(x, mask)
+        x = self.ln1(x + att)
+        out = self.ffn(x)
+        return self.ln2(x + out)
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, units=768, hidden_size=3072, num_layers=12, num_heads=12,
+                 max_length=512, dropout=0.1, causal=False, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._max_length = max_length
+        self.position_weight = self.params.get(
+            "position_weight", shape=(max_length, units), init="zeros")
+        self.cells = []
+        for i in range(num_layers):
+            cell = TransformerEncoderCell(units, hidden_size, num_heads,
+                                          dropout=dropout, causal=causal,
+                                          prefix=f"{self.prefix}layer{i}_")
+            self.register_child(cell, f"layer{i}")
+            self.cells.append(cell)
+        self._dropout = dropout
+
+    def hybrid_forward(self, F, x, position_weight, mask=None):
+        b, s, u = x.shape
+        pos = position_weight[:s].reshape((1, s, u))
+        x = x + pos
+        if self._dropout:
+            x = F.Dropout(x, p=self._dropout)
+        for cell in self.cells:
+            x = cell(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """BERT with MLM head (gluon-nlp BERTModel counterpart)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512, dropout=0.1,
+                 num_token_types=2, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self.word_embed = nn.Embedding(vocab_size, units,
+                                       prefix=self.prefix + "word_embed_")
+        self.token_type_embed = nn.Embedding(num_token_types, units,
+                                             prefix=self.prefix + "type_embed_")
+        self.embed_ln = nn.LayerNorm(in_channels=units)
+        self.encoder = BERTEncoder(units, hidden_size, num_layers, num_heads,
+                                   max_length, dropout,
+                                   prefix=self.prefix + "enc_")
+        self.mlm_dense = nn.Dense(units, flatten=False, in_units=units,
+                                  prefix=self.prefix + "mlm_dense_")
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_decoder = nn.Dense(vocab_size, flatten=False, in_units=units,
+                                    prefix=self.prefix + "mlm_decoder_")
+
+    def hybrid_forward(self, F, inputs, token_types=None):
+        x = self.word_embed(inputs)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        x = self.embed_ln(x)
+        seq = self.encoder(x)
+        h = self.mlm_dense(seq)
+        h = F.gelu(h, approximation="tanh")
+        h = self.mlm_ln(h)
+        return self.mlm_decoder(h)
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only causal LM (GPT-style) — the long-context flagship."""
+
+    def __init__(self, vocab_size=32000, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=2048, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.word_embed = nn.Embedding(vocab_size, units,
+                                       prefix=self.prefix + "word_embed_")
+        self.encoder = BERTEncoder(units, hidden_size, num_layers, num_heads,
+                                   max_length, dropout, causal=True,
+                                   prefix=self.prefix + "enc_")
+        self.final_ln = nn.LayerNorm(in_channels=units)
+        self.decoder = nn.Dense(vocab_size, flatten=False, in_units=units,
+                                prefix=self.prefix + "decoder_")
+
+    def hybrid_forward(self, F, inputs):
+        x = self.word_embed(inputs)
+        x = self.encoder(x)
+        x = self.final_ln(x)
+        return self.decoder(x)
+
+
+def bert_sharding_rules():
+    """Megatron-style TP + dp-replicated rules for BERT/TransformerLM params.
+
+    Works with parallel.ShardingRules spec pruning: on meshes without "tp"
+    everything collapses to replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import ShardingRules
+
+    return ShardingRules([
+        (r"qkv_weight$", P("tp", None)),        # column parallel
+        (r"ffn1_weight$", P("tp", None)),
+        (r"qkv_bias$", P("tp")),
+        (r"ffn1_bias$", P("tp")),
+        (r"proj_weight$", P(None, "tp")),       # row parallel
+        (r"ffn2_weight$", P(None, "tp")),
+        (r"(word_embed|mlm_decoder|decoder)\d*_weight$", P("tp", None)),
+    ], default=P())
+
+
+def bert_tiny(vocab_size=1000, **kw):
+    kw.setdefault("units", 64)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_length", 128)
+    return BERTModel(vocab_size=vocab_size, **kw)
+
+
+def bert_base(vocab_size=30522, **kw):
+    return BERTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
+                     num_layers=12, num_heads=12, **kw)
+
+
+def bert_large(vocab_size=30522, **kw):
+    return BERTModel(vocab_size=vocab_size, units=1024, hidden_size=4096,
+                     num_layers=24, num_heads=16, **kw)
+
+
+def transformer_lm(vocab_size=32000, **kw):
+    return TransformerLM(vocab_size=vocab_size, **kw)

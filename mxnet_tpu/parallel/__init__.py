@@ -1,0 +1,26 @@
+"""Distributed / parallel execution — the TPU-native replacement for the
+reference's KVStore+NCCL+ps-lite stack (SURVEY.md §2.4, §5.8).
+
+The reference scales by data parallelism in five flavors (local/device/
+nccl/dist_sync/dist_async), all implemented as explicit gradient
+communication around an eager training loop. On TPU the whole training
+step — forward, backward, gradient all-reduce, optimizer — is ONE jitted
+XLA program over a ``jax.sharding.Mesh``; XLA inserts the ICI collectives
+from sharding annotations. This package provides:
+
+- :mod:`mesh` — mesh construction over dp/tp/pp/sp axes (ICI-major order).
+- :mod:`sharding` — regex rules mapping parameter names to PartitionSpecs.
+- :mod:`functional` — lift a gluon Block into a pure ``apply(params, *in)``.
+- :mod:`train_step` — :class:`ShardedTrainer`: the fused sharded train step
+  (dp grad reduction + tp param sharding + optional bf16 compute).
+- :mod:`ring_attention` — sequence-parallel blockwise attention over the
+  mesh's ``sp`` axis via ``shard_map`` + ``ppermute`` (a capability the
+  reference lacks — SURVEY.md §5.7).
+"""
+from .mesh import (make_mesh, mesh_axes, local_device_count, mesh_scope,  # noqa: F401
+                   current_mesh)
+from .sharding import (ShardingRules, param_sharding, batch_sharding,  # noqa: F401
+                       replicated)
+from .functional import functionalize  # noqa: F401
+from .train_step import ShardedTrainer  # noqa: F401
+from .ring_attention import ring_attention, sequence_sharded_attention  # noqa: F401

@@ -1,0 +1,63 @@
+"""Lift a gluon Block into a pure function over a parameter dict.
+
+This is the bridge between the mutable gluon API and pjit: the same
+rebinding trick CachedOp uses (gluon/block.py), exposed standalone so the
+sharded train step can ``jax.value_and_grad`` through any Block.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+import jax
+
+from .. import autograd
+from ..ndarray import NDArray
+
+__all__ = ["functionalize"]
+
+
+def functionalize(net, train: bool = True) -> Tuple[List[str], Callable]:
+    """Return ``(param_names, apply)`` where
+    ``apply(param_vals, *inputs) -> (outputs, aux_updates)``.
+
+    - ``param_vals``: dict name → jax.Array (or tracer).
+    - ``outputs``: jax value or tuple of them.
+    - ``aux_updates``: dict name → new value for parameters the forward
+      mutated in place (BatchNorm moving stats); merge these back after the
+      step. The dict's key set is trace-stable for a fixed train mode.
+
+    ``apply`` is pure/traceable: parameters are swapped in by name, the
+    forward runs over tracers, and the original buffers are restored.
+    """
+    params = [p for p in net._iter_params() if p._data is not None]
+    names = [p.name for p in params]
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate parameter names; cannot functionalize")
+
+    def apply(param_vals: Dict[str, jax.Array], *inputs):
+        nds = [p.data() for p in params]
+        saved = [nd_._data for nd_ in nds]
+        injected = [param_vals[n] for n in names]
+        try:
+            for nd_, val in zip(nds, injected):
+                nd_._data = val
+            in_nds = [NDArray(x) if not isinstance(x, NDArray) else x for x in inputs]
+            old_rec = autograd.set_recording(False)
+            old_train = autograd.set_training(train)
+            try:
+                out = net(*in_nds)
+            finally:
+                autograd.set_recording(old_rec)
+                autograd.set_training(old_train)
+            aux = {}
+            for nd_, name, inj in zip(nds, names, injected):
+                if nd_._data is not inj:
+                    aux[name] = nd_._data
+            if isinstance(out, (list, tuple)):
+                return tuple(o._data for o in out), aux
+            return out._data, aux
+        finally:
+            for nd_, s in zip([p.data() for p in params], saved):
+                nd_._data = s
+
+    return names, apply

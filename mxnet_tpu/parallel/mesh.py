@@ -1,0 +1,91 @@
+"""Device-mesh construction.
+
+Replaces the reference's device-list plumbing (``ctx=[mx.gpu(i) ...]`` +
+KVStore comm trees — SURVEY.md §2.4) with ``jax.sharding.Mesh``. Axis
+conventions follow the scaling-book recipe: the innermost (fastest-varying)
+mesh axes carry the heaviest collectives, so order axes ("pp", "dp", "sp",
+"tp") — tp innermost rides the tightest ICI loops.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "mesh_axes", "local_device_count", "mesh_scope",
+           "current_mesh"]
+
+AXIS_ORDER = ("pp", "dp", "sp", "tp", "ep")
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
+    """Build a Mesh from an axis-size dict, e.g. ``{"dp": 2, "tp": 4}``.
+
+    A single ``-1`` axis absorbs the remaining devices. Axes are laid out in
+    AXIS_ORDER with tp innermost (contiguous devices → shortest ICI paths).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {"dp": n})
+    known = 1
+    wild = None
+    for k, v in axes.items():
+        if v == -1:
+            if wild is not None:
+                raise ValueError("only one axis may be -1")
+            wild = k
+        else:
+            known *= v
+    if wild is not None:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        axes[wild] = n // known
+        known *= axes[wild]
+    if known != n:
+        raise ValueError(f"mesh axes {axes} need {known} devices, have {n}")
+    names = [a for a in AXIS_ORDER if a in axes] + \
+            [a for a in axes if a not in AXIS_ORDER]
+    shape = tuple(axes[a] for a in names)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(names))
+
+
+def mesh_axes(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+# ---------------------------------------------------------------------------
+# Mesh scope: lets model code (e.g. attention layers) discover the active
+# mesh during a sharded trace and pick collective implementations (ring
+# attention over "sp") without threading the mesh through every call.
+# ---------------------------------------------------------------------------
+
+import contextlib as _contextlib
+import threading as _threading
+
+
+class _MeshState(_threading.local):
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+
+
+_STATE = _MeshState()
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _STATE.mesh
+
+
+@_contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    prev, _STATE.mesh = _STATE.mesh, mesh
+    try:
+        yield mesh
+    finally:
+        _STATE.mesh = prev

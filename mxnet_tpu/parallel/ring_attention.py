@@ -1,0 +1,127 @@
+"""Ring attention — sequence/context parallelism over the mesh ``sp`` axis.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7); its max context
+is bounded by one GPU's memory. This module removes that bound the TPU way:
+Q stays resident per shard while K/V blocks rotate around the ring via
+``lax.ppermute`` (neighbor exchanges ride the ICI torus), accumulating
+online-softmax statistics — blockwise attention with O(seq/n_shards) live
+memory per chip. Pattern follows the public ring-attention formulation
+(Liu et al.) and the jax shard_map collective idiom.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["ring_attention", "sequence_sharded_attention", "plain_attention"]
+
+
+def plain_attention(q, k, v, mask=None, causal=False, scale=None):
+    """Single-device reference attention. q,k,v: (B, H, S, D)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def _ring_body(q, k, v, axis_name, causal, scale):
+    """Per-shard ring loop. q,k,v are the LOCAL blocks (B, H, s_loc, D)."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    s_loc = q.shape[-2]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+
+    def scores_for(k_blk, src_idx):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        if causal:
+            # global positions: rows my_idx*s_loc + i, cols src_idx*s_loc + j
+            rows = my_idx * s_loc + jnp.arange(s_loc)[:, None]
+            cols = src_idx * s_loc + jnp.arange(s_loc)[None, :]
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        return s
+
+    def step(carry, _):
+        k_blk, v_blk, src_idx, m, num, den = carry
+        s = scores_for(k_blk, src_idx)
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        # guard -inf rows (fully masked block): exp(-inf - -inf) -> exp(0)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - new_m))
+        p = jnp.exp(s - new_m)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        num = num * corr + jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype),
+                                      v_blk).astype(jnp.float32)
+        den = den * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # rotate k/v to the next rank on the ring (neighbor ICI hop)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        src_next = (src_idx - 1) % n
+        return (k_next, v_next, src_next, new_m, num, den), None
+
+    b, h, _, d = q.shape
+    m0 = jnp.full((b, h, s_loc, 1), -jnp.inf, jnp.float32)
+    num0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    den0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    # mark device-invariant carry inits as varying over the ring axis (the
+    # loop makes them device-dependent; required by shard_map's vma check)
+    def _vary(x):
+        # target: the same varying axes as the data (q is sharded over every
+        # mesh axis in play, so its vma is the loop-carry's steady state)
+        try:
+            target = set(jax.typeof(q).vma) | {axis_name}
+            missing = tuple(sorted(target - set(jax.typeof(x).vma)))
+        except (AttributeError, TypeError):
+            return x
+        if not missing:
+            return x
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, missing, to="varying")
+        return lax.pvary(x, missing)
+
+    my_idx, m0, num0, den0 = (_vary(x) for x in (my_idx, m0, num0, den0))
+    (k_f, v_f, _, m, num, den), _ = lax.scan(
+        step, (k, v, my_idx, m0, num0, den0), None, length=n)
+    out = num / jnp.maximum(den, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Call INSIDE shard_map with q,k,v sequence-sharded over ``axis_name``."""
+    return _ring_body(q, k, v, axis_name, causal, scale)
+
+
+def sequence_sharded_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                               causal: bool = False, scale=None,
+                               batch_axis: str = "dp", head_axis: str = "tp"):
+    """Global-view attention sharded (B over dp, H over tp, S over sp).
+
+    q,k,v: (B, H, S, D) global arrays (or tracers under an enclosing pjit).
+    Returns same-shaped output. Uses shard_map + ring rotation; degenerate
+    1-shard meshes reduce to plain attention.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if sizes.get(axis_name, 1) == 1:
+        return plain_attention(q, k, v, causal=causal, scale=scale)
+    b_ax = batch_axis if sizes.get(batch_axis, 1) > 1 else None
+    h_ax = head_axis if sizes.get(head_axis, 1) > 1 else None
+    spec = P(b_ax, h_ax, axis_name, None)
+    fn = shard_map(partial(_ring_body, axis_name=axis_name, causal=causal,
+                           scale=scale),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

@@ -1,0 +1,83 @@
+"""Sharding rules: parameter-name regexes → PartitionSpecs.
+
+The reference has no tensor-parallel sharding (SURVEY.md §2.4 — TP absent);
+its only placement mechanism is whole-array device assignment
+(``__ctx_group__``). Here placement is declarative: a rule table maps
+parameter names to ``PartitionSpec`` axes over the mesh, XLA inserts the
+collectives (the scaling-book recipe).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "param_sharding", "batch_sharding", "replicated"]
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) table; first match wins.
+
+    Specs may name mesh axes absent from the actual mesh — those collapse to
+    None (replicated), so one rule table serves dp-only, dp×tp, dp×tp×sp …
+    meshes unchanged.
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, P]]] = None,
+                 default: P = P()):
+        self.rules: List[Tuple[re.Pattern, P]] = [
+            (re.compile(pat), spec) for pat, spec in (rules or [])]
+        self.default = default
+
+    def add(self, pattern: str, spec: P) -> "ShardingRules":
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, shape=None, mesh: Optional[Mesh] = None) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return _prune(spec, mesh, shape)
+        return _prune(self.default, mesh, shape)
+
+    def sharding_for(self, name: str, mesh: Mesh, shape=None) -> NamedSharding:
+        return NamedSharding(mesh, self.spec_for(name, shape, mesh))
+
+    def tree_shardings(self, mesh: Mesh, named_shapes: Dict[str, tuple]):
+        return {name: self.sharding_for(name, mesh, shape)
+                for name, shape in named_shapes.items()}
+
+
+def _prune(spec: P, mesh: Optional[Mesh], shape=None) -> P:
+    """Drop axes not present in the mesh or not dividing the dim size."""
+    if mesh is None:
+        return spec
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for i, ax in enumerate(spec):
+        keep = None
+        if ax is not None:
+            axs = ax if isinstance(ax, tuple) else (ax,)
+            if all(a in sizes for a in axs):
+                total = 1
+                for a in axs:
+                    total *= sizes[a]
+                if shape is None or (i < len(shape) and shape[i] % total == 0):
+                    keep = ax
+        out.append(keep)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def param_sharding(mesh: Mesh, rules: ShardingRules, named_shapes: Dict[str, tuple]):
+    return rules.tree_shardings(mesh, named_shapes)
+
+
+def batch_sharding(mesh: Mesh, spec: P = P("dp")) -> NamedSharding:
+    return NamedSharding(mesh, _prune(spec, mesh))

@@ -1,0 +1,200 @@
+"""ShardedTrainer — the whole training step as ONE sharded XLA program.
+
+Replaces the reference's eager loop + KVStore gradient push/pull
+(SURVEY.md §3.2): forward, backward, cross-replica gradient reduction,
+and the fused optimizer update all live inside a single ``jax.jit`` over a
+device Mesh. Gradient all-reduce over the ``dp`` axis is not a library
+call — it falls out of sharding propagation (params replicated over dp,
+batch sharded over dp ⇒ XLA inserts psum on the ICI). Tensor-parallel
+params shard over ``tp`` by rule table; buffers are donated so weights
+update in place in HBM.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ndarray import NDArray
+from ..ops import get_op
+from .functional import functionalize
+from .sharding import ShardingRules, batch_sharding
+
+__all__ = ["ShardedTrainer"]
+
+_SUPPORTED = ("sgd", "adam", "adamw")
+
+
+class ShardedTrainer:
+    """Train a gluon Block over a mesh with dp/tp(/sp) shardings.
+
+    Usage::
+
+        mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+        trainer = parallel.ShardedTrainer(net, loss_fn, mesh,
+                                          rules=net.sharding_rules(),
+                                          optimizer="adam",
+                                          optimizer_params={"learning_rate": 1e-4})
+        for x, y in loader:
+            loss = trainer.step(x, y)     # one fused XLA program
+        trainer.sync_to_net()             # write weights back for save/eval
+    """
+
+    def __init__(self, net, loss_fn, mesh: Mesh, rules: Optional[ShardingRules] = None,
+                 optimizer: str = "sgd", optimizer_params: Optional[Dict] = None,
+                 input_specs=P("dp"), label_specs=P("dp"), grad_clip: float = -1.0,
+                 donate: bool = True):
+        if optimizer not in _SUPPORTED:
+            raise ValueError(f"optimizer {optimizer!r} not in {_SUPPORTED}")
+        self.net = net
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.rules = rules or ShardingRules()
+        opt = dict(optimizer_params or {})
+        self._lr = float(opt.pop("learning_rate", opt.pop("lr", 0.01)))
+        self._opt_name = optimizer
+        self._opt = opt
+        self._grad_clip = grad_clip
+        self._donate = donate
+
+        self._params = {p.name: p for p in net._iter_params() if p._data is not None}
+        self._grad_names = [n for n, p in self._params.items() if p.grad_req != "null"]
+        names, self._apply = functionalize(net, train=True)
+        self._names = names
+
+        # place parameter values per the rule table
+        self.param_vals = {}
+        self._param_shardings = {}
+        for n, p in self._params.items():
+            sh = self.rules.sharding_for(n, mesh, p.data().shape)
+            self._param_shardings[n] = sh
+            self.param_vals[n] = jax.device_put(p.data()._data, sh)
+        self.opt_state = {n: self._init_state(self.param_vals[n])
+                          for n in self._grad_names}
+        self._t = 0
+        self._in_sh = batch_sharding(mesh, input_specs if isinstance(input_specs, P)
+                                     else P(*input_specs))
+        self._label_sh = batch_sharding(mesh, label_specs if isinstance(label_specs, P)
+                                        else P(*label_specs))
+        self._step_fn = None
+
+    # ------------------------------------------------------------------
+    def _init_state(self, val):
+        zeros = lambda: jnp.zeros_like(val)  # noqa: E731
+        if self._opt_name == "sgd":
+            if self._opt.get("momentum", 0.0):
+                return (zeros(),)
+            return ()
+        return (zeros(), zeros())  # adam/adamw mean, var
+
+    def _update_one(self, w, g, state, lr, t):
+        o = self._opt
+        wd = o.get("wd", 0.0)
+        rescale = o.get("rescale_grad", 1.0)
+        clip = self._grad_clip
+        if self._opt_name == "sgd":
+            mom = o.get("momentum", 0.0)
+            if mom:
+                new_w, new_m = get_op("sgd_mom_update").fn(
+                    w, g, state[0], lr=lr, momentum=mom, wd=wd,
+                    rescale_grad=rescale, clip_gradient=clip)
+                return new_w, (new_m,)
+            return get_op("sgd_update").fn(w, g, lr=lr, wd=wd, rescale_grad=rescale,
+                                           clip_gradient=clip), ()
+        b1 = o.get("beta1", 0.9)
+        b2 = o.get("beta2", 0.999)
+        eps = o.get("epsilon", 1e-8)
+        if self._opt_name == "adam":
+            # bias correction via lr scaling (reference optimizer.Adam)
+            corr = jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            new_w, m, v = get_op("adam_update").fn(
+                w, g, state[0], state[1], lr=lr * corr, beta1=b1, beta2=b2,
+                epsilon=eps, wd=wd, rescale_grad=rescale, clip_gradient=clip)
+            return new_w, (m, v)
+        new_w, m, v = get_op("adamw_update").fn(
+            w, g, state[0], state[1], lr=lr, beta1=b1, beta2=b2, epsilon=eps,
+            wd=wd, eta=1.0, rescale_grad=jnp.asarray(rescale, w.dtype),
+            clip_gradient=clip)
+        return new_w, (m, v)
+
+    # ------------------------------------------------------------------
+    def _build(self, n_extra_inputs):
+        grad_names = self._grad_names
+
+        def step_fn(param_vals, opt_state, lr, t, *batch):
+            def loss_f(grad_part):
+                full = dict(param_vals)
+                full.update(grad_part)
+                out, aux = self._apply(full, *batch[:-1])
+                outs = out if isinstance(out, tuple) else (out,)
+                loss_nd = self.loss_fn(*[NDArray(o) for o in outs],
+                                       NDArray(batch[-1]))
+                loss_val = jnp.mean(loss_nd._data)
+                return loss_val, aux
+
+            grad_part = {n: param_vals[n] for n in grad_names}
+            (loss, aux), grads = jax.value_and_grad(loss_f, has_aux=True)(grad_part)
+            new_params = dict(param_vals)
+            new_state = {}
+            for n in grad_names:
+                new_w, st = self._update_one(param_vals[n], grads[n],
+                                             opt_state[n], lr, t)
+                new_params[n] = new_w.astype(param_vals[n].dtype)
+                new_state[n] = st
+            new_params.update(aux)  # BatchNorm moving stats etc.
+            return loss, new_params, new_state
+
+        in_shardings = (
+            self._param_shardings,
+            {n: tuple(self._param_shardings[n] for _ in self.opt_state[n])
+             for n in grad_names},
+            None, None,
+            *([self._in_sh] * n_extra_inputs),
+            self._label_sh,
+        )
+        out_shardings = (NamedSharding(self.mesh, P()), self._param_shardings,
+                         in_shardings[1])
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step_fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def step(self, *batch):
+        """batch = (*inputs, labels); returns the (device) loss scalar."""
+        vals = [b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch]
+        vals = [jax.device_put(v, self._in_sh if i < len(vals) - 1 else self._label_sh)
+                for i, v in enumerate(vals)]
+        if self._step_fn is None:
+            self._step_fn = self._build(len(vals) - 1)
+        self._t += 1
+        from .mesh import mesh_scope
+
+        with mesh_scope(self.mesh):  # attention layers pick sp/ring impls
+            loss, self.param_vals, self.opt_state = self._step_fn(
+                self.param_vals, self.opt_state, jnp.float32(self._lr),
+                jnp.float32(self._t), *vals)
+        return NDArray(loss)
+
+    # ------------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._lr
+
+    def set_learning_rate(self, lr):
+        self._lr = float(lr)
+
+    def sync_to_net(self):
+        """Copy sharded weights back into the gluon parameters (gathered)."""
+        from .. import autograd
+
+        for n, p in self._params.items():
+            val = self.param_vals[n]
+            gathered = jax.device_get(val)
+            with autograd.pause():
+                p.data()._set_data(jnp.asarray(gathered))
+
+    def block_until_ready(self):
+        jax.block_until_ready(self.param_vals)

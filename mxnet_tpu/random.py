@@ -32,9 +32,20 @@ class _KeyState(threading.local):
         self.key = None
         self.trace_key = None
         self.trace_counter = 0
+        self.np_rng = None
 
 
 _STATE = _KeyState()
+
+
+def np_rng() -> np.random.Generator:
+    """Host-side numpy generator tied to the framework seed. Used by
+    initializers so ``mx.random.seed(n)`` makes parameter init reproducible
+    (reference behavior: initializers draw from the seeded MXNet RNG)."""
+    if _STATE.np_rng is None:
+        s = get_env("MXNET_SEED", None, int)
+        _STATE.np_rng = np.random.default_rng(s)
+    return _STATE.np_rng
 
 
 def _root_key():
@@ -47,6 +58,7 @@ def _root_key():
 def seed(seed_state: int, ctx="all") -> None:
     """Seed the global stream (reference mx.random.seed; MXNET_SEED env)."""
     _STATE.key = jax.random.key(int(seed_state))
+    _STATE.np_rng = np.random.default_rng(int(seed_state))
 
 
 def next_key():
