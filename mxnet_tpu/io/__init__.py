@@ -1,0 +1,4 @@
+"""``mx.io`` — data iterators (reference python/mxnet/io/ + src/io/)."""
+from .io import (DataBatch, DataDesc, DataIter, NDArrayIter, CSVIter,  # noqa: F401
+                 MNISTIter, ImageRecordIter, ResizeIter, PrefetchingIter)
+from . import recordio  # noqa: F401

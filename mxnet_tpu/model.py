@@ -1,0 +1,42 @@
+"""Checkpoint save/load (reference python/mxnet/model.py — TBV SURVEY.md §5.4).
+
+Formats match the reference: ``prefix-symbol.json`` + ``prefix-%04d.params``
+where the params file stores ``arg:name`` / ``aux:name`` keyed NDArrays.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .ndarray import NDArray, load as nd_load, save as nd_save
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray], remove_amp_cast=True):
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd_save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(prefix, epoch) -> Tuple[Dict[str, NDArray], Dict[str, NDArray]]:
+    loaded = nd_load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    from . import symbol as sym_mod
+
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,146 @@
+"""BaseModule with the high-level ``fit`` loop.
+
+Reference: ``python/mxnet/module/base_module.py`` (TBV — SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from .. import metric as metric_mod
+from ..callback import BatchEndParam
+
+__all__ = ["BaseModule"]
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.symbol = None
+
+    # -- lifecycle hooks implemented by subclasses -----------------------
+    def bind(self, *a, **kw):
+        raise NotImplementedError
+
+    def init_params(self, *a, **kw):
+        raise NotImplementedError
+
+    def init_optimizer(self, *a, **kw):
+        raise NotImplementedError
+
+    def forward(self, data_batch, is_train=None):
+        raise NotImplementedError
+
+    def backward(self, out_grads=None):
+        raise NotImplementedError
+
+    def update(self):
+        raise NotImplementedError
+
+    def update_metric(self, eval_metric, labels):
+        raise NotImplementedError
+
+    def get_outputs(self):
+        raise NotImplementedError
+
+    # -- composite helpers ------------------------------------------------
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True, epoch=0,
+              batch_end_callback=None):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            self.update_metric(eval_metric, eval_batch.label)
+            if batch_end_callback:
+                bp = BatchEndParam(epoch, nbatch, eval_metric, locals())
+                for cb in _as_list(batch_end_callback):
+                    cb(bp)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        from ..ndarray import NDArray, concat
+
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            if batch.pad:
+                outs = [o[:o.shape[0] - batch.pad] for o in outs]
+            outputs.append([o.copy() for o in outs])
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        merged = [concat(*[b[i] for b in outputs], dim=0) for i in range(n_out)]
+        return merged[0] if n_out == 1 else merged
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=None,
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None):
+        """The classic training loop (reference BaseModule.fit)."""
+        assert num_epoch is not None, "num_epoch is required for fit"
+        optimizer_params = optimizer_params or {"learning_rate": 0.01}
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, data_batch in enumerate(train_data):
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback:
+                    bp = BatchEndParam(epoch, nbatch, eval_metric, locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(bp)
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            if epoch_end_callback:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric, epoch=epoch,
+                                 batch_end_callback=eval_batch_end_callback)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]

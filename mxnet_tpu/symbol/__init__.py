@@ -1,0 +1,21 @@
+"""``mx.sym`` — the symbolic (lazy graph) frontend.
+
+Reference: ``python/mxnet/symbol/`` over NNVM (SURVEY.md §2.1 L5, §2.3).
+"""
+from .symbol import (Symbol, Variable, var, Group, load, load_json,  # noqa: F401
+                     zeros, ones)
+
+from ..ops import get_op, has_op, list_ops
+from .symbol import _make_symbol_op
+
+
+def __getattr__(name):
+    if has_op(name):
+        fn = _make_symbol_op(name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list_ops()))

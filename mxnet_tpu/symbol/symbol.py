@@ -1,0 +1,532 @@
+"""Symbol — lazy computation graph.
+
+Reference: ``python/mxnet/symbol/symbol.py`` + ``nnvm::Symbol/Graph``
+(TBV — SURVEY.md §2.1 L5). TPU redesign: the graph is a plain Python DAG;
+"binding" compiles it through ``jax.jit`` (the executor), replacing NNVM's
+pass pipeline (InferShape/PlanMemory/…) with XLA's — shape inference is
+``jax.eval_shape`` over the same pure op functions the imperative API uses.
+
+Missing tensor inputs auto-create Variables named ``{name}_{arg}`` (the
+reference's behavior that makes ``Module.init_params`` work); ``moving_*``
+args become auxiliary states.
+"""
+from __future__ import annotations
+
+import inspect
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops import get_op, has_op
+from ..ops.registry import OpDef, coerce_kwargs
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros",
+           "ones"]
+
+# argument names treated as tensor inputs when inferring op signatures
+_TENSOR_ARGS = {
+    "data", "weight", "bias", "gamma", "beta", "moving_mean", "moving_var",
+    "running_mean", "running_var", "lhs", "rhs", "condition", "x", "y",
+    "label", "grad", "indices", "index", "parameters", "state", "state_cell",
+    "sequence_length", "mean", "var", "mom", "a", "b", "loss", "value",
+    "mask", "anchors", "cls_pred", "loc_pred",
+}
+# inputs that are auxiliary (not trained, updated by forward)
+_AUX_ARGS = {"moving_mean", "moving_var", "running_mean", "running_var"}
+
+
+class _NameManager(threading.local):
+    def __init__(self):
+        self.counters: Dict[str, int] = {}
+
+    def get(self, hint: str) -> str:
+        n = self.counters.get(hint, 0)
+        self.counters[hint] = n + 1
+        return f"{hint}{n}"
+
+
+_NAMES = _NameManager()
+
+
+def op_input_names(opdef: OpDef) -> List[str]:
+    """Tensor-input argument names of an op, in signature order."""
+    if opdef.ndarray_inputs:
+        return list(opdef.ndarray_inputs)
+    names = []
+    try:
+        sig = inspect.signature(opdef.fn)
+    except (ValueError, TypeError):
+        return ["data"]
+    for p in sig.parameters.values():
+        if p.kind not in (p.POSITIONAL_OR_KEYWORD, p.POSITIONAL_ONLY):
+            break
+        if p.default is inspect.Parameter.empty or p.name in _TENSOR_ARGS:
+            names.append(p.name)
+        else:
+            break
+    return names or ["data"]
+
+
+class Symbol:
+    """One graph node (possibly multi-output); ``_index`` selects an output."""
+
+    def __init__(self, op: Optional[str], name: str, inputs: List["Symbol"],
+                 attrs: Dict[str, Any], index: Optional[int] = None):
+        self._op = op          # None => variable
+        self._name = name
+        self._inputs = inputs
+        self._attrs = dict(attrs)
+        self._index = index
+
+    # ------------------------------------------------------------- naming
+    @property
+    def name(self):
+        if self._index is not None:
+            return f"{self._name}_output{self._index}"
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def list_attr(self):
+        return {k: str(v) for k, v in self._attrs.items()}
+
+    # ---------------------------------------------------------- traversal
+    def _topo(self) -> List["Symbol"]:
+        seen: Dict[int, Symbol] = {}
+        order: List[Symbol] = []
+
+        def visit(node: "Symbol"):
+            base = node._base()
+            if id(base) in seen:
+                return
+            seen[id(base)] = base
+            for i in base._inputs:
+                visit(i)
+            order.append(base)
+
+        visit(self)
+        return order
+
+    def _base(self) -> "Symbol":
+        return self if self._index is None else self._inputs[0]
+
+    def get_internals(self) -> "Symbol":
+        return Group(self._topo())
+
+    def list_arguments(self) -> List[str]:
+        return [n._name for n in self._topo()
+                if n._op is None and not n._attrs.get("__aux__")]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n._name for n in self._topo()
+                if n._op is None and n._attrs.get("__aux__")]
+
+    def list_inputs(self) -> List[str]:
+        return [n._name for n in self._topo() if n._op is None]
+
+    def list_outputs(self) -> List[str]:
+        if self._op == "_group":
+            out = []
+            for s in self._inputs:
+                out.extend(s.list_outputs())
+            return out
+        if self._index is not None:
+            return [self.name]
+        n = self._n_outputs()
+        if n == 1:
+            return [f"{self._name}_output"]
+        return [f"{self._name}_output{i}" for i in range(n)]
+
+    def _n_outputs(self) -> int:
+        if self._op is None:
+            return 1
+        if self._op == "_group":
+            return len(self.list_outputs())
+        if self._index is not None:
+            return 1
+        opdef = get_op(self._op)
+        try:
+            return opdef.n_out(coerce_kwargs(dict(self._attrs))) or 1
+        except Exception:
+            return 1
+
+    @property
+    def num_outputs(self):
+        return self._n_outputs()
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        if self._op == "_group":
+            return self._inputs[idx]
+        if self._n_outputs() == 1 and idx == 0:
+            return self
+        return Symbol(self._op, self._name, [self], {}, index=idx)
+
+    def __iter__(self):
+        return iter(self[i] for i in range(len(self.list_outputs())))
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    @property
+    def outputs(self):
+        return [self[i] for i in range(len(self.list_outputs()))]
+
+    # ---------------------------------------------------------- arithmetic
+    def _binop(self, op, other, swap=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if swap else (self, other)
+            return _apply_op(op, [a, b], {})
+        scalar_ops = {"broadcast_add": "_plus_scalar", "broadcast_sub":
+                      "_rminus_scalar" if swap else "_minus_scalar",
+                      "broadcast_mul": "_mul_scalar",
+                      "broadcast_div": "_rdiv_scalar" if swap else "_div_scalar",
+                      "broadcast_power": "_rpower_scalar" if swap else "_power_scalar"}
+        sop = scalar_ops.get(op)
+        if sop and has_op(sop):
+            return _apply_op(sop, [self], {"scalar": other})
+        raise TypeError(f"unsupported operand for {op}: {type(other)}")
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binop("broadcast_sub", o, swap=True)
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("broadcast_div", o, swap=True)
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", o)
+
+    def __neg__(self):
+        return self._binop("broadcast_mul", -1.0)
+
+    # ---------------------------------------------------------- inference
+    def infer_shape(self, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes) (reference API).
+        Parameter shapes are derived from data shapes like the reference's
+        InferShape pass (src/nnvm shape inference — TBV)."""
+        shapes, out_shapes = infer_shapes(self, kwargs)
+        args = self.list_arguments()
+        auxs = self.list_auxiliary_states()
+        return ([shapes[a] for a in args], out_shapes,
+                [shapes[a] for a in auxs])
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        return ([np.float32] * len(args),
+                [np.float32] * len(self.list_outputs()),
+                [np.float32] * len(self.list_auxiliary_states()))
+
+    # ---------------------------------------------------------- execution
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from ..executor import Executor
+
+        return Executor(self, ctx=ctx, grad_req=grad_req, shapes=shapes)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor(self, ctx=ctx, grad_req=grad_req, args=args,
+                        args_grad=args_grad, aux_states=aux_states)
+
+    def eval(self, ctx=None, **kwargs):
+        exe = self.simple_bind(ctx=ctx, grad_req="null",
+                               **{k: v.shape for k, v in kwargs.items()})
+        return exe.forward(is_train=False, **kwargs)
+
+    # ------------------------------------------------------------- persist
+    def tojson(self) -> str:
+        nodes = self._topo()
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append({
+                "op": "null" if n._op is None else n._op,
+                "name": n._name,
+                "attrs": {k: str(v) for k, v in n._attrs.items()
+                          if not k.startswith("__")},
+                "inputs": [[idx[id(i._base())], i._index or 0, 0]
+                           for i in n._inputs],
+            })
+        if self._op == "_group":
+            heads = []
+            for s in self._inputs:
+                heads.append([idx[id(s._base())], s._index or 0, 0])
+        else:
+            heads = [[idx[id(self._base())], self._index or 0, 0]]
+        arg_nodes = [i for i, n in enumerate(nodes) if n._op is None]
+        return json.dumps({"nodes": out_nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10900]}}, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        if self._op is None:
+            return f"<Symbol {self._name}>"
+        return f"<Symbol {self._op}:{self.name}>"
+
+
+# ---------------------------------------------------------------------------
+
+
+def _apply_op(op_name: str, sym_inputs: List[Symbol], attrs: Dict[str, Any],
+              name: Optional[str] = None) -> Symbol:
+    node = Symbol(op_name, name or _NAMES.get(op_name.lower().lstrip("_")),
+                  sym_inputs, attrs)
+    return node
+
+
+def _make_symbol_op(op_name: str):
+    opdef = get_op(op_name)
+
+    def sym_op(*args, name=None, attr=None, **kwargs):
+        input_names = op_input_names(opdef)
+        inputs: List[Optional[Symbol]] = []
+        rest = list(args)
+        # positional symbols fill input slots in order
+        while rest and isinstance(rest[0], Symbol):
+            inputs.append(rest.pop(0))
+        if rest:
+            raise TypeError(f"{op_name}: unexpected positional args {rest}")
+        # keyword symbols fill by name
+        by_name = {}
+        for k in list(kwargs):
+            if isinstance(kwargs[k], Symbol):
+                by_name[k] = kwargs.pop(k)
+        node_name = name or _NAMES.get(op_name.lower().lstrip("_"))
+        full_inputs: List[Symbol] = list(inputs)
+        no_bias = str(kwargs.get("no_bias", False)).lower() == "true"
+        if len(inputs) < len(input_names) and (inputs or by_name):
+            for i, in_name in enumerate(input_names):
+                if i < len(inputs):
+                    continue
+                if in_name in by_name:
+                    full_inputs.append(by_name.pop(in_name))
+                else:
+                    if in_name == "bias" and no_bias:
+                        continue
+                    aux = in_name in _AUX_ARGS
+                    full_inputs.append(Variable(f"{node_name}_{in_name}",
+                                                __aux__=aux))
+        if by_name:
+            raise TypeError(f"{op_name}: unknown symbol kwargs {list(by_name)}")
+        return _apply_op(op_name, full_inputs, kwargs, name=node_name)
+
+    sym_op.__name__ = op_name
+    sym_op.__doc__ = (opdef.fn.__doc__ or "") + f"\n\n(symbolic op {op_name!r})"
+    return sym_op
+
+
+def Variable(name: str, shape=None, dtype=None, init=None, **attrs) -> Symbol:
+    a = dict(attrs)
+    if shape is not None:
+        a["__shape__"] = tuple(shape)
+    if dtype is not None:
+        a["__dtype__"] = str(dtype)
+    if init is not None:
+        a["__init__"] = init
+    return Symbol(None, name, [], a)
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    return Symbol("_group", "group", list(symbols), {})
+
+
+def zeros(shape, dtype="float32", name=None):
+    return _apply_op("_zeros", [], {"shape": tuple(shape), "dtype": dtype},
+                     name=name)
+
+
+def ones(shape, dtype="float32", name=None):
+    return _apply_op("_ones", [], {"shape": tuple(shape), "dtype": dtype},
+                     name=name)
+
+
+def load_json(s: str) -> Symbol:
+    d = json.loads(s)
+    nodes: List[Symbol] = []
+    for nd_ in d["nodes"]:
+        if nd_["op"] == "null":
+            attrs = coerce_kwargs(nd_.get("attrs", nd_.get("param", {})))
+            sym = Symbol(None, nd_["name"], [], attrs)
+        else:
+            ins = []
+            for (nid, out_idx, _v) in nd_["inputs"]:
+                src = nodes[nid]
+                ins.append(src if out_idx == 0 else src[out_idx])
+            attrs = coerce_kwargs(nd_.get("attrs", nd_.get("param", {})))
+            sym = Symbol(nd_["op"], nd_["name"], ins, attrs)
+        nodes.append(sym)
+    heads = [nodes[h[0]] if h[1] == 0 else nodes[h[0]][h[1]]
+             for h in d["heads"]]
+    if len(heads) == 1:
+        return heads[0]
+    return Group(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------------------
+# shape inference
+# ---------------------------------------------------------------------------
+
+def _param_shape_rules(op: str, in_shape: tuple, kwargs: Dict[str, Any],
+                       arg: str) -> Optional[tuple]:
+    """Shape of an auto-created parameter from the primary input's shape —
+    mirrors each reference op's InferShape (src/operator/** — TBV)."""
+    k = kwargs
+    if op == "FullyConnected":
+        nh = int(k["num_hidden"])
+        flatten = k.get("flatten", True)
+        in_units = int(np.prod(in_shape[1:])) if flatten else in_shape[-1]
+        return {"weight": (nh, in_units), "bias": (nh,)}.get(arg)
+    if op in ("Convolution", "Deconvolution"):
+        nf = int(k["num_filter"])
+        kern = tuple(k.get("kernel", ()))
+        ng = int(k.get("num_group", 1))
+        c = in_shape[1]
+        if op == "Convolution":
+            w = (nf, c // ng) + kern
+        else:
+            w = (c, nf // ng) + kern
+        return {"weight": w, "bias": (nf,)}.get(arg)
+    if op in ("BatchNorm", "InstanceNorm"):
+        axis = int(k.get("axis", 1))
+        return (in_shape[axis],)
+    if op == "LayerNorm":
+        axis = int(k.get("axis", -1)) % len(in_shape)
+        return (in_shape[axis],)
+    if op == "GroupNorm":
+        return (in_shape[1],)
+    if op == "Embedding":
+        return (int(k["input_dim"]), int(k["output_dim"]))
+    if op == "LeakyReLU" and arg == "gamma":
+        return (in_shape[1] if len(in_shape) > 1 else in_shape[0],)
+    if op in ("SoftmaxOutput", "Softmax", "SVMOutput") and arg == "label":
+        multi = str(k.get("multi_output", False)).lower() == "true" or \
+            k.get("multi_output") is True
+        if multi:
+            return (in_shape[0],) + tuple(in_shape[2:])
+        return (in_shape[0],)
+    if op.endswith("RegressionOutput") and arg == "label":
+        return tuple(in_shape)
+    if op == "RNN":
+        from ..ops.rnn import rnn_param_size
+
+        h = int(k["state_size"])
+        L = int(k["num_layers"])
+        bi = str(k.get("bidirectional", False)).lower() == "true" or \
+            k.get("bidirectional") is True
+        dirs = 2 if bi else 1
+        if arg == "parameters":
+            return (rnn_param_size(k["mode"], in_shape[2], h, L, bi),)
+        if arg in ("state", "state_cell"):
+            return (L * dirs, in_shape[1], h)
+    return None
+
+
+def infer_shapes(sym: Symbol, known: Dict[str, tuple]):
+    """Topo-order forward shape inference. Returns (all_input_shapes,
+    out_shapes). Auto-created params get shapes from op rules; other node
+    outputs via jax.eval_shape of the same pure op functions."""
+    import jax
+
+    shapes: Dict[str, tuple] = {k: tuple(v) for k, v in known.items()}
+    node_out: Dict[int, Any] = {}  # node id -> shape or tuple of shapes
+
+    for node in sym._topo():
+        if node._op is None:
+            if node._name not in shapes and "__shape__" in node._attrs:
+                shapes[node._name] = tuple(node._attrs["__shape__"])
+            if node._name in shapes:
+                node_out[id(node)] = shapes[node._name]
+            continue
+        if node._op == "_group":
+            continue
+        opdef = get_op(node._op)
+        kwargs = coerce_kwargs({k2: v for k2, v in node._attrs.items()
+                                if not k2.startswith("__")})
+        input_names = op_input_names(opdef)
+        # primary input shape
+        primary = None
+        for i in node._inputs:
+            s = node_out.get(id(i._base()))
+            if s is not None:
+                if i._index is not None and isinstance(s, list):
+                    s = s[i._index]
+                primary = s
+                break
+        in_shapes = []
+        for pos, i in enumerate(node._inputs):
+            base = i._base()
+            s = node_out.get(id(base))
+            if s is not None and i._index is not None and isinstance(s, list):
+                s = s[i._index]
+            if s is None and base._op is None:
+                arg = input_names[pos] if pos < len(input_names) else None
+                s = _param_shape_rules(node._op, primary, kwargs, arg) \
+                    if primary is not None and arg else None
+                if s is None:
+                    raise ValueError(
+                        f"cannot infer shape of {base._name!r} (input "
+                        f"{arg!r} of {node._op}); provide it explicitly")
+                shapes[base._name] = tuple(s)
+                node_out[id(base)] = tuple(s)
+            in_shapes.append(s)
+        avals = [jax.ShapeDtypeStruct(s, np.float32) for s in in_shapes]
+        try:
+            out = jax.eval_shape(lambda *a: opdef.fn(*a, **kwargs), *avals)
+        except Exception as e:
+            raise ValueError(f"shape inference failed at {node._op} "
+                             f"({node._name}): {e}") from e
+        if isinstance(out, (list, tuple)):
+            node_out[id(node)] = [tuple(o.shape) for o in out]
+        else:
+            node_out[id(node)] = tuple(out.shape)
+
+    if sym._op == "_group":
+        heads = [(s._base(), s._index) for s in sym._inputs]
+    else:
+        heads = [(sym._base(), sym._index)]
+    out_shapes = []
+    for base, index in heads:
+        s = node_out[id(base)]
+        if isinstance(s, list):
+            if index is not None:
+                out_shapes.append(s[index])
+            else:
+                out_shapes.extend(s)
+        else:
+            out_shapes.append(s)
+    return shapes, out_shapes

@@ -1,0 +1,134 @@
+"""mx.io + recordio tests (reference test_io.py / test_recordio analogs)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import (CSVIter, DataBatch, ImageRecordIter, NDArrayIter,
+                          PrefetchingIter, ResizeIter)
+from mxnet_tpu.io.recordio import (IRHeader, MXIndexedRecordIO, MXRecordIO,
+                                   pack, pack_img, unpack, unpack_img)
+
+
+def test_ndarray_iter_basic():
+    x = np.arange(50, dtype=np.float32).reshape(10, 5)
+    y = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(x, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 5)
+    assert batches[-1].pad == 2
+    # pad wraps from beginning
+    np.testing.assert_allclose(batches[-1].data[0].asnumpy()[1:],
+                               x[:2])
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarray_iter_discard_and_shard():
+    x = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(x, None, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 3
+    it0 = NDArrayIter(x, None, batch_size=1, part_index=0, num_parts=2)
+    it1 = NDArrayIter(x, None, batch_size=1, part_index=1, num_parts=2)
+    d0 = np.concatenate([b.data[0].asnumpy() for b in it0])
+    d1 = np.concatenate([b.data[0].asnumpy() for b in it1])
+    assert sorted(np.concatenate([d0, d1]).tolist()) == list(range(10))
+
+
+def test_provide_data_descs():
+    it = NDArrayIter(np.zeros((8, 3, 4, 4), np.float32),
+                     np.zeros(8, np.float32), batch_size=2)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (2, 3, 4, 4)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_csv_iter(tmp_path):
+    f = tmp_path / "d.csv"
+    np.savetxt(f, np.arange(12).reshape(4, 3), delimiter=",")
+    it = CSVIter(str(f), data_shape=(3,), batch_size=2)
+    b = next(iter(it))
+    assert b.data[0].shape == (2, 3)
+
+
+def test_recordio_roundtrip(tmp_path):
+    uri = str(tmp_path / "test.rec")
+    w = MXRecordIO(uri, "w")
+    payloads = [b"hello", b"x" * 1001, b""]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = MXRecordIO(uri, "r")
+    got = []
+    while True:
+        b = r.read()
+        if b is None:
+            break
+        got.append(b)
+    assert got == payloads
+
+
+def test_indexed_recordio(tmp_path):
+    uri = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = MXIndexedRecordIO(idx, uri, "w")
+    for i in range(5):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = MXIndexedRecordIO(idx, uri, "r")
+    assert r.keys == list(range(5))
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+
+
+def test_pack_unpack_header():
+    h = IRHeader(0, 3.0, 7, 0)
+    blob = pack(h, b"payload")
+    h2, payload = unpack(blob)
+    assert h2.label == 3.0 and h2.id == 7
+    assert payload == b"payload"
+    # vector label
+    h = IRHeader(0, [1.0, 2.0, 3.0], 9, 0)
+    h2, payload = unpack(pack(h, b"xy"))
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"xy"
+
+
+def test_pack_img_and_image_record_iter(tmp_path):
+    uri = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = MXIndexedRecordIO(idx, uri, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+        w.write_idx(i, pack_img(IRHeader(0, float(i % 3), i, 0), img))
+    w.close()
+
+    it = ImageRecordIter(path_imgrec=uri, data_shape=(3, 32, 32), batch_size=4,
+                         rand_crop=True, rand_mirror=True, preprocess_threads=2)
+    batches = list(iter_all(it))
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.astype(int)) <= {0, 1, 2}
+
+
+def iter_all(it):
+    it.reset()
+    while True:
+        try:
+            yield it.next()
+        except StopIteration:
+            return
+
+
+def test_resize_and_prefetch_iters():
+    x = np.arange(20, dtype=np.float32)
+    base = NDArrayIter(x, None, batch_size=4)
+    r = ResizeIter(base, 10)
+    assert len(list(iter_all(r))) == 10
+    p = PrefetchingIter(NDArrayIter(x, None, batch_size=4))
+    batches = list(iter_all(p))
+    assert len(batches) == 5
+    got = np.concatenate([b.data[0].asnumpy() for b in batches])
+    np.testing.assert_allclose(np.sort(got), x)

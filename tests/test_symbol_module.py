@@ -1,0 +1,171 @@
+"""Symbolic frontend + Executor + Module tests (reference test_symbol.py /
+test_module.py analogs — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.module import BucketingModule, Module
+
+
+def _mlp_symbol(hidden=16, classes=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_arguments_and_outputs():
+    s = _mlp_symbol()
+    args = s.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert s.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_infer_shape():
+    s = _mlp_symbol(hidden=16, classes=4)
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(data=(8, 10))
+    args = s.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (16, 10)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (4, 16)
+    assert out_shapes == [(8, 4)]
+
+
+def test_symbol_json_roundtrip():
+    s = _mlp_symbol()
+    js = s.tojson()
+    s2 = sym.load_json(js)
+    assert s2.list_arguments() == s.list_arguments()
+    arg_shapes, out_shapes, _ = s2.infer_shape(data=(2, 6))
+    assert out_shapes == [(2, 4)]
+
+
+def test_executor_forward_matches_numpy():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.FullyConnected(data, w, no_bias=True, num_hidden=3, name="fc")
+    exe = out.simple_bind(grad_req="null", data=(2, 5), w=(3, 5))
+    x = np.random.RandomState(0).rand(2, 5).astype(np.float32)
+    wv = np.random.RandomState(1).rand(3, 5).astype(np.float32)
+    (y,) = exe.forward(is_train=False, data=x, w=wv)
+    np.testing.assert_allclose(y.asnumpy(), x @ wv.T, rtol=1e-5, atol=1e-6)
+
+
+def test_executor_backward_gradients():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=1, no_bias=True, name="fc")
+    loss = sym.sum(out)
+    exe = loss.simple_bind(grad_req="write", data=(4, 3))
+    x = np.ones((4, 3), np.float32)
+    wv = np.full((1, 3), 2.0, np.float32)
+    exe.forward(is_train=True, data=x, fc_weight=wv)
+    exe.backward()
+    np.testing.assert_allclose(exe.grad_dict["fc_weight"].asnumpy(),
+                               np.full((1, 3), 4.0), rtol=1e-5)
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               np.full((4, 3), 2.0), rtol=1e-5)
+
+
+def test_module_fit_mlp():
+    """Small real fit reaches high train accuracy (reference
+    tests/python/train/test_mlp.py idea)."""
+    rng = np.random.RandomState(0)
+    n = 256
+    x = rng.randn(n, 8).astype(np.float32)
+    wtrue = rng.randn(8, 3).astype(np.float32)
+    y = np.argmax(x @ wtrue, axis=1).astype(np.float32)
+    it = NDArrayIter(x, y, batch_size=32, shuffle=True)
+
+    mod = Module(_mlp_symbol(hidden=32, classes=3), context=mx.cpu())
+    mod.fit(it, num_epoch=12,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.9, f"train accuracy too low: {score}"
+
+
+def test_module_predict_and_checkpoint(tmp_path):
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 6).astype(np.float32)
+    y = rng.randint(0, 3, 32).astype(np.float32)
+    it = NDArrayIter(x, y, batch_size=8)
+    mod = Module(_mlp_symbol(hidden=8, classes=3))
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (32, 3)
+
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = Module.load(prefix, 1)
+    mod2.bind(it.provide_data, it.provide_label, for_training=False)
+    mod2.init_params()
+    preds2 = mod2.predict(it)
+    np.testing.assert_allclose(preds.asnumpy(), preds2.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_batchnorm_symbolic_aux_update():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, name="bn", fix_gamma=False, momentum=0.5)
+    exe = net.simple_bind(grad_req="null", data=(4, 3))
+    assert set(exe.aux_dict) == {"bn_moving_mean", "bn_moving_var"}
+    x = np.random.RandomState(0).rand(4, 3).astype(np.float32) * 10
+    exe.forward(is_train=True, data=x, bn_gamma=np.ones(3, np.float32),
+                bn_beta=np.zeros(3, np.float32))
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    expected = 0.5 * np.zeros(3) + 0.5 * x.mean(axis=0)
+    np.testing.assert_allclose(mm, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_bucketing_module_shares_params():
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=4, name="fc",
+                                 flatten=False)
+        net = sym.mean(net, axis=1)
+        net = sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10)
+    mod.bind([("data", (2, 10, 5))], [("softmax_label", (2,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+    for key, t in ((10, 10), (6, 6), (10, 10)):
+        batch = DataBatch([nd.ones((2, t, 5))], [nd.zeros((2,))],
+                          bucket_key=key,
+                          provide_data=[("data", (2, t, 5))],
+                          provide_label=[("softmax_label", (2,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    # both buckets must share the same parameter storage
+    m10 = mod._buckets[10]._exec.arg_dict["fc_weight"]
+    m6 = mod._buckets[6]._exec.arg_dict["fc_weight"]
+    assert m10 is m6
+
+
+def test_symbol_arithmetic_and_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2.0 - 1.0
+    exe = c.simple_bind(grad_req="null", a=(2, 2), b=(2, 2))
+    (out,) = exe.forward(a=np.ones((2, 2), np.float32),
+                         b=np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_multi_output_indexing():
+    data = sym.Variable("data")
+    s = sym.SliceChannel(data, num_outputs=3, axis=1, name="split")
+    assert len(s.list_outputs()) == 3
+    first = s[0]
+    exe = first.simple_bind(grad_req="null", data=(2, 6))
+    (out,) = exe.forward(data=np.arange(12, dtype=np.float32).reshape(2, 6))
+    assert out.shape == (2, 2)
