@@ -1,0 +1,92 @@
+"""AMP — automatic mixed precision, TPU-native.
+
+Reference: ``python/mxnet/contrib/amp/`` (op allow/deny lists patching fp16
+casts into the graph, dynamic loss scaling — TBV, SURVEY.md §2.3).
+
+TPU redesign: the MXU's native fast dtype is **bfloat16**, which shares
+float32's exponent range — so the reference's loss-scaling machinery is
+unnecessary (kept as an API-compatible no-op shim for fp16 parity). AMP
+here = cast-to-bf16 policy on parameters/inputs; accumulations stay fp32
+inside XLA (dot_general's preferred_element_type).
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+__all__ = ["init", "init_trainer", "convert_model", "convert_hybrid_block",
+           "amp_cast", "LossScaler", "scale_loss", "unscale"]
+
+_TARGET = {"dtype": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Install the global AMP dtype (reference amp.init patches op lists;
+    here eager math follows jax dtype promotion once inputs are bf16)."""
+    if target_dtype in ("float16", np.float16):
+        warnings.warn("float16 has no MXU fast path on TPU; using bfloat16")
+        target_dtype = "bfloat16"
+    _TARGET["dtype"] = target_dtype
+
+
+def init_trainer(trainer):
+    """No-op: bf16 needs no loss scaling (exponent range == fp32)."""
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None,
+                         cast_optional_params=False):
+    """Cast a Gluon block's parameters to bf16 (BatchNorm stats stay fp32,
+    like the reference keeps BN in fp32)."""
+    for p in block._iter_params():
+        name = p.name
+        if name.endswith(("running_mean", "running_var", "moving_mean",
+                          "moving_var", "gamma", "beta")):
+            continue
+        p.cast(target_dtype)
+    return block
+
+
+convert_model = convert_hybrid_block
+
+
+def amp_cast(x, dtype="bfloat16"):
+    return x.astype(dtype)
+
+
+class LossScaler:
+    """API-compatible shim of the reference's dynamic loss scaler. On TPU
+    (bf16) scale stays 1.0; the update logic is kept for fp16 parity tests."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0, scale_window=2000):
+        self.loss_scale = 1.0
+        self._init_scale = init_scale
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        for p in params:
+            g = p.grad() if callable(getattr(p, "grad", None)) else None
+            if g is not None and not bool(np.isfinite(g.asnumpy()).all()):
+                return True
+        return False
+
+    def update_scale(self, skip):
+        if skip:
+            self.loss_scale = max(self.loss_scale / self._factor, 1e-4)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale = min(self.loss_scale * self._factor, 2 ** 24)
+                self._unskipped = 0
+
+
+def scale_loss(loss, scaler: LossScaler):
+    return loss * scaler.loss_scale
+
+
+def unscale(grads, scaler: LossScaler):
+    return [g / scaler.loss_scale for g in grads]

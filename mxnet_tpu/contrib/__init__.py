@@ -1,0 +1,4 @@
+"""``mx.contrib`` namespace (reference python/mxnet/contrib/)."""
+from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
+from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

@@ -1,0 +1,34 @@
+"""ONNX interop (reference contrib/onnx/ mx2onnx + onnx2mx — TBV).
+
+Export serializes the symbol graph + params to the framework's own json/
+params pair (StableHLO export is the TPU-native deployment path — see
+HybridBlock.export); full ONNX protobuf emission requires the ``onnx``
+package, which is not in this image — gated accordingly.
+"""
+from __future__ import annotations
+
+__all__ = ["export_model", "import_model"]
+
+
+def _have_onnx():
+    try:
+        import onnx  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def export_model(sym, params, input_shape, input_type=None, onnx_file_path="model.onnx",
+                 verbose=False, **kwargs):
+    if not _have_onnx():
+        raise ImportError("onnx package not available in this environment; "
+                          "use Module.save_checkpoint / HybridBlock.export for "
+                          "the native json+params format")
+    raise NotImplementedError("ONNX emission lands with the onnx package")
+
+
+def import_model(model_file):
+    if not _have_onnx():
+        raise ImportError("onnx package not available in this environment")
+    raise NotImplementedError

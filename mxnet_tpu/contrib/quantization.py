@@ -1,0 +1,25 @@
+"""INT8 quantization shim (reference contrib/quantization.py — TBV).
+
+The reference's INT8 path targets MKLDNN/TensorRT; TPU v5 has no INT8
+inference path exposed through XLA, so calibration/quantization raise with
+guidance (bf16 via mx.amp is the TPU reduced-precision path). API surface
+kept for import parity.
+"""
+from __future__ import annotations
+
+__all__ = ["quantize_model", "quantize_net", "quantize_graph"]
+
+_MSG = ("INT8 quantization is not available in the TPU build; use "
+        "mx.amp (bfloat16) for reduced-precision inference/training")
+
+
+def quantize_model(*a, **kw):
+    raise NotImplementedError(_MSG)
+
+
+def quantize_net(*a, **kw):
+    raise NotImplementedError(_MSG)
+
+
+def quantize_graph(*a, **kw):
+    raise NotImplementedError(_MSG)

@@ -38,6 +38,12 @@ def _make_dispatcher(name: str):
 
 
 def __getattr__(name: str):
+    if name == "contrib":
+        import importlib
+
+        mod = importlib.import_module(".contrib", __name__)
+        globals()["contrib"] = mod
+        return mod
     if has_op(name):
         fn = _make_dispatcher(name)
         globals()[name] = fn  # cache

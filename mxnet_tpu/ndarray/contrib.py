@@ -1,0 +1,17 @@
+"""``mx.nd.contrib`` — contrib op namespace (reference ndarray/contrib.py).
+
+Resolves ``nd.contrib.box_nms`` → registered op ``_contrib_box_nms`` (or a
+bare-name registration)."""
+from __future__ import annotations
+
+from ..ops import has_op
+from . import _make_dispatcher
+
+
+def __getattr__(name: str):
+    for cand in (f"_contrib_{name}", name):
+        if has_op(cand):
+            fn = _make_dispatcher(cand)
+            globals()[name] = fn
+            return fn
+    raise AttributeError(f"no contrib operator {name!r}")

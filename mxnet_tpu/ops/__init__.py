@@ -17,3 +17,4 @@ from . import nn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import contrib  # noqa: F401

@@ -1,0 +1,337 @@
+"""Contrib operators: SSD multibox trio, box_nms, roi_align, boolean_mask,
+index_copy, allclose.
+
+Reference: ``src/operator/contrib/`` (multibox_prior.cu, multibox_target.cu,
+multibox_detection.cu, bounding_box.cu — TBV, SURVEY.md §2.2). These are
+data-dependent CUDA kernels in the reference; TPU redesign keeps shapes
+STATIC: NMS is a fixed-length ``lax.scan`` over score-sorted boxes with a
+suppression mask (no dynamic compaction — suppressed entries become -1
+rows, exactly the reference's output convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# multibox_prior — anchor generation
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior", "multibox_prior"])
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                    offsets=(0.5, 0.5)):
+    """data (B, C, H, W) → anchors (1, H*W*(S+R-1), 4) in ltrb [0,1] coords."""
+    h, w = data.shape[-2], data.shape[-1]
+    sizes = tuple(sizes)
+    ratios = tuple(ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cys, cxs = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cxs.ravel(), cys.ravel()], axis=-1)  # (HW, 2)
+
+    wh = []
+    # reference order: (s_i, r_0) for all sizes, then (s_0, r_j) for j>0
+    for s in sizes:
+        wh.append((s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        wh.append((sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r)))
+    wh = jnp.asarray(wh, jnp.float32)  # (K, 2)
+
+    k = wh.shape[0]
+    cxy = jnp.repeat(centers[:, None, :], k, axis=1)  # (HW, K, 2)
+    half = wh[None, :, :] / 2.0
+    ltrb = jnp.concatenate([cxy - half, cxy + half], axis=-1).reshape(1, -1, 4)
+    if clip:
+        ltrb = jnp.clip(ltrb, 0.0, 1.0)
+    return ltrb.astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# IOU helper
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(a, b):
+    """a (N,4), b (M,4) ltrb → (N,M) IOU."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# multibox_target — anchor matching + loc target encoding
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxTarget", aliases=["MultiBoxTarget", "multibox_target"],
+          num_outputs=3)
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """anchor (1,N,4); label (B,M,5) [cls,l,t,r,b] (cls<0 = pad);
+    cls_pred (B,C,N). Returns (loc_target (B,N*4), loc_mask (B,N*4),
+    cls_target (B,N))."""
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    v = jnp.asarray(variances, anchors.dtype)
+
+    def one_sample(lab):
+        valid = lab[:, 0] >= 0
+        gt = lab[:, 1:5]
+        iou = _iou_matrix(anchors, gt)  # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)          # (N,)
+        best_iou = jnp.take_along_axis(iou, best_gt[:, None], 1)[:, 0]
+        # each gt's best anchor is forced matched (reference bipartite stage)
+        best_anchor = jnp.argmax(iou, axis=0)      # (M,)
+        forced = jnp.zeros(n, bool).at[best_anchor].set(valid)
+        matched = forced | (best_iou >= overlap_threshold)
+        gt_ltrb = gt[best_gt]
+        # encode: center offsets normalized by variances
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(gt_ltrb[:, 2] - gt_ltrb[:, 0], 1e-8)
+        gh = jnp.maximum(gt_ltrb[:, 3] - gt_ltrb[:, 1], 1e-8)
+        gcx = (gt_ltrb[:, 0] + gt_ltrb[:, 2]) / 2
+        gcy = (gt_ltrb[:, 1] + gt_ltrb[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / v[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / v[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / v[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / v[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.broadcast_to(matched[:, None], (n, 4)).astype(anchors.dtype)
+        cls_t = jnp.where(matched, lab[best_gt, 0] + 1.0, 0.0)
+        return loc_t, loc_m.reshape(-1), cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(one_sample)(label)
+    return (loc_target.astype(cls_pred.dtype), loc_mask.astype(cls_pred.dtype),
+            cls_target.astype(cls_pred.dtype))
+
+
+# ---------------------------------------------------------------------------
+# NMS core: fixed-length greedy suppression over sorted boxes
+# ---------------------------------------------------------------------------
+
+def _greedy_nms_keep(boxes, scores, valid, thresh):
+    """boxes (N,4) sorted by score desc; returns keep mask (N,)."""
+    n = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes)
+
+    def body(keep, i):
+        sup = jnp.any((iou[i] > thresh) & keep & (jnp.arange(n) < i))
+        keep = keep.at[i].set(jnp.logical_and(valid[i], jnp.logical_not(sup)))
+        return keep, None
+
+    keep0 = jnp.zeros(n, bool)
+    keep, _ = lax.scan(body, keep0, jnp.arange(n))
+    return keep
+
+
+@register("_contrib_box_nms", aliases=["box_nms", "_contrib_box_non_maximum_suppression"])
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner"):
+    """data (..., N, K) rows [id?, score, l, t, r, b, ...]; suppressed rows
+    get all fields -1 (reference convention)."""
+    shape = data.shape
+    flat = data.reshape(-1, shape[-2], shape[-1])
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = batch[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            cx, cy, w, h = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+            boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= batch[:, id_index] != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        sboxes = boxes[order]
+        svalid = valid[order]
+        if topk > 0:
+            svalid &= jnp.arange(svalid.shape[0]) < topk
+        if id_index >= 0 and not force_suppress:
+            # suppress only within the same class: inflate IOU across classes to 0
+            ids = batch[order, id_index]
+            iou = _iou_matrix(sboxes, sboxes)
+            same = ids[:, None] == ids[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+            def body(keep, i):
+                sup = jnp.any((iou[i] > overlap_thresh) & keep
+                              & (jnp.arange(keep.shape[0]) < i))
+                keep = keep.at[i].set(svalid[i] & ~sup)
+                return keep, None
+
+            keep, _ = lax.scan(body, jnp.zeros(sboxes.shape[0], bool),
+                               jnp.arange(sboxes.shape[0]))
+        else:
+            keep = _greedy_nms_keep(sboxes, scores[order], svalid, overlap_thresh)
+        sorted_batch = batch[order]
+        out = jnp.where(keep[:, None], sorted_batch, -1.0)
+        return out.astype(data.dtype)
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# multibox_detection — decode + NMS
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiBoxDetection", aliases=["MultiBoxDetection",
+                                                 "multibox_detection"])
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """cls_prob (B,C,N), loc_pred (B,N*4), anchor (1,N,4) →
+    (B, N, 6) rows [cls_id, score, l, t, r, b]; cls_id -1 = suppressed."""
+    b, c, n = cls_prob.shape
+    anchors = anchor.reshape(-1, 4)
+    v = jnp.asarray(variances, cls_prob.dtype)
+
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(probs, locs):
+        loc = locs.reshape(n, 4)
+        cx = loc[:, 0] * v[0] * aw + acx
+        cy = loc[:, 1] * v[1] * ah + acy
+        w = jnp.exp(jnp.clip(loc[:, 2] * v[2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(loc[:, 3] * v[3], -10, 10)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([probs[:background_id], probs[background_id + 1:]],
+                             axis=0) if 0 <= background_id < c else probs
+        cls_id = jnp.argmax(fg, axis=0).astype(cls_prob.dtype)
+        score = jnp.max(fg, axis=0)
+        rows = jnp.concatenate([cls_id[:, None], score[:, None], boxes], axis=-1)
+        rows = jnp.where((score > threshold)[:, None], rows,
+                         jnp.full_like(rows, -1.0))
+        return rows
+
+    decoded = jax.vmap(one)(cls_prob, loc_pred)  # (B, N, 6)
+    return _box_nms(decoded, overlap_thresh=nms_threshold, valid_thresh=threshold,
+                    topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                    background_id=-1, force_suppress=force_suppress)
+
+
+# ---------------------------------------------------------------------------
+# ROIAlign
+# ---------------------------------------------------------------------------
+
+@register("_contrib_ROIAlign", aliases=["ROIAlign", "roi_align"])
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2,
+               position_sensitive=False, aligned=False):
+    """data (B,C,H,W); rois (R,5) [batch_idx, x1, y1, x2, y2] → (R,C,ph,pw)."""
+    b, c, h, w = data.shape
+    ph, pw = pooled_size
+    sr = max(int(sample_ratio), 1)
+    off = 0.5 if aligned else 0.0
+
+    def bilinear(img, ys, xs):
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys, 0, h - 1) - y0
+        wx = jnp.clip(xs, 0, w - 1) - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def one(roi):
+        bi = jnp.clip(roi[0].astype(jnp.int32), 0, b - 1)
+        img = lax.dynamic_index_in_dim(data, bi, 0, keepdims=False)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale - off,
+                          roi[2] * spatial_scale - off,
+                          roi[3] * spatial_scale - off,
+                          roi[4] * spatial_scale - off)
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-8)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-8)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(ph)[:, None, None, None]
+              * bin_h + y1 + (jnp.arange(sr)[None, None, :, None] + 0.5)
+              * bin_h / sr)
+        ix = (jnp.arange(pw)[None, :, None, None]
+              * bin_w + x1 + (jnp.arange(sr)[None, None, None, :] + 0.5)
+              * bin_w / sr)
+        ys = jnp.broadcast_to(iy, (ph, pw, sr, sr)).reshape(-1)
+        xs = jnp.broadcast_to(ix, (ph, pw, sr, sr)).reshape(-1)
+        vals = bilinear(img, ys, xs)  # (C, ph*pw*sr*sr)
+        vals = vals.reshape(c, ph, pw, sr * sr).mean(axis=-1)
+        return vals
+
+    return jax.vmap(one)(rois).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# misc contrib
+# ---------------------------------------------------------------------------
+
+@register("_contrib_boolean_mask", aliases=["boolean_mask"])
+def _boolean_mask(data, index, axis=0):
+    """Dynamic-shape op in the reference; TPU version keeps static shape by
+    compacting selected rows to the front and zero-padding the tail (callers
+    that need the true count can sum(index))."""
+    mask = index.astype(bool)
+    ax = int(axis) % data.ndim
+    order = jnp.argsort(~mask, stable=True)  # selected first, stable
+    gathered = jnp.take(data, order, axis=ax)
+    count = jnp.sum(mask)
+    idx = jnp.arange(data.shape[ax])
+    keep_shape = [1] * data.ndim
+    keep_shape[ax] = -1
+    keep = (idx < count).reshape(keep_shape)
+    return jnp.where(keep, gathered, 0).astype(data.dtype)
+
+
+@register("_contrib_index_copy", aliases=["index_copy"])
+def _index_copy(old_tensor, index_vector, new_tensor):
+    idx = index_vector.astype(jnp.int32)
+    return old_tensor.at[idx].set(new_tensor)
+
+
+@register("_contrib_allclose", aliases=["allclose"], differentiable=False)
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32).reshape(1)
+
+
+@register("_contrib_arange_like", differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = data.size
+        out = start + step * jnp.arange(n, dtype=jnp.float32)
+        return out.reshape(data.shape)
+    n = data.shape[int(axis)]
+    return start + step * jnp.arange(n, dtype=jnp.float32)
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))

@@ -1,0 +1,93 @@
+"""``mx.profiler`` over jax.profiler.
+
+Reference: ``src/profiler/`` + ``python/mxnet/profiler.py`` (TBV —
+SURVEY.md §5.1). The reference hooks the engine and dumps chrome-trace
+JSON; here XLA's profiler produces an XPlane/perfetto trace (viewable in
+TensorBoard/Perfetto, superset of the chrome-trace view). Per-op
+attribution inside jitted programs comes from ``named_scope`` annotations
+(``mx.profiler.scope``).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import warnings
+
+import jax
+
+__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
+           "scope", "Profiler"]
+
+_config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
+_state = {"running": False, "dir": None}
+
+
+def set_config(**kwargs):
+    """profile_{all,symbolic,imperative,memory,api}=..., filename=... —
+    reference kwargs accepted; XLA traces everything on the device timeline."""
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state in ("run", 1):
+        if not _state["running"]:
+            logdir = _config.get("filename", "profile.json")
+            trace_dir = logdir if os.path.isdir(logdir) else \
+                (os.path.splitext(logdir)[0] + "_trace")
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            _state.update(running=True, dir=trace_dir)
+    elif state in ("stop", 0):
+        if _state["running"]:
+            jax.profiler.stop_trace()
+            _state["running"] = False
+    else:
+        raise ValueError(f"invalid profiler state {state!r}")
+
+
+def pause(profile_process="worker"):
+    if _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+resume = None  # set below
+
+
+def _resume(profile_process="worker"):
+    set_state("run")
+
+
+resume = _resume
+
+
+def dump(finished=True, profile_process="worker"):
+    """Finish tracing; the trace directory holds the XPlane/perfetto dump."""
+    set_state("stop")
+    return _state.get("dir")
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    return f"profiler trace dir: {_state.get('dir')}"
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Named sub-scope for per-op attribution inside jit (reference profiler
+    scopes / operator names in the engine timeline)."""
+    with jax.named_scope(name):
+        yield
+
+
+class Profiler:
+    """Context manager: profile a region."""
+
+    def __init__(self, filename="profile", **kwargs):
+        set_config(filename=filename, **kwargs)
+
+    def __enter__(self):
+        set_state("run")
+        return self
+
+    def __exit__(self, *a):
+        dump()

@@ -1,0 +1,64 @@
+"""``mx.runtime`` — compiled-feature introspection.
+
+Reference: ``src/libinfo.cc`` → ``mx.runtime.feature_list()`` (TBV —
+SURVEY.md §5.6). Features reflect the TPU build: CUDA-family flags are
+False, TPU/XLA capabilities are reported in their place.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+
+__all__ = ["Feature", "feature_list", "Features", "is_enabled"]
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    platforms = {d.platform for d in jax.devices()}
+    feats = {
+        "TPU": "tpu" in platforms or "axon" in platforms,
+        "CPU": True,
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "XLA": True,
+        "PALLAS": True,
+        "PJIT": True,
+        "SHARD_MAP": True,
+        "RING_ATTENTION": True,
+        "BF16": True,
+        "INT8": False,
+        "OPENCV": False,
+        "PIL": _has("PIL"),
+        "DIST_KVSTORE": True,
+        "PS_DIST_ASYNC": True,
+        "SIGNAL_HANDLER": True,
+        "PROFILER": True,
+    }
+    return feats
+
+
+def _has(mod):
+    try:
+        __import__(mod)
+        return True
+    except ImportError:
+        return False
+
+
+def feature_list():
+    return [Feature(k, v) for k, v in _detect().items()]
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _detect().items()})
+
+    def is_enabled(self, name):
+        return self.get(name, Feature(name, False)).enabled
+
+
+def is_enabled(name):
+    return Features().is_enabled(name)

@@ -1,0 +1,55 @@
+"""Misc utilities (reference python/mxnet/util.py — TBV)."""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["use_np", "use_np_shape", "use_np_array", "is_np_array",
+           "set_module", "makedirs", "get_gpu_count", "get_gpu_memory",
+           "default_array"]
+
+_np_array = False
+
+
+def is_np_array():
+    return _np_array
+
+
+def use_np_shape(fn):
+    return fn
+
+
+def use_np_array(fn):
+    return fn
+
+
+def use_np(fn):
+    return fn
+
+
+def set_module(module):
+    def deco(fn):
+        fn.__module__ = module
+        return fn
+
+    return deco
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+
+
+def get_gpu_count():
+    return 0
+
+
+def get_gpu_memory(dev_id=0):
+    raise RuntimeError("no CUDA GPUs in the TPU build")
+
+
+def default_array(source, ctx=None, dtype=None):
+    from .ndarray import array
+
+    return array(source, ctx=ctx, dtype=dtype)

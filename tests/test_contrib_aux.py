@@ -1,0 +1,156 @@
+"""Contrib ops (multibox/NMS/roi_align) + aux modules (profiler, runtime,
+amp, image) — reference test_contrib_*.py analogs."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_prior():
+    x = nd.ones((1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()[0]
+    # centers inside [0,1]; first anchor centered at (0.125, 0.125)
+    cx = (a[0, 0] + a[0, 2]) / 2
+    cy = (a[0, 1] + a[0, 3]) / 2
+    np.testing.assert_allclose([cx, cy], [0.125, 0.125], atol=1e-6)
+    np.testing.assert_allclose(a[0, 2] - a[0, 0], 0.5, atol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # rows: [id, score, l, t, r, b]
+    boxes = np.array([[0, 0.9, 0.0, 0.0, 0.5, 0.5],
+                      [0, 0.8, 0.05, 0.05, 0.55, 0.55],   # overlaps first
+                      [0, 0.7, 0.6, 0.6, 0.9, 0.9],       # separate
+                      [0, 0.0, 0.0, 0.0, 0.1, 0.1]],      # below valid_thresh
+                     np.float32)
+    out = nd.contrib.box_nms(nd.array(boxes[None]), overlap_thresh=0.5,
+                             valid_thresh=0.01).asnumpy()[0]
+    scores = out[:, 1]
+    kept = scores[scores > 0]
+    assert len(kept) == 2
+    np.testing.assert_allclose(sorted(kept, reverse=True), [0.9, 0.7], atol=1e-6)
+
+
+def test_box_nms_per_class():
+    boxes = np.array([[0, 0.9, 0.0, 0.0, 0.5, 0.5],
+                      [1, 0.8, 0.0, 0.0, 0.5, 0.5]], np.float32)  # same box, diff class
+    out = nd.contrib.box_nms(nd.array(boxes[None]), overlap_thresh=0.5,
+                             id_index=0, force_suppress=False).asnumpy()[0]
+    assert (out[:, 1] > 0).sum() == 2  # both kept per-class
+    out2 = nd.contrib.box_nms(nd.array(boxes[None]), overlap_thresh=0.5,
+                              id_index=0, force_suppress=True).asnumpy()[0]
+    assert (out2[:, 1] > 0).sum() == 1
+
+
+def test_multibox_target_matching():
+    anchors = np.array([[0.0, 0.0, 0.5, 0.5],
+                        [0.5, 0.5, 1.0, 1.0],
+                        [0.0, 0.5, 0.5, 1.0]], np.float32)
+    # one gt box matching anchor 0 exactly
+    label = np.array([[[1.0, 0.0, 0.0, 0.5, 0.5],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array(anchors[None]), nd.array(label), nd.array(cls_pred))
+    cls = cls_t.asnumpy()[0]
+    assert cls[0] == 2.0  # class 1 + 1 (0 is background)
+    assert cls[1] == 0.0
+    m = loc_m.asnumpy()[0].reshape(3, 4)
+    assert m[0].all() and not m[1].any()
+    # exact match -> zero offsets
+    np.testing.assert_allclose(loc_t.asnumpy()[0][:4], 0.0, atol=1e-5)
+
+
+def test_multibox_detection_decode():
+    anchors = np.array([[0.1, 0.1, 0.3, 0.3],
+                        [0.6, 0.6, 0.9, 0.9]], np.float32)
+    cls_prob = np.array([[[0.1, 0.8], [0.9, 0.2]]], np.float32)  # (1,C=2,N=2)
+    loc_pred = np.zeros((1, 8), np.float32)  # zero offsets -> anchors
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                       nd.array(anchors[None]))
+    o = out.asnumpy()[0]
+    valid = o[o[:, 0] >= 0]
+    assert valid.shape[0] == 2  # both pass the 0.01 threshold, no overlap
+    best = valid[np.argmax(valid[:, 1])]
+    np.testing.assert_allclose(best[1], 0.9, atol=1e-5)  # class-1 prob of anchor 0
+    np.testing.assert_allclose(best[2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_roi_align_shapes_and_values():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    v = out.asnumpy()[0, 0]
+    assert v[0, 0] < v[1, 1]  # increasing values preserved
+
+
+def test_boolean_mask_compacts():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([1, 0, 1, 0], np.float32))
+    out = nd.contrib.boolean_mask(data, idx).asnumpy()
+    np.testing.assert_allclose(out[0], [0, 1, 2])
+    np.testing.assert_allclose(out[1], [6, 7, 8])
+    np.testing.assert_allclose(out[2:], 0.0)
+
+
+def test_runtime_features():
+    feats = mx.runtime.feature_list()
+    d = {f.name: f.enabled for f in feats}
+    assert d["XLA"] and d["CPU"]
+    assert not d["CUDA"]
+    assert mx.runtime.Features().is_enabled("PJIT")
+
+
+def test_amp_convert_and_loss_scaler():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.BatchNorm(in_channels=4))
+    net.initialize()
+    mx.amp.init()
+    mx.amp.convert_hybrid_block(net)
+    dtypes = {p.name: p.data().dtype for p in net.collect_params().values()}
+    assert any(str(d) == "bfloat16" for d in dtypes.values())
+    # BN stats stay fp32
+    for name, d in dtypes.items():
+        if "running" in name or "gamma" in name or "beta" in name:
+            assert str(d) == "float32"
+    s = mx.amp.LossScaler()
+    s.update_scale(skip=True)
+    s.update_scale(skip=False)
+    assert s.loss_scale > 0
+
+
+def test_image_api(tmp_path):
+    img = np.random.RandomState(0).randint(0, 255, (20, 30, 3)).astype(np.uint8)
+    from PIL import Image
+
+    p = str(tmp_path / "t.png")
+    Image.fromarray(img).save(p)
+    loaded = mx.image.imread(p)
+    assert loaded.shape == (20, 30, 3)
+    r = mx.image.imresize(loaded, 15, 10)
+    assert r.shape == (10, 15, 3)
+    c, _ = mx.image.center_crop(loaded, (10, 10))
+    assert c.shape == (10, 10, 3)
+    augs = mx.image.CreateAugmenter((3, 8, 8), rand_mirror=True, mean=True, std=True)
+    out = loaded
+    for a in augs:
+        out = a(out)
+    assert out.shape[0] == 8 or out.shape == (8, 8, 3)
+
+
+def test_profiler_api(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "prof"))
+    mx.profiler.set_state("run")
+    (nd.ones((4, 4)) * 2).wait_to_read()
+    mx.profiler.set_state("stop")
+    d = mx.profiler.dump()
+    import os
+
+    assert d and os.path.isdir(d)
