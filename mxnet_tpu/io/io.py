@@ -296,6 +296,9 @@ class ImageRecordIter(DataIter):
         self.cursor = 0
         if shuffle:
             np.random.shuffle(self._order)
+        import threading
+
+        self._read_lock = threading.Lock()  # seek+read on the shared handle
 
     @property
     def provide_data(self):
@@ -313,8 +316,9 @@ class ImageRecordIter(DataIter):
             np.random.shuffle(self._order)
 
     def _load_one(self, offset):
-        self._rec.record.seek(offset)
-        blob = self._rec.read()
+        with self._read_lock:  # decode below stays parallel; IO is serialized
+            self._rec.record.seek(offset)
+            blob = self._rec.read()
         header, img = self._unpack_img(blob, iscolor=1)  # HWC uint8
         c, h, w = self.data_shape
         if self._resize > 0:
