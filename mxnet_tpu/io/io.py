@@ -299,6 +299,13 @@ class ImageRecordIter(DataIter):
         import threading
 
         self._read_lock = threading.Lock()  # seek+read on the shared handle
+        self._path = path_imgrec
+        self._native = None
+        if not kwargs.get("no_native"):
+            from ..native import io_lib
+
+            self._native = io_lib()  # C++ decode pipeline when built
+        self._seed_counter = 0
 
     @property
     def provide_data(self):
@@ -344,17 +351,49 @@ class ImageRecordIter(DataIter):
             raise StopIteration
         idxs = self._order[self.cursor:self.cursor + self.batch_size]
         self.cursor += self.batch_size
+        offsets = [int(self._offsets[i]) for i in idxs]
+        if self._native is not None:
+            try:
+                return self._next_native(offsets)
+            except RuntimeError:
+                self._native = None  # e.g. PNG records → PIL fallback
         import concurrent.futures as cf
 
         if self._threads > 1:
             with cf.ThreadPoolExecutor(self._threads) as pool:
-                results = list(pool.map(self._load_one,
-                                        [self._offsets[i] for i in idxs]))
+                results = list(pool.map(self._load_one, offsets))
         else:
-            results = [self._load_one(self._offsets[i]) for i in idxs]
+            results = [self._load_one(o) for o in offsets]
         data = np.stack([r[0] for r in results])
         label = np.stack([r[1] for r in results])
         return DataBatch([nd_array(data)], [nd_array(label)], 0, None)
+
+    def _next_native(self, offsets):
+        """Batch decode through the C++ pipeline (native/io/recordio_jpeg.cc)."""
+        import ctypes
+
+        bs = len(offsets)
+        c, h, w = self.data_shape
+        data = np.empty((bs, 3, h, w), np.float32)
+        labels = np.empty((bs, self.label_width), np.float32)
+        offs = (ctypes.c_int64 * bs)(*offsets)
+        mean = (ctypes.c_float * 3)(*self._mean.ravel())
+        std = (ctypes.c_float * 3)(*self._std.ravel())
+        self._seed_counter += 1
+        seed = int(np.random.randint(0, 2 ** 31)) if (self._rand_crop or
+                                                      self._rand_mirror) else \
+            self._seed_counter
+        fails = self._native.mxtpu_decode_batch(
+            self._path.encode(), offs, bs, h, w, int(self._resize),
+            int(bool(self._rand_crop)), int(bool(self._rand_mirror)),
+            ctypes.c_uint64(seed), mean, std,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self.label_width, self._threads)
+        if fails:
+            raise RuntimeError(f"native decode failed for {fails} records")
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch([nd_array(data)], [nd_array(lab)], 0, None)
 
 
 def _resize_short(img, size):

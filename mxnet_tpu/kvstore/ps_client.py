@@ -33,10 +33,19 @@ class PSClient:
         return _unpack_array(payload)
 
     def set_optimizer(self, optimizer):
-        spec = {"name": type(optimizer).__name__.lower(),
-                "kwargs": {"learning_rate": optimizer.lr, "wd": optimizer.wd,
-                           "rescale_grad": optimizer.rescale_grad}}
-        self._rpc(OP_SET_OPT, "", pickle.dumps(spec))
+        # text wire format shared with the C++ server (native/ps/ps_server.cc)
+        name = type(optimizer).__name__.lower()
+        kwargs = {"learning_rate": optimizer.lr, "wd": optimizer.wd,
+                  "rescale_grad": optimizer.rescale_grad}
+        mom = getattr(optimizer, "momentum", None)
+        if mom:
+            kwargs["momentum"] = mom
+        for k in ("beta1", "beta2", "epsilon"):
+            v = getattr(optimizer, k, None)
+            if v is not None:
+                kwargs[k] = v
+        spec = name + " " + " ".join(f"{k}={v}" for k, v in kwargs.items())
+        self._rpc(OP_SET_OPT, "", spec.encode("ascii"))
 
     def barrier(self):
         self._rpc(OP_BARRIER)

@@ -168,10 +168,22 @@ class PSServer:
             return
 
     def _set_optimizer_bytes(self, blob: bytes):
+        """SET_OPT payload is text: ``name key=val key=val …`` — a format the
+        C++ server (native/ps/ps_server.cc) parses too. Legacy pickle blobs
+        still accepted."""
         from ..optimizer import Updater, create as opt_create
 
-        spec = pickle.loads(blob)
-        opt = opt_create(spec["name"], **spec["kwargs"])
+        try:
+            text = blob.decode("ascii")
+            parts = text.split()
+            name, kwargs = parts[0], {}
+            for kv in parts[1:]:
+                k, _, v = kv.partition("=")
+                kwargs[k] = float(v)
+        except (UnicodeDecodeError, ValueError, IndexError):
+            spec = pickle.loads(blob)
+            name, kwargs = spec["name"], spec["kwargs"]
+        opt = opt_create(name, **kwargs)
         self._updater = Updater(opt)
 
     def _apply(self, key, grad, weight_np):

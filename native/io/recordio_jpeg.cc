@@ -1,0 +1,239 @@
+// libmxtpu_io — native RecordIO + JPEG batch decode pipeline.
+//
+// Reference counterpart: the C++ threaded data pipeline in src/io/
+// (iter_image_recordio_2.cc: RecordIO read → OpenCV JPEG decode → augment →
+// batch — TBV, SURVEY.md §2.1 L8). Same job here with libjpeg + a thread
+// pool, emitting normalized CHW float32 ready for the host→device transfer.
+// Exposed as a C ABI consumed via ctypes (mxnet_tpu/native.py).
+//
+// Build: g++ -O2 -std=c++17 -fPIC -shared -pthread recordio_jpeg.cc -ljpeg
+//        -o libmxtpu_io.so
+#include <stdio.h>  // must precede jpeglib.h (it uses FILE unqualified)
+#include <stdint.h>
+#include <string.h>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xCED7230A;
+
+struct JErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jerr_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JErr*>(cinfo->err)->jb, 1);
+}
+
+// Decode JPEG bytes to RGB HWC uint8. Returns false on failure.
+bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
+                int* h, int* w) {
+  jpeg_decompress_struct cinfo;
+  JErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jerr_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(size_t(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + size_t(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear resize HWC uint8 RGB.
+void Resize(const std::vector<uint8_t>& src, int sh, int sw,
+            std::vector<uint8_t>* dst, int dh, int dw) {
+  dst->resize(size_t(dh) * dw * 3);
+  float ry = dh > 1 ? float(sh - 1) / (dh - 1) : 0.f;
+  float rx = dw > 1 ? float(sw - 1) / (dw - 1) : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = y * ry;
+    int y0 = int(fy);
+    int y1 = std::min(y0 + 1, sh - 1);
+    float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = x * rx;
+      int x0 = int(fx);
+      int x1 = std::min(x0 + 1, sw - 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(size_t(y0) * sw + x0) * 3 + c];
+        float v01 = src[(size_t(y0) * sw + x1) * 3 + c];
+        float v10 = src[(size_t(y1) * sw + x0) * 3 + c];
+        float v11 = src[(size_t(y1) * sw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        (*dst)[(size_t(y) * dw + x) * 3 + c] = uint8_t(v + 0.5f);
+      }
+    }
+  }
+}
+
+struct Task {
+  int64_t offset;
+  int index;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Decode a batch of image records. Returns number of failures (0 = clean).
+// out_data: n * 3 * out_h * out_w floats (CHW, normalized (x-mean)/std)
+// out_labels: n * label_width floats
+int mxtpu_decode_batch(const char* path, const int64_t* offsets, int n,
+                       int out_h, int out_w, int resize_short, int rand_crop,
+                       int rand_mirror, uint64_t seed, const float* mean,
+                       const float* stdv, float* out_data, float* out_labels,
+                       int label_width, int num_threads) {
+  std::atomic<int> failures{0};
+  int nthreads = std::max(1, std::min(num_threads, n));
+  std::vector<std::thread> workers;
+  std::atomic<int> next{0};
+
+  auto work = [&]() {
+    FILE* f = fopen(path, "rb");
+    if (!f) {
+      failures.fetch_add(1);
+      return;
+    }
+    std::vector<uint8_t> record, pixels, resized;
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) break;
+      std::mt19937_64 rng(seed * 1000003ull + uint64_t(i));
+      // --- read record
+      if (fseek(f, long(offsets[i]), SEEK_SET) != 0) { failures++; continue; }
+      uint32_t hdr[2];
+      if (fread(hdr, 4, 2, f) != 2 || hdr[0] != kMagic) { failures++; continue; }
+      uint32_t len = hdr[1] & ((1u << 29) - 1);
+      record.resize(len);
+      if (fread(record.data(), 1, len, f) != len) { failures++; continue; }
+      // --- IRHeader: u32 flag, f32 label, u64 id, u64 id2
+      if (len < 24) { failures++; continue; }
+      uint32_t flag;
+      float scalar_label;
+      memcpy(&flag, record.data(), 4);
+      memcpy(&scalar_label, record.data() + 4, 4);
+      size_t off = 24;
+      float* lab_dst = out_labels + size_t(i) * label_width;
+      if (flag > 0) {
+        for (int k = 0; k < label_width; ++k) {
+          float v = 0.f;
+          if (uint32_t(k) < flag) memcpy(&v, record.data() + off + 4ull * k, 4);
+          lab_dst[k] = v;
+        }
+        off += 4ull * flag;
+      } else {
+        lab_dst[0] = scalar_label;
+        for (int k = 1; k < label_width; ++k) lab_dst[k] = 0.f;
+      }
+      // --- decode
+      int h, w;
+      if (!DecodeJpeg(record.data() + off, len - off, &pixels, &h, &w)) {
+        failures++;
+        continue;
+      }
+      const std::vector<uint8_t>* img = &pixels;
+      // --- resize shorter side
+      if (resize_short > 0) {
+        int nh, nw;
+        if (h < w) { nh = resize_short; nw = int(float(w) * resize_short / h); }
+        else { nw = resize_short; nh = int(float(h) * resize_short / w); }
+        Resize(pixels, h, w, &resized, nh, nw);
+        img = &resized;
+        h = nh;
+        w = nw;
+      }
+      if (h < out_h || w < out_w) {  // upsample if still too small
+        std::vector<uint8_t> up;
+        int nh = std::max(h, out_h), nw = std::max(w, out_w);
+        Resize(*img, h, w, &up, nh, nw);
+        resized = std::move(up);
+        img = &resized;
+        h = nh;
+        w = nw;
+      }
+      // --- crop
+      int y0, x0;
+      if (rand_crop) {
+        y0 = int(rng() % uint64_t(h - out_h + 1));
+        x0 = int(rng() % uint64_t(w - out_w + 1));
+      } else {
+        y0 = (h - out_h) / 2;
+        x0 = (w - out_w) / 2;
+      }
+      bool mirror = rand_mirror && (rng() & 1);
+      // --- normalize + CHW
+      float* dst = out_data + size_t(i) * 3 * out_h * out_w;
+      for (int c = 0; c < 3; ++c) {
+        float m = mean ? mean[c] : 0.f;
+        float s = stdv ? stdv[c] : 1.f;
+        float inv = s != 0.f ? 1.f / s : 1.f;
+        for (int y = 0; y < out_h; ++y) {
+          const uint8_t* row = img->data() + ((size_t(y0) + y) * w + x0) * 3;
+          float* orow = dst + (size_t(c) * out_h + y) * out_w;
+          if (mirror) {
+            for (int x = 0; x < out_w; ++x)
+              orow[x] = (float(row[(out_w - 1 - x) * 3 + c]) - m) * inv;
+          } else {
+            for (int x = 0; x < out_w; ++x)
+              orow[x] = (float(row[x * 3 + c]) - m) * inv;
+          }
+        }
+      }
+    }
+    fclose(f);
+  };
+
+  for (int t = 0; t < nthreads; ++t) workers.emplace_back(work);
+  for (auto& t : workers) t.join();
+  return failures.load();
+}
+
+// Scan a RecordIO file for record offsets. Returns count, or -1 on error.
+// Caller provides capacity; call with offsets=nullptr to count only.
+int64_t mxtpu_scan_offsets(const char* path, int64_t* offsets,
+                           int64_t capacity) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  int64_t count = 0;
+  for (;;) {
+    long pos = ftell(f);
+    uint32_t hdr[2];
+    if (fread(hdr, 4, 2, f) != 2) break;
+    if (hdr[0] != kMagic) { fclose(f); return -1; }
+    uint32_t len = hdr[1] & ((1u << 29) - 1);
+    uint32_t padded = len + ((4 - len % 4) % 4);
+    if (offsets && count < capacity) offsets[count] = pos;
+    ++count;
+    if (fseek(f, long(padded), SEEK_CUR) != 0) break;
+  }
+  fclose(f);
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,320 @@
+// mxtpu_ps_server — native async parameter server (dist_async transport).
+//
+// Reference counterpart: ps-lite's KVServer + ZMQ van (3rdparty/ps-lite —
+// TBV, SURVEY.md §3.4). The reference runs the optimizer server-side on
+// every push with no worker barrier; this server does the same over plain
+// TCP with the wire protocol shared with mxnet_tpu/kvstore/ps_server.py:
+//
+//   frame:   u32 total_len | u8 opcode | u16 key_len | key | payload
+//   array:   u8 ndim | u32*ndim shape | u8 dtype_code | raw bytes
+//   opcodes: 0=INIT 1=PUSH 2=PULL 3=SET_OPT 4=BARRIER 5=SHUTDOWN
+//   SET_OPT payload (text): "sgd learning_rate=0.1 momentum=0.9 wd=0 ..."
+//
+// f32 only (dtype code 0) — the Python server handles exotic dtypes.
+// Build: g++ -O2 -std=c++17 -pthread ps_server.cc -o mxtpu_ps_server
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { INIT = 0, PUSH = 1, PULL = 2, SET_OPT = 3, BARRIER = 4,
+                    SHUTDOWN = 5 };
+
+struct Entry {
+  std::vector<uint32_t> shape;
+  std::vector<float> weight;
+  std::vector<float> mom;     // sgd momentum / adam m
+  std::vector<float> var;     // adam v
+  int64_t t = 0;              // adam step
+  std::mutex mu;
+};
+
+struct Optimizer {
+  std::string name = "";      // "", "sgd", "adam"
+  float lr = 0.01f, momentum = 0.f, wd = 0.f, rescale_grad = 1.f;
+  float beta1 = 0.9f, beta2 = 0.999f, epsilon = 1e-8f;
+  float clip_gradient = -1.f;
+};
+
+class Server {
+ public:
+  Server(int port, int num_workers) : num_workers_(num_workers) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      perror("bind");
+      exit(1);
+    }
+    listen(fd_, 64);
+    socklen_t len = sizeof(addr);
+    getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+  }
+
+  int port() const { return port_; }
+
+  void Run() {
+    printf("mxtpu_ps_server listening on :%d\n", port_);
+    fflush(stdout);
+    while (!stop_.load()) {
+      int conn = accept(fd_, nullptr, nullptr);
+      if (conn < 0) break;
+      int one = 1;
+      setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::thread(&Server::Handle, this, conn).detach();
+    }
+  }
+
+ private:
+  static bool RecvExact(int fd, void* buf, size_t n) {
+    auto* p = static_cast<uint8_t*>(buf);
+    while (n) {
+      ssize_t r = recv(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool SendAll(int fd, const void* buf, size_t n) {
+    auto* p = static_cast<const uint8_t*>(buf);
+    while (n) {
+      ssize_t r = send(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool SendMsg(int fd, uint8_t op, const std::string& key,
+                      const std::string& payload) {
+    uint32_t body_len = static_cast<uint32_t>(3 + key.size() + payload.size());
+    std::string out;
+    out.reserve(4 + body_len);
+    uint32_t len_le = body_len;  // x86: little-endian already
+    out.append(reinterpret_cast<char*>(&len_le), 4);
+    out.push_back(static_cast<char>(op));
+    uint16_t klen = static_cast<uint16_t>(key.size());
+    out.append(reinterpret_cast<char*>(&klen), 2);
+    out.append(key);
+    out.append(payload);
+    return SendAll(fd, out.data(), out.size());
+  }
+
+  void Handle(int conn) {
+    std::vector<uint8_t> body;
+    while (true) {
+      uint32_t len;
+      if (!RecvExact(conn, &len, 4)) break;
+      body.resize(len);
+      if (!RecvExact(conn, body.data(), len)) break;
+      uint8_t op = body[0];
+      uint16_t klen;
+      memcpy(&klen, body.data() + 1, 2);
+      std::string key(reinterpret_cast<char*>(body.data() + 3), klen);
+      const uint8_t* payload = body.data() + 3 + klen;
+      size_t payload_len = len - 3 - klen;
+
+      if (op == INIT) {
+        Entry* e = GetEntry(key, true);
+        std::lock_guard<std::mutex> lk(e->mu);
+        if (e->weight.empty()) ParseArray(payload, payload_len, e);
+        SendMsg(conn, INIT, key, std::string("\x00", 1));
+      } else if (op == PUSH) {
+        Entry* e = GetEntry(key, false);
+        if (!e) { SendMsg(conn, PUSH, key, std::string("\x01", 1)); continue; }
+        std::lock_guard<std::mutex> lk(e->mu);
+        ApplyPush(e, payload, payload_len);
+        SendMsg(conn, PUSH, key, std::string("\x00", 1));
+      } else if (op == PULL) {
+        Entry* e = GetEntry(key, false);
+        if (!e) { SendMsg(conn, PULL, key, ""); continue; }
+        std::string out;
+        {
+          std::lock_guard<std::mutex> lk(e->mu);
+          out = PackArray(*e);
+        }
+        SendMsg(conn, PULL, key, out);
+      } else if (op == SET_OPT) {
+        ParseOptimizer(std::string(reinterpret_cast<const char*>(payload),
+                                   payload_len));
+        SendMsg(conn, SET_OPT, key, std::string("\x00", 1));
+      } else if (op == BARRIER) {
+        {
+          std::unique_lock<std::mutex> lk(barrier_mu_);
+          if (++barrier_count_ >= num_workers_) {
+            barrier_count_ = 0;
+            barrier_cv_.notify_all();
+          } else {
+            barrier_cv_.wait_for(lk, std::chrono::seconds(60));
+          }
+        }
+        SendMsg(conn, BARRIER, key, std::string("\x00", 1));
+      } else if (op == SHUTDOWN) {
+        SendMsg(conn, SHUTDOWN, key, std::string("\x00", 1));
+        stop_.store(true);
+        shutdown(fd_, SHUT_RDWR);
+        close(conn);
+        return;
+      }
+    }
+    close(conn);
+  }
+
+  Entry* GetEntry(const std::string& key, bool create) {
+    std::lock_guard<std::mutex> lk(map_mu_);
+    auto it = entries_.find(key);
+    if (it == entries_.end()) {
+      if (!create) return nullptr;
+      it = entries_.emplace(std::piecewise_construct,
+                            std::forward_as_tuple(key),
+                            std::forward_as_tuple()).first;
+    }
+    return &it->second;
+  }
+
+  static size_t ParseHeader(const uint8_t* p, std::vector<uint32_t>* shape) {
+    uint8_t ndim = p[0];
+    shape->resize(ndim);
+    memcpy(shape->data(), p + 1, 4ull * ndim);
+    return 1 + 4ull * ndim + 1;  // + dtype byte (assumed f32 = code 0)
+  }
+
+  static void ParseArray(const uint8_t* p, size_t n, Entry* e) {
+    size_t off = ParseHeader(p, &e->shape);
+    size_t count = (n - off) / 4;
+    e->weight.resize(count);
+    memcpy(e->weight.data(), p + off, count * 4);
+  }
+
+  void ApplyPush(Entry* e, const uint8_t* p, size_t n) {
+    std::vector<uint32_t> shape;
+    size_t off = ParseHeader(p, &shape);
+    const float* g = reinterpret_cast<const float*>(p + off);
+    size_t count = (n - off) / 4;
+    if (count != e->weight.size()) return;
+    Optimizer o;
+    {
+      std::lock_guard<std::mutex> lk(opt_mu_);
+      o = opt_;
+    }
+    float* w = e->weight.data();
+    if (o.name.empty()) {  // aggregate-only mode (no optimizer installed)
+      for (size_t i = 0; i < count; ++i) w[i] += g[i];
+      return;
+    }
+    auto clip = [&](float x) {
+      if (o.clip_gradient > 0) {
+        if (x > o.clip_gradient) return o.clip_gradient;
+        if (x < -o.clip_gradient) return -o.clip_gradient;
+      }
+      return x;
+    };
+    if (o.name == "adam") {
+      if (e->mom.size() != count) e->mom.assign(count, 0.f);
+      if (e->var.size() != count) e->var.assign(count, 0.f);
+      e->t += 1;
+      float corr = std::sqrt(1.f - std::pow(o.beta2, float(e->t))) /
+                   (1.f - std::pow(o.beta1, float(e->t)));
+      float lr = o.lr * corr;
+      for (size_t i = 0; i < count; ++i) {
+        float gi = clip(g[i] * o.rescale_grad) + o.wd * w[i];
+        e->mom[i] = o.beta1 * e->mom[i] + (1 - o.beta1) * gi;
+        e->var[i] = o.beta2 * e->var[i] + (1 - o.beta2) * gi * gi;
+        w[i] -= lr * e->mom[i] / (std::sqrt(e->var[i]) + o.epsilon);
+      }
+    } else {  // sgd (+momentum)
+      if (o.momentum != 0.f && e->mom.size() != count) e->mom.assign(count, 0.f);
+      for (size_t i = 0; i < count; ++i) {
+        float gi = clip(g[i] * o.rescale_grad) + o.wd * w[i];
+        if (o.momentum != 0.f) {
+          e->mom[i] = o.momentum * e->mom[i] - o.lr * gi;
+          w[i] += e->mom[i];
+        } else {
+          w[i] -= o.lr * gi;
+        }
+      }
+    }
+  }
+
+  static std::string PackArray(const Entry& e) {
+    std::string out;
+    uint8_t ndim = static_cast<uint8_t>(e.shape.size());
+    out.push_back(static_cast<char>(ndim));
+    out.append(reinterpret_cast<const char*>(e.shape.data()), 4ull * ndim);
+    out.push_back(0);  // dtype code 0 = float32
+    out.append(reinterpret_cast<const char*>(e.weight.data()),
+               e.weight.size() * 4);
+    return out;
+  }
+
+  void ParseOptimizer(const std::string& spec) {
+    std::lock_guard<std::mutex> lk(opt_mu_);
+    Optimizer o;
+    std::istringstream ss(spec);
+    ss >> o.name;
+    std::string kv;
+    while (ss >> kv) {
+      auto eq = kv.find('=');
+      if (eq == std::string::npos) continue;
+      std::string k = kv.substr(0, eq);
+      float v = std::strtof(kv.c_str() + eq + 1, nullptr);
+      if (k == "learning_rate" || k == "lr") o.lr = v;
+      else if (k == "momentum") o.momentum = v;
+      else if (k == "wd") o.wd = v;
+      else if (k == "rescale_grad") o.rescale_grad = v;
+      else if (k == "beta1") o.beta1 = v;
+      else if (k == "beta2") o.beta2 = v;
+      else if (k == "epsilon") o.epsilon = v;
+      else if (k == "clip_gradient") o.clip_gradient = v;
+    }
+    opt_ = o;
+  }
+
+  int fd_;
+  int port_;
+  int num_workers_;
+  std::atomic<bool> stop_{false};
+  std::map<std::string, Entry> entries_;
+  std::mutex map_mu_;
+  Optimizer opt_;
+  std::mutex opt_mu_;
+  std::mutex barrier_mu_;
+  std::condition_variable barrier_cv_;
+  int barrier_count_ = 0;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 9091, num_workers = 1;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--num-workers")) num_workers = atoi(argv[i + 1]);
+  }
+  Server s(port, num_workers);
+  s.Run();
+  return 0;
+}

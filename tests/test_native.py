@@ -1,0 +1,137 @@
+"""Native C++ component tests: IO decode pipeline + PS server binary.
+
+Pattern follows the reference's known-value dist kvstore nightly tests
+(SURVEY.md §4: workers push known values, expected aggregate asserted).
+"""
+import os
+import socket
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io.io import ImageRecordIter
+from mxnet_tpu.io.recordio import IRHeader, MXIndexedRecordIO, pack_img
+from mxnet_tpu.native import io_lib, ps_server_binary
+
+
+def _make_rec(tmp_path, n=8, size=40):
+    uri = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = MXIndexedRecordIO(idx, uri, "w")
+    rng = np.random.RandomState(0)
+    imgs = []
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3)).astype(np.uint8)
+        imgs.append(img)
+        w.write_idx(i, pack_img(IRHeader(0, float(i % 3), i, 0), img,
+                                quality=95))
+    w.close()
+    return uri, imgs
+
+
+@pytest.mark.skipif(io_lib() is None, reason="native io lib not built")
+def test_native_scan_offsets(tmp_path):
+    import ctypes
+
+    uri, _ = _make_rec(tmp_path, n=5)
+    lib = io_lib()
+    count = lib.mxtpu_scan_offsets(uri.encode(), None, 0)
+    assert count == 5
+    offs = (ctypes.c_int64 * 5)()
+    assert lib.mxtpu_scan_offsets(uri.encode(), offs, 5) == 5
+    assert offs[0] == 0 and all(offs[i] < offs[i + 1] for i in range(4))
+
+
+@pytest.mark.skipif(io_lib() is None, reason="native io lib not built")
+def test_native_decode_matches_pil(tmp_path):
+    uri, imgs = _make_rec(tmp_path, n=8)
+    common = dict(path_imgrec=uri, data_shape=(3, 32, 32), batch_size=4,
+                  shuffle=False, rand_crop=False, rand_mirror=False)
+    nat = ImageRecordIter(**common)
+    assert nat._native is not None
+    ref = ImageRecordIter(no_native=True, **common)
+    assert ref._native is None
+    b_nat = nat.next()
+    b_ref = ref.next()
+    np.testing.assert_array_equal(b_nat.label[0].asnumpy(),
+                                  b_ref.label[0].asnumpy())
+    # JPEG decoders (libjpeg vs PIL) may differ by ±1 LSB per pixel
+    d_nat = b_nat.data[0].asnumpy()
+    d_ref = b_ref.data[0].asnumpy()
+    assert d_nat.shape == d_ref.shape == (4, 3, 32, 32)
+    assert np.abs(d_nat - d_ref).max() <= 2.0
+    assert np.abs(d_nat - d_ref).mean() < 0.5
+
+
+@pytest.mark.skipif(io_lib() is None, reason="native io lib not built")
+def test_native_decode_with_augment_and_norm(tmp_path):
+    uri, _ = _make_rec(tmp_path, n=4, size=48)
+    it = ImageRecordIter(path_imgrec=uri, data_shape=(3, 32, 32), batch_size=4,
+                         rand_crop=True, rand_mirror=True, resize=40,
+                         mean_r=123.0, mean_g=116.0, mean_b=103.0,
+                         std_r=58.0, std_g=57.0, std_b=57.0)
+    b = it.next()
+    d = b.data[0].asnumpy()
+    assert d.shape == (4, 3, 32, 32)
+    assert np.isfinite(d).all()
+    assert -5 < d.mean() < 5  # normalized range
+
+
+@pytest.mark.skipif(ps_server_binary() is None, reason="ps server not built")
+def test_native_ps_server_known_values():
+    from mxnet_tpu.kvstore.ps_client import PSClient
+
+    binary = ps_server_binary()
+    proc = subprocess.Popen([binary, "--port", "0"], stdout=subprocess.PIPE,
+                            text=True)
+    try:
+        line = proc.stdout.readline()
+        port = int(line.strip().rsplit(":", 1)[1])
+        cli = PSClient("127.0.0.1", port)
+        w0 = np.arange(6, dtype=np.float32).reshape(2, 3)
+        cli.init("w", w0)
+        np.testing.assert_allclose(cli.pull("w"), w0)
+        # aggregate-only mode: pushes sum into the weight
+        cli.push("w", np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(cli.pull("w"), w0 + 1)
+        # install sgd and verify the server-side update: w -= lr * grad
+        from mxnet_tpu.optimizer import create as opt_create
+
+        cli.set_optimizer(opt_create("sgd", learning_rate=0.5))
+        g = np.full((2, 3), 2.0, np.float32)
+        cli.push("w", g)
+        np.testing.assert_allclose(cli.pull("w"), w0 + 1 - 0.5 * 2.0,
+                                   rtol=1e-6)
+        cli.shutdown()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.skipif(ps_server_binary() is None, reason="ps server not built")
+def test_native_ps_server_adam_converges():
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.optimizer import create as opt_create
+
+    binary = ps_server_binary()
+    proc = subprocess.Popen([binary, "--port", "0"], stdout=subprocess.PIPE,
+                            text=True)
+    try:
+        port = int(proc.stdout.readline().strip().rsplit(":", 1)[1])
+        cli = PSClient("127.0.0.1", port)
+        target = np.array([1.0, -2.0, 3.0], np.float32)
+        w = np.zeros(3, np.float32)
+        cli.init("w", w)
+        cli.set_optimizer(opt_create("adam", learning_rate=0.1))
+        for _ in range(200):
+            w = cli.pull("w")
+            cli.push("w", w - target)  # grad of 0.5||w-t||^2
+        w = cli.pull("w")
+        np.testing.assert_allclose(w, target, atol=0.05)
+        cli.shutdown()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
