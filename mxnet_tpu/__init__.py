@@ -64,6 +64,12 @@ def __getattr__(name):
         "models": ".models",
         "contrib": ".contrib",
         "util": ".util",
+        "np": ".numpy",
+        "npx": ".numpy_extension",
+        "operator": ".operator",
+        "monitor": ".monitor",
+        "mon": ".monitor",
+        "native": ".native",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

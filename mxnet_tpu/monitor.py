@@ -1,0 +1,86 @@
+"""``mx.monitor.Monitor`` — tap intermediate outputs during training.
+
+Reference: ``python/mxnet/monitor.py`` (executor output callback — TBV,
+SURVEY.md §5.5). Here the tap installs over Executor forward results and
+Gluon forward hooks.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as np
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(x: np.ndarray):
+    return np.abs(x).mean()
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = interval
+        self.stat_func = stat_func or (lambda x: _default_stat(x.asnumpy()
+                                                               if isinstance(x, NDArray)
+                                                               else np.asarray(x)))
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue = []
+        self._gluon_handles = []
+
+    # -- symbolic path ---------------------------------------------------
+    def install(self, exe):
+        """Attach to an Executor: stats collected from outputs each toc."""
+        exe._monitor = self
+        return exe
+
+    def install_gluon(self, block):
+        """Attach forward hooks to every child of a Gluon block."""
+
+        def hook(blk, inputs, output):
+            if not self.activated:
+                return
+            name = blk.name
+            if self.pattern.match(name):
+                outs = output if isinstance(output, (list, tuple)) else [output]
+                for i, o in enumerate(outs):
+                    if isinstance(o, NDArray):
+                        self.queue.append((self.step, f"{name}_output{i}",
+                                           self.stat_func(o)))
+
+        def walk(b):
+            b.register_forward_hook(hook)
+            for c in b._children.values():
+                walk(c)
+
+        walk(block)
+        return block
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def toc(self, exe=None):
+        if not self.activated:
+            return []
+        if exe is not None:
+            for name, out in zip(exe._symbol.list_outputs(), exe.outputs):
+                if self.pattern.match(name):
+                    self.queue.append((self.step, name, self.stat_func(out)))
+        self.activated = False
+        res = list(self.queue)
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.queue = []
+        return res
+
+    def toc_print(self, exe=None):
+        for step, name, value in self.toc(exe):
+            logging.info("Batch: %7d %30s %s", step, name, value)

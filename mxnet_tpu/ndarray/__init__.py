@@ -38,11 +38,11 @@ def _make_dispatcher(name: str):
 
 
 def __getattr__(name: str):
-    if name == "contrib":
+    if name in ("contrib", "sparse"):
         import importlib
 
-        mod = importlib.import_module(".contrib", __name__)
-        globals()["contrib"] = mod
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
         return mod
     if has_op(name):
         fn = _make_dispatcher(name)

@@ -5,6 +5,7 @@ bare-name registration)."""
 from __future__ import annotations
 
 from ..ops import has_op
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 from . import _make_dispatcher
 
 

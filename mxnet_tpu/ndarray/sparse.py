@@ -1,0 +1,165 @@
+"""Sparse NDArrays: CSR and row_sparse.
+
+Reference: ``src/ndarray`` storage types + ``python/mxnet/ndarray/sparse.py``
+(TBV — SURVEY.md §2.1 L3). XLA has no native sparse layout, so TPU sparse
+arrays keep the reference's *metadata* (indices/indptr/data views, stype)
+while backing compute with dense HLO (gather/scatter) — numerically exact
+parity; the perf-relevant sparse path in the reference (distributed
+row_sparse embedding pull) lives at the KVStore layer where the host-side
+PS keeps true sparsity over the wire.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
+           "BaseSparseNDArray"]
+
+
+class BaseSparseNDArray(NDArray):
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == self.stype:
+            return self
+        if stype == "row_sparse":
+            return RowSparseNDArray.from_dense(NDArray(self._data))
+        if stype == "csr":
+            return CSRNDArray.from_dense(NDArray(self._data))
+        raise ValueError(f"unknown stype {stype!r}")
+
+    def todense(self) -> NDArray:
+        return NDArray(self._data)
+
+    def asscipy(self):
+        raise NotImplementedError("scipy interchange not available")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (2D)."""
+
+    def __init__(self, dense_data, indptr, indices, sdata):
+        super().__init__(dense_data)
+        self._indptr = indptr
+        self._indices = indices
+        self._sdata = sdata
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return nd_array(self._indptr)
+
+    @property
+    def indices(self) -> NDArray:
+        return nd_array(self._indices)
+
+    @property
+    def data(self) -> NDArray:
+        return nd_array(self._sdata)
+
+    @staticmethod
+    def from_dense(arr: NDArray) -> "CSRNDArray":
+        d = np.asarray(arr.asnumpy())
+        assert d.ndim == 2, "CSR requires 2D"
+        indptr = [0]
+        indices = []
+        vals = []
+        for row in d:
+            nz = np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            vals.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(jnp.asarray(d), np.asarray(indptr, np.int64),
+                          np.asarray(indices, np.int64),
+                          np.asarray(vals, d.dtype))
+
+    def __repr__(self):
+        return (f"<CSRNDArray {self.shape} nnz={len(self._sdata)} "
+                f"@{self.context}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """First-dim sparse tensor: (indices, data[rows]) — the embedding-gradient
+    format the reference streams through KVStore row_sparse_pull."""
+
+    def __init__(self, dense_data, indices, sdata):
+        super().__init__(dense_data)
+        self._indices = indices
+        self._sdata = sdata
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return nd_array(self._indices)
+
+    @property
+    def data(self) -> NDArray:
+        return nd_array(self._sdata)
+
+    @staticmethod
+    def from_dense(arr: NDArray) -> "RowSparseNDArray":
+        d = np.asarray(arr.asnumpy())
+        nz_rows = np.nonzero(d.reshape(d.shape[0], -1).any(axis=1))[0]
+        return RowSparseNDArray(jnp.asarray(d), nz_rows.astype(np.int64),
+                                d[nz_rows])
+
+    def retain(self, rs_indices) -> "RowSparseNDArray":
+        keep = set(np.asarray(
+            rs_indices.asnumpy() if isinstance(rs_indices, NDArray)
+            else rs_indices).astype(np.int64).tolist())
+        d = np.array(self.asnumpy())
+        mask = np.ones(d.shape[0], bool)
+        for i in range(d.shape[0]):
+            if i not in keep:
+                d[i] = 0
+        return RowSparseNDArray.from_dense(nd_array(d))
+
+    def __repr__(self):
+        return (f"<RowSparseNDArray {self.shape} rows={len(self._indices)} "
+                f"@{self.context}>")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    """csr_matrix((data, indices, indptr), shape=...) or from dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = np.asarray(data, dtype or np.float32)
+        indices = np.asarray(indices, np.int64)
+        indptr = np.asarray(indptr, np.int64)
+        n_rows = len(indptr) - 1
+        n_cols = shape[1] if shape else (int(indices.max()) + 1 if len(indices)
+                                         else 0)
+        dense = np.zeros((n_rows, n_cols), data.dtype)
+        for r in range(n_rows):
+            for k in range(indptr[r], indptr[r + 1]):
+                dense[r, indices[k]] = data[k]
+        return CSRNDArray(jnp.asarray(dense), indptr, indices, data)
+    return CSRNDArray.from_dense(nd_array(arg1, ctx=ctx, dtype=dtype))
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    """row_sparse_array((data, indices), shape=...) or from dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = np.asarray(data, dtype or np.float32)
+        indices = np.asarray(indices, np.int64)
+        n_rows = shape[0] if shape else int(indices.max()) + 1
+        dense = np.zeros((n_rows,) + data.shape[1:], data.dtype)
+        dense[indices] = data
+        return RowSparseNDArray(jnp.asarray(dense), indices, data)
+    return RowSparseNDArray.from_dense(nd_array(arg1, ctx=ctx, dtype=dtype))

@@ -1,0 +1,129 @@
+"""``mx.np`` — the NumPy-compatible frontend.
+
+Reference: ``python/mxnet/numpy/`` (a large re-implementation of numpy
+semantics over the op registry — TBV, SURVEY.md §2.3). TPU redesign: jax
+already IS a numpy-compatible array API, so this module is a thin
+delegation layer — any ``jnp.<name>`` resolves here, unwrapping/wrapping
+:class:`NDArray` at the boundary. mxnet-specific dtype defaults (float32)
+are applied on creation.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..ndarray import NDArray
+from ..ndarray.ndarray import invoke_fn
+
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange"]
+
+ndarray = NDArray
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+euler_gamma = _onp.euler_gamma
+
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int8 = _onp.int8
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+bfloat16 = jnp.bfloat16
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    import jax
+
+    if isinstance(x, (jax.Array,)):
+        return NDArray(x)
+    if isinstance(x, tuple):
+        return tuple(_wrap(v) for v in x)
+    if isinstance(x, list):
+        return [_wrap(v) for v in x]
+    return x
+
+
+def array(object, dtype=None, ctx=None, device=None):
+    from ..ndarray import array as nd_array
+
+    return nd_array(object, ctx=ctx or device, dtype=dtype)
+
+
+def zeros(shape, dtype=None, order="C", ctx=None, device=None):
+    from ..ndarray import zeros as nd_zeros
+
+    return nd_zeros(shape, ctx=ctx or device, dtype=dtype or "float32")
+
+
+def ones(shape, dtype=None, order="C", ctx=None, device=None):
+    from ..ndarray import ones as nd_ones
+
+    return nd_ones(shape, ctx=ctx or device, dtype=dtype or "float32")
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None):
+    from ..ndarray import full as nd_full
+
+    return nd_full(shape, fill_value, ctx=ctx or device, dtype=dtype or "float32")
+
+
+def empty(shape, dtype=None, ctx=None, device=None):
+    return zeros(shape, dtype=dtype, ctx=ctx, device=device)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    from ..ndarray import arange as nd_arange
+
+    return nd_arange(start, stop, step, ctx=ctx or device,
+                     dtype=dtype or "float32")
+
+
+def _make_delegate(name):
+    fn = getattr(jnp, name)
+
+    def wrapper(*args, **kwargs):
+        nd_args = [a for a in args if isinstance(a, NDArray)]
+        if nd_args:
+            # route through invoke_fn so autograd records the call
+            def pure(*tensor_args, **kw):
+                it = iter(tensor_args)
+                rebuilt = [next(it) if isinstance(a, NDArray) else _unwrap(a)
+                           for a in args]
+                return fn(*rebuilt, **{k: _unwrap(v) for k, v in kw.items()})
+
+            return invoke_fn(pure, nd_args, kwargs)
+        return _wrap(fn(*[_unwrap(a) for a in args],
+                        **{k: _unwrap(v) for k, v in kwargs.items()}))
+
+    wrapper.__name__ = name
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+def __getattr__(name):
+    if hasattr(jnp, name):
+        attr = getattr(jnp, name)
+        if callable(attr) and not isinstance(attr, type):
+            f = _make_delegate(name)
+            globals()[name] = f
+            return f
+        return attr
+    raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + dir(jnp)))

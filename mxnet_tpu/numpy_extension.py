@@ -1,0 +1,72 @@
+"""``mx.npx`` — numpy-extension namespace (nn ops with numpy arrays).
+
+Reference: ``python/mxnet/numpy_extension/`` (npx.relu / npx.batch_norm /
+set_np — TBV). Delegates to the registered op library.
+"""
+from __future__ import annotations
+
+from .ops import has_op
+from .ndarray import invoke
+
+__all__ = ["set_np", "reset_np", "is_np_array", "use_np"]
+
+_np_mode = {"array": False, "shape": False}
+
+_ALIASES = {
+    "relu": "Activation",
+    "sigmoid": "sigmoid",
+    "softmax": "softmax",
+    "log_softmax": "log_softmax",
+    "batch_norm": "BatchNorm",
+    "layer_norm": "LayerNorm",
+    "fully_connected": "FullyConnected",
+    "convolution": "Convolution",
+    "pooling": "Pooling",
+    "embedding": "Embedding",
+    "topk": "topk",
+    "pick": "pick",
+    "one_hot": "one_hot",
+    "rnn": "RNN",
+    "dropout": "Dropout",
+    "gelu": "gelu",
+    "sequence_mask": "SequenceMask",
+    "gamma": "gamma",
+}
+
+
+def set_np(shape=True, array=True, dtype=False):
+    _np_mode["array"] = array
+    _np_mode["shape"] = shape
+
+
+def reset_np():
+    _np_mode["array"] = False
+    _np_mode["shape"] = False
+
+
+def is_np_array():
+    return _np_mode["array"]
+
+
+def use_np(fn):
+    return fn
+
+
+def __getattr__(name):
+    op_name = _ALIASES.get(name, name)
+    if has_op(op_name):
+        def f(*inputs, **kwargs):
+            from .ndarray import NDArray
+
+            tensors = []
+            rest = list(inputs)
+            while rest and isinstance(rest[0], NDArray):
+                tensors.append(rest.pop(0))
+            if name == "relu" and "act_type" not in kwargs:
+                kwargs["act_type"] = "relu"
+            return invoke(op_name, tensors, kwargs)
+
+        f.__name__ = name
+        globals()[name] = f
+        return f
+    raise AttributeError(f"module 'mxnet_tpu.numpy_extension' has no attribute {name!r}")

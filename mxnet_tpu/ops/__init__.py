@@ -18,3 +18,4 @@ from . import sequence  # noqa: F401
 from . import rnn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib  # noqa: F401
+from . import control_flow  # noqa: F401

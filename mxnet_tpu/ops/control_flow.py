@@ -1,0 +1,184 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: ``src/operator/control_flow.cc`` (first-class ops taking
+subgraphs — TBV, SURVEY.md §2.2). The natural TPU fit: ``lax.scan`` /
+``lax.while_loop`` / ``lax.cond`` — these APIs take Python callables over
+NDArrays (matching the reference's Python-facing contrib API
+``mx.nd.contrib.foreach(body, data, init_states)``) and trace them into a
+single fused XLA loop, eager or under jit alike.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _wrap(v):
+    from ..ndarray import NDArray
+
+    return NDArray(v) if not isinstance(v, NDArray) else v
+
+
+def _unwrap(v):
+    from ..ndarray import NDArray
+
+    if isinstance(v, NDArray):
+        return v._data
+    if isinstance(v, (list, tuple)):
+        return [_unwrap(x) for x in v]
+    return v
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan ``body(item, states) -> (out, new_states)`` over axis 0 of data.
+
+    Matches reference ``mx.nd.contrib.foreach`` semantics; compiles to one
+    ``lax.scan`` (the fused-RNN building block).
+    """
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import invoke_fn
+
+    single_data = not isinstance(data, (list, tuple))
+    single_state = not isinstance(init_states, (list, tuple))
+    data_list = [data] if single_data else list(data)
+    state_list = [init_states] if single_state else list(init_states)
+
+    out_is_single = [None]  # discovered during trace
+
+    def fn(*vals):
+        xs = vals[:len(data_list)]
+        st = list(vals[len(data_list):])
+
+        def step(carry, x):
+            x_nd = [NDArray(v) for v in (x if isinstance(x, tuple) else (x,))]
+            c_nd = [NDArray(v) for v in carry]
+            out, new_states = body(x_nd[0] if single_data else x_nd,
+                                   c_nd[0] if single_state else c_nd)
+            outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+            out_is_single[0] = not isinstance(out, (list, tuple))
+            ns = [new_states] if not isinstance(new_states, (list, tuple)) \
+                else list(new_states)
+            return tuple(_unwrap(n) for n in ns), \
+                tuple(_unwrap(o) for o in outs)
+
+        carry, ys = lax.scan(step, tuple(st),
+                             xs[0] if len(xs) == 1 else tuple(xs))
+        return tuple(ys) + tuple(carry)
+
+    n_data = len(data_list)
+    results = invoke_fn(lambda *v: fn(*v), data_list + state_list)
+    if not isinstance(results, tuple):
+        results = (results,)
+    n_states = len(state_list)
+    n_out = len(results) - n_states
+    outs = list(results[:n_out])
+    states = list(results[n_out:])
+    out = outs[0] if (out_is_single[0] or n_out == 1) else outs
+    st = states[0] if single_state else states
+    return out, st
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations=None):
+    """Reference ``mx.nd.contrib.while_loop(cond, func, loop_vars,
+    max_iterations)``. Returns (stacked_outputs, final_loop_vars).
+
+    XLA needs static shapes: outputs are collected into a ``max_iterations``
+    buffer with an iteration-count mask (the reference pads identically).
+    """
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import invoke_fn
+
+    assert max_iterations is not None and max_iterations > 0, \
+        "max_iterations is required (static shapes on TPU)"
+    single = not isinstance(loop_vars, (list, tuple))
+    lv = [loop_vars] if single else list(loop_vars)
+    out_meta = {}
+
+    def fn(*vals):
+        # probe one step to learn the output structure
+        probe_out, _ = func([NDArray(v) for v in vals] if not single
+                            else NDArray(vals[0]))
+        probe_outs = [probe_out] if not isinstance(probe_out, (list, tuple)) \
+            else list(probe_out)
+        out_meta["single"] = not isinstance(probe_out, (list, tuple))
+        bufs = tuple(jnp.zeros((max_iterations,) + tuple(_unwrap(o).shape),
+                               _unwrap(o).dtype) for o in probe_outs)
+
+        def cond_wrap(state):
+            i, vars_, bufs_ = state
+            c = cond_fn([NDArray(v) for v in vars_] if not single
+                        else NDArray(vars_[0]))
+            return jnp.logical_and(i < max_iterations,
+                                   _unwrap(c).reshape(()).astype(bool))
+
+        def body_wrap(state):
+            i, vars_, bufs_ = state
+            nd_vars = [NDArray(v) for v in vars_] if not single \
+                else NDArray(vars_[0])
+            out, new_vars = func(nd_vars)
+            outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+            nv = [new_vars] if not isinstance(new_vars, (list, tuple)) \
+                else list(new_vars)
+            new_bufs = tuple(b.at[i].set(_unwrap(o))
+                             for b, o in zip(bufs_, outs))
+            return (i + 1, tuple(_unwrap(v) for v in nv), new_bufs)
+
+        i, final_vars, final_bufs = lax.while_loop(
+            cond_wrap, body_wrap, (jnp.int32(0), tuple(vals), bufs))
+        return final_bufs + final_vars + (i,)
+
+    results = invoke_fn(lambda *v: fn(*v), lv)
+    if not isinstance(results, tuple):
+        results = (results,)
+    n_vars = len(lv)
+    n_out = len(results) - n_vars - 1
+    outs = list(results[:n_out])
+    final_vars = list(results[n_out:n_out + n_vars])
+    out = outs[0] if (out_meta.get("single") or n_out == 1) else outs
+    fv = final_vars[0] if single else final_vars
+    return out, fv
+
+
+def cond(pred_fn_or_val, then_func: Callable, else_func: Callable, inputs=None):
+    """Reference ``mx.nd.contrib.cond(pred, then_func, else_func, inputs)``.
+
+    pred may be a callable over inputs or a boolean NDArray/scalar.
+    """
+    from ..ndarray import NDArray
+    from ..ndarray.ndarray import invoke_fn
+
+    single = not isinstance(inputs, (list, tuple)) and inputs is not None
+    ins = [] if inputs is None else ([inputs] if single else list(inputs))
+
+    def fn(*vals):
+        nd_ins = [NDArray(v) for v in vals]
+        arg = (nd_ins[0] if single else nd_ins) if ins else None
+
+        if callable(pred_fn_or_val):
+            p = _unwrap(pred_fn_or_val(arg)).reshape(()).astype(bool)
+        else:
+            p = _unwrap(pred_fn_or_val)
+            p = jnp.asarray(p).reshape(()).astype(bool)
+
+        def then_branch(vs):
+            r = then_func(arg)
+            rs = [r] if not isinstance(r, (list, tuple)) else list(r)
+            return tuple(_unwrap(x) for x in rs)
+
+        def else_branch(vs):
+            r = else_func(arg)
+            rs = [r] if not isinstance(r, (list, tuple)) else list(r)
+            return tuple(_unwrap(x) for x in rs)
+
+        return lax.cond(p, then_branch, else_branch, tuple(vals))
+
+    result = invoke_fn(lambda *v: fn(*v), ins)
+    if isinstance(result, tuple) and len(result) == 1:
+        return result[0]
+    return result

@@ -1,0 +1,170 @@
+"""mx.np frontend, sparse NDArrays, control-flow ops, custom op, monitor."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------- mx.np
+
+def test_np_creation_and_math():
+    a = mx.np.array([[1, 2], [3, 4]])
+    b = mx.np.ones((2, 2))
+    c = mx.np.matmul(a, b)
+    np.testing.assert_allclose(c.asnumpy(), [[3, 3], [7, 7]])
+    assert mx.np.mean(a).asnumpy() == 2.5
+    s = mx.np.concatenate([a, b], axis=0)
+    assert s.shape == (4, 2)
+    assert mx.np.arange(5).shape == (5,)
+    assert float(mx.np.pi) == pytest.approx(np.pi)
+
+
+def test_np_autograd_through_delegate():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.np.sum(mx.np.square(x))
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_npx_ops():
+    x = nd.array(np.array([[-1.0, 2.0]], np.float32))
+    out = mx.npx.relu(x)
+    np.testing.assert_allclose(out.asnumpy(), [[0, 2]])
+    sm = mx.npx.softmax(nd.array(np.zeros((1, 4), np.float32)))
+    np.testing.assert_allclose(sm.asnumpy(), np.full((1, 4), 0.25))
+    mx.npx.set_np()
+    assert mx.npx.is_np_array()
+    mx.npx.reset_np()
+
+
+# ---------------------------------------------------------------- sparse
+
+def test_csr_roundtrip():
+    from mxnet_tpu.ndarray import sparse
+
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.todense().asnumpy(), dense)
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 3])
+    np.testing.assert_allclose(csr.indices.asnumpy(), [1, 0, 2])
+    np.testing.assert_allclose(csr.data.asnumpy(), [1, 2, 3])
+    # construction from (data, indices, indptr)
+    csr2 = sparse.csr_matrix(([1.0, 2, 3], [1, 0, 2], [0, 1, 3]), shape=(2, 3))
+    np.testing.assert_allclose(csr2.todense().asnumpy(), dense)
+    # sparse arrays still work as operands
+    out = nd.dot(csr, nd.array(np.eye(3, dtype=np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), dense)
+
+
+def test_row_sparse_and_retain():
+    from mxnet_tpu.ndarray import sparse
+
+    dense = np.zeros((4, 2), np.float32)
+    dense[1] = [1, 2]
+    dense[3] = [3, 4]
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    np.testing.assert_allclose(rs.indices.asnumpy(), [1, 3])
+    kept = rs.retain(nd.array(np.array([3], np.float32)))
+    np.testing.assert_allclose(kept.indices.asnumpy(), [3])
+    np.testing.assert_allclose(kept.todense().asnumpy()[1], 0)
+    # tostype round trip
+    assert rs.tostype("default").stype == "default"
+    assert rs.tostype("csr").stype == "csr"
+
+
+# ---------------------------------------------------------- control flow
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+    init = nd.zeros((2,))
+
+    def body(x, state):
+        new = x + state
+        return new, new
+
+    outs, final = nd.contrib.foreach(body, data, init)
+    np.testing.assert_allclose(final.asnumpy(), [6, 9])
+    np.testing.assert_allclose(outs.asnumpy(), [[0, 1], [2, 4], [6, 9]])
+
+
+def test_foreach_backward():
+    data = nd.array(np.ones((4, 3), np.float32))
+    data.attach_grad()
+    init = nd.zeros((3,))
+    with mx.autograd.record():
+        outs, final = nd.contrib.foreach(lambda x, s: (x * 2 + s, s + x), data,
+                                         init)
+        loss = final.sum()
+    loss.backward()
+    np.testing.assert_allclose(data.grad.asnumpy(), np.ones((4, 3)))
+
+
+def test_while_loop():
+    def cond_fn(vars_):
+        i, acc = vars_
+        return i < 5
+
+    def func(vars_):
+        i, acc = vars_
+        return acc + i, [i + 1, acc + i]
+
+    outs, final = nd.contrib.while_loop(
+        cond_fn, func, [nd.zeros((1,)), nd.zeros((1,))], max_iterations=8)
+    # acc accumulates 0+1+2+3+4 = 10
+    np.testing.assert_allclose(final[1].asnumpy(), [10])
+    assert outs.shape[0] == 8  # padded to max_iterations
+
+
+def test_cond():
+    x = nd.array(np.array([2.0], np.float32))
+    out = nd.contrib.cond(lambda v: v.sum() > 1,
+                          lambda v: v * 10,
+                          lambda v: v - 10, x)
+    np.testing.assert_allclose(out.asnumpy(), [20])
+    out = nd.contrib.cond(lambda v: v.sum() > 100,
+                          lambda v: v * 10,
+                          lambda v: v - 10, x)
+    np.testing.assert_allclose(out.asnumpy(), [-8])
+
+
+# ------------------------------------------------------------ custom op
+
+def test_custom_op():
+    @mx.operator.register("mysigmoid")
+    class MySigmoidProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class MySigmoid(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0]
+                    y = 1.0 / (1.0 + (-x).exp())
+                    self.assign(out_data[0], req[0], y)
+
+            return MySigmoid()
+
+    assert "mysigmoid" in mx.operator.get_all_registered_operators()
+    x = nd.array(np.array([0.0, 1.0], np.float32))
+    out = nd.Custom(x, op_type="mysigmoid")
+    np.testing.assert_allclose(out.asnumpy(), 1 / (1 + np.exp([-0.0, -1.0])),
+                               rtol=1e-6)
+
+
+# -------------------------------------------------------------- monitor
+
+def test_monitor_gluon():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    mon = mx.monitor.Monitor(interval=1)
+    mon.install_gluon(net)
+    mon.tic()
+    net(nd.ones((2, 3)))
+    stats = mon.toc()
+    assert len(stats) >= 2
+    assert all(np.isfinite(v) for _, _, v in stats)
