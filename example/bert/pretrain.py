@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""BERT-base MLM pretraining over a device mesh — baseline config 3.
+
+Reference: gluon-nlp/scripts/bert over KVStore nccl/dist (SURVEY.md §2.5).
+TPU-native: the whole step (fwd+bwd+grad-allreduce+adam) is ONE pjit'd XLA
+program over a dp×tp×sp mesh (parallel.ShardedTrainer); ring attention
+engages automatically when the mesh has sp>1.
+
+Smoke test:
+  python pretrain.py --model tiny --batch-size 8 --seq-len 32 --steps 3 --mesh dp=2,sp=2,tp=2
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.models import bert_base, bert_large, bert_tiny, bert_sharding_rules
+
+parser = argparse.ArgumentParser(description="BERT pretraining (MLM)")
+parser.add_argument("--model", default="base", choices=["tiny", "base", "large"])
+parser.add_argument("--vocab-size", type=int, default=30522)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--seq-len", type=int, default=128)
+parser.add_argument("--steps", type=int, default=20)
+parser.add_argument("--lr", type=float, default=1e-4)
+parser.add_argument("--mesh", type=str, default="dp=-1",
+                    help="mesh axes, e.g. dp=2,sp=2,tp=2 (-1 = rest)")
+parser.add_argument("--mask-prob", type=float, default=0.15)
+parser.add_argument("--log-interval", type=int, default=5)
+args = parser.parse_args()
+
+
+def make_batch(rng, vocab, bs, sl, mask_id=103):
+    tokens = rng.randint(5, vocab, (bs, sl)).astype(np.int32)
+    mask = rng.rand(bs, sl) < args.mask_prob
+    inputs = tokens.copy()
+    inputs[mask] = mask_id
+    return mx.nd.array(inputs), mx.nd.array(tokens)
+
+
+def main():
+    mx.random.seed(0)
+    builders = {"tiny": bert_tiny, "base": bert_base, "large": bert_large}
+    kwargs = {"vocab_size": args.vocab_size, "dropout": 0.0,
+              "max_length": max(args.seq_len, 128)}
+    net = builders[args.model](**kwargs)
+    net.initialize()
+
+    axes = {}
+    for part in args.mesh.split(","):
+        k, _, v = part.partition("=")
+        axes[k.strip()] = int(v)
+    mesh = par.make_mesh(axes)
+    print(f"mesh: {par.mesh_axes(mesh)}")
+
+    rng = np.random.RandomState(0)
+    x, y = make_batch(rng, args.vocab_size, args.batch_size, args.seq_len)
+    net(x)  # resolve deferred shapes before sharding
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = par.ShardedTrainer(net, loss_fn, mesh, rules=bert_sharding_rules(),
+                                 optimizer="adam",
+                                 optimizer_params={"learning_rate": args.lr})
+
+    loss = trainer.step(x, y)
+    print(f"step 0 loss {float(loss.asnumpy()):.4f} (compile included)")
+    tic = time.time()
+    for step in range(1, args.steps):
+        x, y = make_batch(rng, args.vocab_size, args.batch_size, args.seq_len)
+        loss = trainer.step(x, y)
+        if step % args.log_interval == 0 or step == args.steps - 1:
+            lv = float(loss.asnumpy())
+            dt = time.time() - tic
+            sps = step * args.batch_size / dt
+            print(f"step {step} loss {lv:.4f} {sps:.1f} seq/s", flush=True)
+    trainer.sync_to_net()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

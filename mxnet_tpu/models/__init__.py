@@ -9,3 +9,6 @@ from .transformer import (MultiHeadAttention, PositionwiseFFN,  # noqa: F401
                           TransformerEncoderCell, BERTEncoder, BERTModel,
                           TransformerLM, bert_base, bert_large, bert_tiny,
                           transformer_lm, bert_sharding_rules)
+from .seq2seq import (TransformerDecoderCell, Seq2SeqTransformer,  # noqa: F401
+                      beam_search, label_smoothing_loss)
+from .ssd import SSD, SSDMultiBoxLoss, ssd_300  # noqa: F401

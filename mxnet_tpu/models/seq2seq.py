@@ -1,0 +1,205 @@
+"""Encoder-decoder transformer for NMT + beam search.
+
+Reference counterpart: GluonNLP/Sockeye transformer NMT (external repos
+driven through the mx API — SURVEY.md §2.5 config 4: label smoothing +
+beam search over topk). Decoder blocks add causal self-attention and
+cross-attention; beam search is a static-shape ``topk`` loop (XLA-friendly:
+fixed max length, no dynamic compaction).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .transformer import MultiHeadAttention, PositionwiseFFN
+
+__all__ = ["TransformerDecoderCell", "Seq2SeqTransformer", "beam_search",
+           "label_smoothing_loss"]
+
+
+class CrossAttention(HybridBlock):
+    """Q from decoder, K/V from encoder memory."""
+
+    def __init__(self, units, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._heads = num_heads
+        self.q_proj = nn.Dense(units, flatten=False, in_units=units,
+                               prefix=self.prefix + "q_")
+        self.kv_proj = nn.Dense(2 * units, flatten=False, in_units=units,
+                                prefix=self.prefix + "kv_")
+        self.proj = nn.Dense(units, flatten=False, in_units=units,
+                             prefix=self.prefix + "proj_")
+        self._dropout = dropout
+
+    def hybrid_forward(self, F, x, memory):
+        from ..ndarray.ndarray import invoke_fn
+        from ..parallel.ring_attention import plain_attention
+
+        b, sq, u = x.shape
+        sk = memory.shape[1]
+        h, d = self._heads, self._units // self._heads
+        q = self.q_proj(x).reshape((b, sq, h, d)).transpose((0, 2, 1, 3))
+        kv = self.kv_proj(memory).reshape((b, sk, 2, h, d)).transpose(
+            (2, 0, 3, 1, 4))
+        k, v = kv[0], kv[1]
+        out = invoke_fn(lambda qq, kk, vv: plain_attention(qq, kk, vv),
+                        [q, k, v])
+        out = out.transpose((0, 2, 1, 3)).reshape((b, sq, u))
+        out = self.proj(out)
+        if self._dropout:
+            out = F.Dropout(out, p=self._dropout)
+        return out
+
+
+class TransformerDecoderCell(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.self_attn = MultiHeadAttention(units, num_heads, dropout=dropout,
+                                            causal=True,
+                                            prefix=self.prefix + "selfattn_")
+        self.ln1 = nn.LayerNorm(in_channels=units)
+        self.cross_attn = CrossAttention(units, num_heads, dropout=dropout,
+                                         prefix=self.prefix + "crossattn_")
+        self.ln2 = nn.LayerNorm(in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                   prefix=self.prefix + "ffn_")
+        self.ln3 = nn.LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, memory):
+        x = self.ln1(x + self.self_attn(x))
+        x = self.ln2(x + self.cross_attn(x, memory))
+        return self.ln3(x + self.ffn(x))
+
+
+class Seq2SeqTransformer(HybridBlock):
+    """Full encoder-decoder NMT model (gluon-nlp/Sockeye transformer class)."""
+
+    def __init__(self, src_vocab=32000, tgt_vocab=32000, units=512,
+                 hidden_size=2048, num_layers=6, num_heads=8, max_length=512,
+                 dropout=0.1, tie_embeddings=False, **kwargs):
+        super().__init__(**kwargs)
+        from .transformer import BERTEncoder
+
+        self.src_embed = nn.Embedding(src_vocab, units,
+                                      prefix=self.prefix + "src_embed_")
+        self.tgt_embed = nn.Embedding(tgt_vocab, units,
+                                      prefix=self.prefix + "tgt_embed_")
+        self.encoder = BERTEncoder(units, hidden_size, num_layers, num_heads,
+                                   max_length, dropout,
+                                   prefix=self.prefix + "enc_")
+        self.dec_pos = self.params.get("dec_position_weight",
+                                       shape=(max_length, units), init="zeros")
+        self.dec_cells = []
+        for i in range(num_layers):
+            cell = TransformerDecoderCell(units, hidden_size, num_heads, dropout,
+                                          prefix=f"{self.prefix}dec{i}_")
+            self.register_child(cell, f"dec{i}")
+            self.dec_cells.append(cell)
+        self.out_proj = nn.Dense(tgt_vocab, flatten=False, in_units=units,
+                                 prefix=self.prefix + "out_")
+        self._units = units
+        self._dropout = dropout
+
+    def encode(self, src):
+        return self.encoder(self.src_embed(src))
+
+    def decode(self, tgt, memory):
+        from .. import ndarray as F
+
+        b, s = tgt.shape[0], tgt.shape[1]
+        x = self.tgt_embed(tgt)
+        pos = self.dec_pos.data()[:s].reshape((1, s, self._units))
+        x = x + pos
+        if self._dropout:
+            x = F.Dropout(x, p=self._dropout)
+        for cell in self.dec_cells:
+            x = cell(x, memory)
+        return self.out_proj(x)
+
+    def hybrid_forward(self, F, src, tgt, **params):
+        memory = self.encode(src)
+        return self.decode(tgt, memory)
+
+
+def label_smoothing_loss(logits, labels, epsilon=0.1, ignore_index=None):
+    """Smoothed CE (the reference NMT configs use make_loss + smoothing ops)."""
+    from .. import ndarray as F
+    from ..ndarray.ndarray import invoke_fn
+    import jax.numpy as jnp
+
+    def pure(lg, lb):
+        import jax
+
+        v = lg.shape[-1]
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        oh = jnp.eye(v, dtype=lg.dtype)[lb.astype(jnp.int32)]
+        smooth = oh * (1 - epsilon) + epsilon / v
+        nll = -(smooth * logp).sum(-1)
+        if ignore_index is not None:
+            mask = (lb != ignore_index).astype(lg.dtype)
+            return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return nll.mean()
+
+    return invoke_fn(pure, [logits, labels])
+
+
+def beam_search(model: Seq2SeqTransformer, src, beam_size=4, max_length=30,
+                bos=1, eos=2, alpha=0.6):
+    """Static-shape beam search (reference: GluonNLP BeamSearchSampler over
+    topk ops). Decodes greedily over a fixed max_length loop; returns
+    (best_sequences (B, max_length), scores (B,))."""
+    import jax.numpy as jnp
+    import numpy as np_
+
+    from .. import ndarray as F
+    from ..ndarray import NDArray
+
+    src_np = src if isinstance(src, NDArray) else NDArray(src)
+    b = src_np.shape[0]
+    memory = model.encode(src_np)  # (B, S, U)
+    mem = memory._data
+    mem_rep = jnp.repeat(mem, beam_size, axis=0)  # (B*K, S, U)
+
+    seqs = np_.full((b * beam_size, max_length), eos, np_.int32)
+    seqs[:, 0] = bos
+    scores = np_.full((b, beam_size), -1e9, np_.float32)
+    scores[:, 0] = 0.0  # only the first beam is live initially
+    alive = np_.ones((b * beam_size,), bool)
+
+    for t in range(1, max_length):
+        logits = model.decode(NDArray(jnp.asarray(seqs[:, :t])),
+                              NDArray(mem_rep))  # (B*K, t, V)
+        logp = np_.array(F.log_softmax(logits[:, t - 1], axis=-1).asnumpy())
+        v = logp.shape[-1]
+        # dead beams only extend with eos at zero cost
+        logp[~alive] = -1e9
+        logp[~alive, eos] = 0.0
+        total = scores.reshape(-1, 1) + logp  # (B*K, V)
+        total = total.reshape(b, beam_size * v)
+        topk_idx = np_.argsort(-total, axis=1)[:, :beam_size]
+        topk_score = np_.take_along_axis(total, topk_idx, axis=1)
+        beam_src = topk_idx // v
+        token = (topk_idx % v).astype(np_.int32)
+        new_seqs = np_.empty_like(seqs)
+        for bi in range(b):
+            for k in range(beam_size):
+                parent = bi * beam_size + int(beam_src[bi, k])
+                row = bi * beam_size + k
+                new_seqs[row] = seqs[parent]
+                new_seqs[row, t] = token[bi, k]
+        seqs = new_seqs
+        scores = topk_score
+        alive = (seqs[:, t] != eos) & alive[
+            (np_.arange(b)[:, None] * beam_size + beam_src).reshape(-1)]
+        if not alive.any():
+            break
+
+    # length-normalized best beam
+    lengths = (seqs != eos).sum(axis=1).reshape(b, beam_size)
+    lp = ((5 + lengths) ** alpha) / ((5 + 1) ** alpha)
+    final = scores / lp
+    best = np_.argmax(final, axis=1)
+    out = np_.stack([seqs[bi * beam_size + best[bi]] for bi in range(b)])
+    return out, final[np_.arange(b), best]

@@ -1,0 +1,137 @@
+"""SSD object detector (config 5 of the baseline set).
+
+Reference counterpart: ``example/ssd`` + GluonCV SSD (multibox_* + box_nms
+CUDA ops — TBV, SURVEY.md §2.5). Anchors via MultiBoxPrior, training via
+MultiBoxTarget + SSDMultiBoxLoss, inference via MultiBoxDetection (NMS) —
+all running as static-shape XLA (ops/contrib.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["SSD", "SSDMultiBoxLoss", "ssd_300"]
+
+
+def _conv_block(channels, stride=1):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(channels, 3, stride, 1, use_bias=False))
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    return out
+
+
+class SSD(HybridBlock):
+    """Multi-scale single-shot detector.
+
+    forward(x) -> (anchors (1, N, 4), cls_preds (B, N, classes+1),
+                   box_preds (B, N*4))
+    """
+
+    def __init__(self, num_classes=20, base_channels=(32, 64, 128),
+                 scale_channels=(128, 128, 128),
+                 sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619)),
+                 ratios=((1, 2, 0.5),) * 3, **kwargs):
+        super().__init__(**kwargs)
+        assert len(scale_channels) == len(sizes) == len(ratios)
+        self._num_classes = num_classes
+        self._sizes = sizes
+        self._ratios = ratios
+        self._num_anchors = [len(s) + len(r) - 1 for s, r in zip(sizes, ratios)]
+
+        self.base = nn.HybridSequential()
+        for i, c in enumerate(base_channels):
+            self.base.add(_conv_block(c, stride=1))
+            self.base.add(nn.MaxPool2D(2, 2))
+
+        self.stages, self.cls_heads, self.box_heads = [], [], []
+        for i, c in enumerate(scale_channels):
+            stage = _conv_block(c, stride=1) if i == 0 else _seq(
+                _conv_block(c), nn.MaxPool2D(2, 2))
+            self.register_child(stage, f"stage{i}")
+            self.stages.append(stage)
+            k = self._num_anchors[i]
+            cls = nn.Conv2D(k * (num_classes + 1), 3, padding=1)
+            box = nn.Conv2D(k * 4, 3, padding=1)
+            self.register_child(cls, f"cls{i}")
+            self.register_child(box, f"box{i}")
+            self.cls_heads.append(cls)
+            self.box_heads.append(box)
+
+    def hybrid_forward(self, F, x):
+        x = self.base(x)
+        anchors, cls_preds, box_preds = [], [], []
+        for i, stage in enumerate(self.stages):
+            x = stage(x)
+            anchors.append(F.contrib.MultiBoxPrior(x, sizes=self._sizes[i],
+                                                   ratios=self._ratios[i]))
+            c = self.cls_heads[i](x)  # (B, K*(C+1), H, W)
+            b = self.box_heads[i](x)  # (B, K*4, H, W)
+            bsz = c.shape[0]
+            cls_preds.append(c.transpose((0, 2, 3, 1)).reshape(
+                (bsz, -1, self._num_classes + 1)))
+            box_preds.append(b.transpose((0, 2, 3, 1)).reshape((bsz, -1)))
+        return (F.concat(*anchors, dim=1), F.concat(*cls_preds, dim=1),
+                F.concat(*box_preds, dim=1))
+
+    def detect(self, x, nms_threshold=0.45, threshold=0.01, nms_topk=400):
+        """Full inference: forward + softmax + decode + NMS → (B, N, 6)."""
+        from .. import ndarray as F
+
+        anchors, cls_preds, box_preds = self(x)
+        cls_prob = F.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+        return F.contrib.MultiBoxDetection(cls_prob, box_preds, anchors,
+                                           nms_threshold=nms_threshold,
+                                           threshold=threshold,
+                                           nms_topk=nms_topk)
+
+
+def _seq(*blocks):
+    s = nn.HybridSequential()
+    s.add(*blocks)
+    return s
+
+
+class SSDMultiBoxLoss:
+    """cls CE + smooth-L1 box loss with hard-negative-free normalization
+    (GluonCV SSDMultiBoxLoss counterpart)."""
+
+    def __init__(self, negative_mining_ratio=3.0, lambd=1.0):
+        self._ratio = negative_mining_ratio
+        self._lambd = lambd
+
+    def __call__(self, cls_preds, box_preds, cls_targets, box_targets, box_masks):
+        from .. import ndarray as F
+        from ..ndarray.ndarray import invoke_fn
+        import jax
+        import jax.numpy as jnp
+
+        def pure(cp, bp, ct, bt, bm):
+            logp = jax.nn.log_softmax(cp, axis=-1)
+            ce = -jnp.take_along_axis(logp, ct.astype(jnp.int32)[..., None],
+                                      axis=-1)[..., 0]
+            pos = ct > 0
+            num_pos = jnp.maximum(pos.sum(), 1).astype(cp.dtype)
+            # hard negative mining: top (ratio * num_pos) negatives by loss
+            neg_ce = jnp.where(pos, -jnp.inf, ce)
+            k = jnp.minimum((self._ratio * pos.sum(axis=-1)).astype(jnp.int32),
+                            ce.shape[-1] - 1)
+            sorted_neg = -jnp.sort(-neg_ce, axis=-1)
+            thresh = jnp.take_along_axis(sorted_neg,
+                                         jnp.maximum(k - 1, 0)[:, None],
+                                         axis=-1)
+            hard_neg = (neg_ce >= thresh) & (k > 0)[:, None] & ~pos
+            cls_loss = jnp.where(pos | hard_neg, ce, 0.0).sum() / num_pos
+            diff = jnp.abs((bp - bt) * bm)
+            sl1 = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5)
+            box_loss = sl1.sum() / num_pos
+            return cls_loss + self._lambd * box_loss
+
+        return invoke_fn(pure, [cls_preds, box_preds, cls_targets, box_targets,
+                                box_masks])
+
+
+def ssd_300(num_classes=20, **kwargs):
+    return SSD(num_classes=num_classes, **kwargs)
