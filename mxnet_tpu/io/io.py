@@ -301,7 +301,9 @@ class ImageRecordIter(DataIter):
         self._read_lock = threading.Lock()  # seek+read on the shared handle
         self._path = path_imgrec
         self._native = None
-        if not kwargs.get("no_native"):
+        # The C++ pipeline decodes RGB only; grayscale/other channel counts
+        # go through the PIL fallback which honors data_shape[0].
+        if not kwargs.get("no_native") and self.data_shape[0] == 3:
             from ..native import io_lib
 
             self._native = io_lib()  # C++ decode pipeline when built

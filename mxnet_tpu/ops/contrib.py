@@ -97,7 +97,9 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         best_iou = jnp.take_along_axis(iou, best_gt[:, None], 1)[:, 0]
         # each gt's best anchor is forced matched (reference bipartite stage)
         best_anchor = jnp.argmax(iou, axis=0)      # (M,)
-        forced = jnp.zeros(n, bool).at[best_anchor].set(valid)
+        # scatter-max, not set: padded gts all argmax to anchor 0 and a
+        # duplicate-index set() could nondeterministically drop a real match
+        forced = jnp.zeros(n, bool).at[best_anchor].max(valid)
         matched = forced | (best_iou >= overlap_threshold)
         gt_ltrb = gt[best_gt]
         # encode: center offsets normalized by variances

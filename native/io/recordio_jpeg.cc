@@ -139,6 +139,12 @@ int mxtpu_decode_batch(const char* path, const int64_t* offsets, int n,
       memcpy(&flag, record.data(), 4);
       memcpy(&scalar_label, record.data() + 4, 4);
       size_t off = 24;
+      // A truncated/corrupt multi-label record must fail counted, not read
+      // past the buffer (and len - off below must never underflow).
+      if (flag > 0 && 24 + 4ull * flag >= len) {
+        failures++;
+        continue;
+      }
       float* lab_dst = out_labels + size_t(i) * label_width;
       if (flag > 0) {
         for (int k = 0; k < label_width; ++k) {

@@ -124,24 +124,32 @@ class Server {
   }
 
   void Handle(int conn) {
+    // Frames arrive from the open network (INADDR_ANY): every wire field is
+    // validated before use, and a malformed frame drops the connection.
+    constexpr uint32_t kMaxFrame = 1u << 30;
     std::vector<uint8_t> body;
     while (true) {
       uint32_t len;
       if (!RecvExact(conn, &len, 4)) break;
+      if (len < 3 || len > kMaxFrame) break;
       body.resize(len);
       if (!RecvExact(conn, body.data(), len)) break;
       uint8_t op = body[0];
       uint16_t klen;
       memcpy(&klen, body.data() + 1, 2);
+      if (3ull + klen > len) break;
       std::string key(reinterpret_cast<char*>(body.data() + 3), klen);
       const uint8_t* payload = body.data() + 3 + klen;
       size_t payload_len = len - 3 - klen;
 
       if (op == INIT) {
         Entry* e = GetEntry(key, true);
-        std::lock_guard<std::mutex> lk(e->mu);
-        if (e->weight.empty()) ParseArray(payload, payload_len, e);
-        SendMsg(conn, INIT, key, std::string("\x00", 1));
+        bool ok = true;
+        {
+          std::lock_guard<std::mutex> lk(e->mu);
+          if (e->weight.empty()) ok = ParseArray(payload, payload_len, e);
+        }
+        SendMsg(conn, INIT, key, std::string(ok ? "\x00" : "\x01", 1));
       } else if (op == PUSH) {
         Entry* e = GetEntry(key, false);
         if (!e) { SendMsg(conn, PUSH, key, std::string("\x01", 1)); continue; }
@@ -195,25 +203,63 @@ class Server {
     return &it->second;
   }
 
-  static size_t ParseHeader(const uint8_t* p, std::vector<uint32_t>* shape) {
+  // Returns the header size (ndim byte + shape + dtype byte), or 0 when the
+  // payload is too short to hold it — callers must reject the frame then.
+  // *dtype_code receives the wire dtype (0 = f32, 16 = 2-bit compressed).
+  static size_t ParseHeader(const uint8_t* p, size_t n,
+                            std::vector<uint32_t>* shape,
+                            uint8_t* dtype_code = nullptr) {
+    if (n < 2) return 0;
     uint8_t ndim = p[0];
+    size_t need = 1 + 4ull * ndim + 1;
+    if (n < need) return 0;
     shape->resize(ndim);
     memcpy(shape->data(), p + 1, 4ull * ndim);
-    return 1 + 4ull * ndim + 1;  // + dtype byte (assumed f32 = code 0)
+    if (dtype_code) *dtype_code = p[need - 1];
+    return need;
   }
 
-  static void ParseArray(const uint8_t* p, size_t n, Entry* e) {
-    size_t off = ParseHeader(p, &e->shape);
+  // Expand a 2-bit-compressed payload (f32 threshold | packed codes) into
+  // ±threshold / 0 floats. Wire format shared with kvstore/compression.py.
+  static bool Decode2Bit(const uint8_t* p, size_t n, size_t count,
+                         std::vector<float>* out) {
+    if (n < 4 || (count + 3) / 4 > n - 4) return false;
+    float threshold;
+    memcpy(&threshold, p, 4);
+    const uint8_t* packed = p + 4;
+    out->resize(count);
+    for (size_t i = 0; i < count; ++i) {
+      uint8_t code = (packed[i / 4] >> (2 * (i % 4))) & 3;
+      (*out)[i] = code == 1 ? threshold : (code == 2 ? -threshold : 0.f);
+    }
+    return true;
+  }
+
+  static bool ParseArray(const uint8_t* p, size_t n, Entry* e) {
+    size_t off = ParseHeader(p, n, &e->shape);
+    if (off == 0) return false;
     size_t count = (n - off) / 4;
     e->weight.resize(count);
     memcpy(e->weight.data(), p + off, count * 4);
+    return true;
   }
 
   void ApplyPush(Entry* e, const uint8_t* p, size_t n) {
     std::vector<uint32_t> shape;
-    size_t off = ParseHeader(p, &shape);
-    const float* g = reinterpret_cast<const float*>(p + off);
-    size_t count = (n - off) / 4;
+    uint8_t dtype_code = 0;
+    size_t off = ParseHeader(p, n, &shape, &dtype_code);
+    if (off == 0) return;
+    std::vector<float> expanded;
+    const float* g;
+    size_t count;
+    if (dtype_code == 16) {  // 2-bit compressed gradient
+      if (!Decode2Bit(p + off, n - off, e->weight.size(), &expanded)) return;
+      g = expanded.data();
+      count = expanded.size();
+    } else {
+      g = reinterpret_cast<const float*>(p + off);
+      count = (n - off) / 4;
+    }
     if (count != e->weight.size()) return;
     Optimizer o;
     {
