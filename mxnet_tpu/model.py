@@ -1,7 +1,9 @@
 """Checkpoint save/load (reference python/mxnet/model.py — TBV SURVEY.md §5.4).
 
-Formats match the reference: ``prefix-symbol.json`` + ``prefix-%04d.params``
-where the params file stores ``arg:name`` / ``aux:name`` keyed NDArrays.
+Naming convention matches the reference: ``prefix-symbol.json`` +
+``prefix-%04d.params`` with ``arg:name`` / ``aux:name`` keyed NDArrays; the
+params container is the reference binary NDArray format (see
+``ndarray.save``).
 """
 from __future__ import annotations
 

@@ -21,12 +21,19 @@ Redesign for PJRT/XLA (SURVEY.md §7 hard part #1):
 """
 from __future__ import annotations
 
+import os
 import struct
 from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# MX_SYNC=1: block after every op (reference MXNET_ENGINE_TYPE=NaiveEngine
+# debug mode, SURVEY.md §5.2) — turns async-dispatch bugs and NaN origins
+# into synchronous stack traces. Read once at import like the reference.
+_MX_SYNC = (os.environ.get("MX_SYNC", "0") not in ("", "0")
+            or os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine")
 
 from ..base import MXNetError, dtype_np
 from ..context import Context, current_context
@@ -503,7 +510,11 @@ def invoke(op: Any, inputs: Sequence[NDArray], kwargs: dict):
                 o._set_data(r._data)
         else:
             out._set_data(result._data)
-        return out
+        result = out
+    if _MX_SYNC:
+        for r in result if isinstance(result, (list, tuple)) else [result]:
+            if isinstance(r, NDArray):
+                r.wait_to_read()
     return result
 
 
@@ -588,34 +599,42 @@ def waitall():
 
 # ---------------------------------------------------------------------------
 # save / load — reference NDArray serialization API (MXNDArraySave/Load).
-# Format: npz container (TPU build's native format; the reference's custom
-# binary format is provided by mxnet_tpu.utils.serialization for checkpoint
-# compatibility).
+# Format: the reference binary list container (see ndarray/serialization.py);
+# load() also accepts the npz container earlier TPU builds wrote.
 # ---------------------------------------------------------------------------
 
 def save(fname: str, data) -> None:
+    from .serialization import save_nd
+
     if isinstance(data, NDArray):
-        np.savez(_ensure_ext(fname), __single__=data.asnumpy())
+        save_nd(fname, [data.asnumpy()], [])
     elif isinstance(data, dict):
-        np.savez(_ensure_ext(fname), **{k: v.asnumpy() for k, v in data.items()})
+        keys = list(data.keys())
+        save_nd(fname, [data[k].asnumpy() for k in keys], keys)
     elif isinstance(data, (list, tuple)):
-        np.savez(_ensure_ext(fname), **{f"__list_{i}__": v.asnumpy() for i, v in enumerate(data)})
+        save_nd(fname, [v.asnumpy() for v in data], [])
     else:
         raise TypeError(f"cannot save {type(data)}")
 
 
 def load(fname: str):
-    with np.load(_npz_path(fname), allow_pickle=False) as z:
+    from .serialization import is_binary_nd, load_nd
+
+    path = _npz_path(fname)
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if is_binary_nd(head):
+        out = load_nd(path)
+        if isinstance(out, dict):
+            return {k: NDArray(v) for k, v in out.items()}
+        return [NDArray(v) for v in out]
+    with np.load(path, allow_pickle=False) as z:  # legacy npz container
         keys = list(z.keys())
         if keys == ["__single__"]:
             return [NDArray(z["__single__"])]
         if all(k.startswith("__list_") for k in keys):
             return [NDArray(z[f"__list_{i}__"]) for i in range(len(keys))]
         return {k: NDArray(z[k]) for k in keys}
-
-
-def _ensure_ext(fname):
-    return fname
 
 
 def _npz_path(fname):

@@ -1,0 +1,147 @@
+"""Reference-compatible binary NDArray serialization.
+
+Implements the upstream ``MXNDArraySave``/``MXNDArrayLoad`` container format
+(reference ``src/ndarray/ndarray.cc`` NDArray::Save/Load and
+``src/c_api/c_api.cc`` — expected paths per SURVEY.md §5.4; the reference
+mount was empty this round so byte layout is reconstructed from the public
+Apache MXNet 1.x format, TBV against a real ``.params`` file when available):
+
+    file   := u64 list_magic(0x112) | u64 reserved(0)
+              | u64 n_arrays | array*  | u64 n_names | dmlc_str*
+    array  := u32 nd_magic | i32 stype | u32 ndim | i64*ndim shape
+              | i32 dev_type | i32 dev_id | i32 type_flag | raw data
+    dmlc_str := u64 len | bytes
+
+Dense arrays only (stype 0); sparse NDArrays are densified on save with a
+warning. ndim==0 encodes a "none" array (no context/dtype/data follow).
+"""
+from __future__ import annotations
+
+import struct
+import warnings
+from typing import Dict, List, Union
+
+import numpy as np
+
+_LIST_MAGIC = 0x112
+# reference ndarray.cc: V1 = int64 TShape, V2 = +storage type, V3 = np-shape
+_ND_V1 = 0xF993FAC8
+_ND_V2 = 0xF993FAC9
+_ND_V3 = 0xF993FACA
+
+# reference mshadow type flags (mshadow/base.h)
+_TYPE_FLAG_TO_DTYPE = {
+    0: np.dtype(np.float32),
+    1: np.dtype(np.float64),
+    2: np.dtype(np.float16),
+    3: np.dtype(np.uint8),
+    4: np.dtype(np.int32),
+    5: np.dtype(np.int8),
+    6: np.dtype(np.int64),
+    7: np.dtype(np.bool_),
+    8: np.dtype(np.int16),
+    9: np.dtype(np.uint16),
+    10: np.dtype(np.uint32),
+    11: np.dtype(np.uint64),
+}
+_DTYPE_TO_TYPE_FLAG = {v: k for k, v in _TYPE_FLAG_TO_DTYPE.items()}
+try:  # TPU-build extension: bfloat16 uses the 1.x kBfloat16 slot
+    import ml_dtypes
+
+    _TYPE_FLAG_TO_DTYPE[12] = np.dtype(ml_dtypes.bfloat16)
+    _DTYPE_TO_TYPE_FLAG[np.dtype(ml_dtypes.bfloat16)] = 12
+except ImportError:  # pragma: no cover
+    pass
+
+_CPU_DEV_TYPE = 1  # Context::kCPU — loads are device-agnostic anyway
+
+
+def _write_array(out: List[bytes], arr: np.ndarray) -> None:
+    out.append(struct.pack("<Ii", _ND_V2, 0))  # magic, stype=default(dense)
+    out.append(struct.pack("<I", arr.ndim))
+    out.append(struct.pack(f"<{arr.ndim}q", *arr.shape))
+    out.append(struct.pack("<ii", _CPU_DEV_TYPE, 0))
+    flag = _DTYPE_TO_TYPE_FLAG.get(arr.dtype)
+    if flag is None:
+        raise TypeError(f"dtype {arr.dtype} has no reference type flag")
+    out.append(struct.pack("<i", flag))
+    out.append(np.ascontiguousarray(arr).tobytes())
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise ValueError("truncated NDArray file")
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def unpack(self, fmt: str):
+        return struct.unpack(fmt, self.take(struct.calcsize(fmt)))
+
+
+def _read_array(r: _Reader) -> np.ndarray:
+    (magic,) = r.unpack("<I")
+    if magic not in (_ND_V1, _ND_V2, _ND_V3):
+        raise ValueError(f"bad NDArray record magic {magic:#x}")
+    if magic in (_ND_V2, _ND_V3):
+        (stype,) = r.unpack("<i")
+        if stype != 0:
+            raise ValueError(f"sparse storage type {stype} not supported on load")
+    (ndim,) = r.unpack("<I")
+    if ndim == 0:
+        return np.zeros((), np.float32)  # reference "none" placeholder
+    if ndim > 32:
+        raise ValueError(f"implausible ndim {ndim}")
+    shape = r.unpack(f"<{ndim}q")
+    r.unpack("<ii")  # dev_type, dev_id — ignored, loads land on default ctx
+    (flag,) = r.unpack("<i")
+    dtype = _TYPE_FLAG_TO_DTYPE.get(flag)
+    if dtype is None:
+        raise ValueError(f"unknown type flag {flag}")
+    count = int(np.prod(shape)) if ndim else 1
+    data = r.take(count * dtype.itemsize)
+    return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+
+def save_nd(fname: str, arrays: List[np.ndarray], names: List[str]) -> None:
+    """Write the reference list container. ``names`` may be empty (list save)."""
+    out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
+                        struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        _write_array(out, a)
+    out.append(struct.pack("<Q", len(names)))
+    for n in names:
+        b = n.encode("utf-8")
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    with open(fname, "wb") as f:
+        f.write(b"".join(out))
+
+
+def is_binary_nd(head: bytes) -> bool:
+    return len(head) >= 8 and struct.unpack("<Q", head[:8])[0] == _LIST_MAGIC
+
+
+def load_nd(fname: str) -> Union[List[np.ndarray], Dict[str, np.ndarray]]:
+    with open(fname, "rb") as f:
+        buf = f.read()
+    r = _Reader(buf)
+    magic, _reserved = r.unpack("<QQ")
+    if magic != _LIST_MAGIC:
+        raise ValueError(f"not an NDArray file (magic {magic:#x})")
+    (n,) = r.unpack("<Q")
+    if n > 1_000_000:
+        raise ValueError(f"implausible array count {n}")
+    arrays = [_read_array(r) for _ in range(n)]
+    (n_names,) = r.unpack("<Q")
+    if n_names == 0:
+        return arrays
+    if n_names != n:
+        raise ValueError(f"{n} arrays but {n_names} names")
+    names = [r.take(r.unpack("<Q")[0]).decode("utf-8") for _ in range(n_names)]
+    return dict(zip(names, arrays))

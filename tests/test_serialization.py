@@ -1,0 +1,117 @@
+"""Binary NDArray serialization format (reference MXNDArraySave/Load analog).
+
+The golden-bytes test pins the wire layout byte-for-byte so the format can't
+drift silently; layout per mxnet_tpu/ndarray/serialization.py docstring.
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import serialization as ser
+
+
+def test_golden_bytes(tmp_path):
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    path = str(tmp_path / "g.params")
+    ser.save_nd(path, [arr], ["w"])
+    with open(path, "rb") as f:
+        got = f.read()
+    expect = b"".join([
+        struct.pack("<QQ", 0x112, 0),          # list magic, reserved
+        struct.pack("<Q", 1),                  # n arrays
+        struct.pack("<Ii", 0xF993FAC9, 0),     # V2 magic, stype dense
+        struct.pack("<I", 2),                  # ndim
+        struct.pack("<qq", 2, 3),              # shape (int64)
+        struct.pack("<ii", 1, 0),              # dev_type cpu, dev_id
+        struct.pack("<i", 0),                  # type flag float32
+        arr.tobytes(),
+        struct.pack("<Q", 1),                  # n names
+        struct.pack("<Q", 1), b"w",
+    ])
+    assert got == expect
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.float16,
+                                   np.uint8, np.int32, np.int8, np.int64])
+def test_format_roundtrip_dtypes(tmp_path, dtype):
+    arr = np.arange(24).astype(dtype).reshape(2, 3, 4)
+    path = str(tmp_path / "a.params")
+    ser.save_nd(path, [arr], ["x"])
+    out = ser.load_nd(path)
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["x"].dtype == np.dtype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, np.uint8,
+                                   np.int32, np.int8])
+def test_nd_roundtrip_dtypes(tmp_path, dtype):
+    # 64-bit dtypes excluded: NDArray lives in JAX x32 mode and downcasts
+    arr = np.arange(24).astype(dtype).reshape(2, 3, 4)
+    path = str(tmp_path / "a.params")
+    nd.save(path, {"x": nd.array(arr)})
+    out = nd.load(path)
+    np.testing.assert_array_equal(out["x"].asnumpy(), arr)
+    assert out["x"].dtype == np.dtype(dtype)
+
+
+def test_roundtrip_bfloat16(tmp_path):
+    import ml_dtypes
+
+    arr = np.arange(8).astype(ml_dtypes.bfloat16)
+    path = str(tmp_path / "b.params")
+    ser.save_nd(path, [arr], ["x"])
+    out = ser.load_nd(path)
+    np.testing.assert_array_equal(out["x"].astype(np.float32),
+                                  arr.astype(np.float32))
+
+
+def test_list_and_single_save(tmp_path):
+    path = str(tmp_path / "l.params")
+    nd.save(path, [nd.array(np.ones((2,), np.float32)),
+                   nd.array(np.zeros((3,), np.float32))])
+    out = nd.load(path)
+    assert isinstance(out, list) and len(out) == 2
+    nd.save(path, nd.array(np.full((4,), 7, np.float32)))
+    (single,) = nd.load(path)
+    np.testing.assert_array_equal(single.asnumpy(), np.full((4,), 7, np.float32))
+
+
+def test_legacy_npz_load(tmp_path):
+    """Round-1 checkpoints (npz container) must keep loading."""
+    path = str(tmp_path / "old.params")
+    np.savez(path, **{"arg:w": np.ones((2, 2), np.float32)})
+    out = nd.load(path)  # np.savez appends .npz; _npz_path resolves it
+    np.testing.assert_array_equal(out["arg:w"].asnumpy(), np.ones((2, 2)))
+
+
+def test_truncated_file_rejected(tmp_path):
+    arr = np.ones((4, 4), np.float32)
+    path = str(tmp_path / "t.params")
+    ser.save_nd(path, [arr], ["x"])
+    with open(path, "rb") as f:
+        buf = f.read()
+    with open(path, "wb") as f:
+        f.write(buf[:len(buf) - 10])
+    with pytest.raises(ValueError):
+        ser.load_nd(path)
+
+
+def test_module_checkpoint_binary(tmp_path):
+    """Module.save_checkpoint params files are the binary container now."""
+    from mxnet_tpu import sym
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3, name="fc")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (2, 5))], label_shapes=None)
+    mod.init_params()
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1)
+    with open(prefix + "-0001.params", "rb") as f:
+        assert ser.is_binary_nd(f.read(8))
+    loaded_sym, args, aux = mx.model.load_checkpoint(prefix, 1)
+    assert "fc_weight" in args and args["fc_weight"].shape == (3, 5)
