@@ -90,11 +90,21 @@ class Context:
         stack = getattr(cls._default, "stack", None)
         if stack:
             return stack[-1]
+        global _DEFAULT
+        if _DEFAULT is None:
+            # Resolved on first use, NOT at import: touching jax.devices()
+            # at import time would initialize the XLA backend and break the
+            # create-kvstore-before-arrays contract jax.distributed needs.
+            _DEFAULT = Context("tpu", 0) if _accel_devices() else Context("cpu", 0)
         return _DEFAULT
 
 
 def _cpu_devices():
-    return jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+    # local_devices, not devices(): under jax.distributed a Context must name
+    # a process-addressable device (reference: each worker owns its own GPUs)
+    if jax.default_backend() != "cpu":
+        return jax.local_devices(backend="cpu")
+    return jax.local_devices()
 
 
 _ACCEL_CACHE: Optional[list] = None
@@ -103,12 +113,12 @@ _ACCEL_CACHE: Optional[list] = None
 def _accel_devices():
     global _ACCEL_CACHE
     if _ACCEL_CACHE is None:
-        devs = jax.devices()
+        devs = jax.local_devices()
         _ACCEL_CACHE = [d for d in devs if d.platform not in ("cpu",)]
     return _ACCEL_CACHE
 
 
-_DEFAULT = Context("cpu", 0)
+_DEFAULT: Optional[Context] = None  # lazily resolved by default_ctx()
 
 
 def cpu(device_id: int = 0) -> Context:
@@ -145,11 +155,3 @@ def current_context() -> Context:
     return Context.default_ctx()
 
 
-def _init_default():
-    """Make the accelerator the process default when present (TPU-first)."""
-    global _DEFAULT
-    if _accel_devices():
-        _DEFAULT = Context("tpu", 0)
-
-
-_init_default()

@@ -1,4 +1,4 @@
-"""Distributed KVStore: multi-process sync over jax.distributed + async ZMQ PS.
+"""Distributed KVStore: multi-process sync over jax.distributed + async TCP PS.
 
 Reference: ``src/kvstore/kvstore_dist.h`` / ``kvstore_dist_server.h`` over
 ps-lite (TBV — SURVEY.md §3.4, §5.8 transport 3).
@@ -6,14 +6,21 @@ ps-lite (TBV — SURVEY.md §3.4, §5.8 transport 3).
 TPU-native redesign:
 
 - ``dist_sync`` / ``dist_device_sync``: each process is a jax.distributed
-  worker; push/pull map to a global-sum collective over the DCN/ICI mesh via
-  ``jax.make_array_from_process_local_data`` + psum (multi-host pjit subsumes
-  per-key RPC). Environment mirrors the reference launcher contract:
-  DMLC_NUM_WORKER / DMLC_WORKER_ID (or MXNET_COORDINATOR for jax.distributed).
-- ``dist_async``: a literal host-side parameter server over ZMQ-style TCP
-  (pure-stdlib socket framing; C++ server planned) — workers push grads, the
-  server applies the optimizer on arrival, workers pull fresh weights with no
-  barrier. See mxnet_tpu/kvstore/ps_server.py.
+  worker; push maps to a global-sum collective over the DCN mesh
+  (``jax.make_array_from_process_local_data`` + an all-reduce jit). One
+  1-device-per-process mesh is built once and reused for every key/step, so
+  each (shape, dtype) compiles exactly once. Environment mirrors the
+  reference launcher contract: ``DMLC_NUM_WORKER`` / ``DMLC_WORKER_ID`` and
+  ``MXNET_COORDINATOR`` (or ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT``) —
+  all set by ``tools/launch.py``.
+- ``dist_async``: a literal host-side parameter server over plain TCP —
+  workers push grads, the server applies the optimizer on arrival, workers
+  pull fresh weights with no barrier (native/ps/ps_server.cc or the python
+  twin mxnet_tpu/kvstore/ps_server.py).
+
+Create the kvstore before touching any jax arrays: ``jax.distributed``
+must initialize before the local backend is first used (same
+create-kvstore-first ordering the reference launcher assumes).
 """
 from __future__ import annotations
 
@@ -34,6 +41,8 @@ class DistKVStore(KVStore):
         self._rank = int(get_env("DMLC_WORKER_ID", get_env("MXNET_WORKER_ID", 0, int), int) or 0)
         self._num_workers = int(get_env("DMLC_NUM_WORKER", get_env("MXNET_NUM_WORKER", 1, int), int) or 1)
         self._ps = None
+        self._mesh = None
+        self._gc = None
         if self._is_async:
             addr = get_env("MXNET_PS_ADDR", get_env("DMLC_PS_ROOT_URI", None))
             port = int(get_env("MXNET_PS_PORT", get_env("DMLC_PS_ROOT_PORT", 9091, int), int) or 9091)
@@ -50,10 +59,55 @@ class DistKVStore(KVStore):
         import jax
 
         coord = get_env("MXNET_COORDINATOR", None)
-        if coord and jax.process_count() == 1:
+        if not coord:
+            uri = get_env("DMLC_PS_ROOT_URI", None)
+            port = get_env("DMLC_PS_ROOT_PORT", None)
+            if uri and port:
+                coord = f"{uri}:{port}"
+        if not coord:
+            raise MXNetError(
+                "dist_sync needs MXNET_COORDINATOR (or DMLC_PS_ROOT_URI + "
+                "DMLC_PS_ROOT_PORT) — launch through tools/launch.py")
+        # NB: can't guard with jax.process_count() — that call would itself
+        # initialize the backend before distributed init.
+        from jax._src import distributed as _jax_dist
+
+        if _jax_dist.global_state.client is None:
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=self._num_workers,
                                        process_id=self._rank)
+
+    def _dcn_mesh(self):
+        """One device per process, built once (SURVEY §5.8: DCN allreduce)."""
+        if self._mesh is None:
+            import numpy as np
+            import jax
+            from jax.sharding import Mesh
+
+            devs = (np.array(jax.devices())
+                    .reshape(jax.process_count(), -1)[:, :1].reshape(-1))
+            self._mesh = Mesh(devs, ("worker",))
+        return self._mesh
+
+    def _allreduce(self, nd_arr, bcast_from=None):
+        """Global sum (or broadcast of one rank's value) across processes."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ndarray import NDArray
+
+        if self._num_workers <= 1 or jax.process_count() == 1:
+            return nd_arr
+        mesh = self._dcn_mesh()
+        local = np.asarray(nd_arr.asnumpy())[None]
+        if bcast_from is not None and self._rank != bcast_from:
+            local = np.zeros_like(local)
+        garr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("worker")), local)
+        out = _sum_over_workers(garr, mesh)
+        return NDArray(np.asarray(jax.device_get(out)))
 
     @property
     def rank(self):
@@ -63,6 +117,29 @@ class DistKVStore(KVStore):
     def num_workers(self):
         return self._num_workers
 
+    def init(self, key, value):
+        if self._ps is not None:
+            keys, values = _as_list(key), _as_list(value)
+            for k, v in zip(keys, values):
+                self._ps.init(str(k), v.asnumpy())
+            return
+        if self._num_workers > 1:
+            # reference semantics: rank 0's init value wins on the server
+            keys, values = _as_list(key), _as_list(value)
+            for k, v in zip(keys, values):
+                super().init(str(k), self._allreduce(v, bcast_from=0))
+            return
+        super().init(key, value)
+
+    def set_gradient_compression(self, compression_params):
+        from .compression import (GradientCompression,
+                                  validate_compression_params)
+
+        params = validate_compression_params(compression_params)
+        self._gc = (GradientCompression(params["threshold"])
+                    if params else None)
+        self._compression = params
+
     def push(self, key, value, priority=0):
         if self._ps is not None:
             keys, values = _as_list(key), _as_list(value)
@@ -71,18 +148,26 @@ class DistKVStore(KVStore):
                 merged = vs[0]
                 for e in vs[1:]:
                     merged = merged + e
-                self._ps.push(str(k), merged.asnumpy())
+                self._ps.push(str(k), merged.asnumpy(),
+                              compressor=getattr(self, "_gc", None))
             return
         if self._num_workers > 1:
-            # sum across processes via a psum on the global mesh
             keys, values = _as_list(key), _as_list(value)
             for k, v in zip(keys, values):
                 vs = _as_list(v)
                 merged = vs[0]
                 for e in vs[1:]:
                     merged = merged + e
-                reduced = _cross_process_sum(merged)
-                super().push(str(k), reduced)
+                gc = getattr(self, "_gc", None)
+                if gc is not None:
+                    # same numerics as the PS path: per-worker quantization
+                    # with error feedback, then the exact sum of the ±t codes
+                    # (the collective itself still moves f32 over DCN)
+                    from ..ndarray import NDArray
+
+                    packed = gc.compress(str(k), merged.asnumpy())
+                    merged = NDArray(gc.decompress(packed, merged.shape))
+                super().push(str(k), self._allreduce(merged))
             return
         super().push(key, value, priority)
 
@@ -104,56 +189,31 @@ class DistKVStore(KVStore):
             return
         super().set_optimizer(optimizer)
 
-    def init(self, key, value):
-        if self._ps is not None:
-            keys, values = _as_list(key), _as_list(value)
-            for k, v in zip(keys, values):
-                self._ps.init(str(k), v.asnumpy())
-            return
-        super().init(key, value)
-
     def barrier(self):
         if self._ps is not None:
             self._ps.barrier()
             return
         if self._num_workers > 1:
-            import jax
-            import jax.numpy as jnp
+            import numpy as np
 
-            # an effectful collective barrier: global sum of a scalar
-            _cross_process_sum_scalar()
+            from ..ndarray import array
+
+            self._allreduce(array(np.zeros(1, np.float32)))
 
 
-def _cross_process_sum(nd_arr):
-    """Sum an identical-shaped array across jax processes (DCN allreduce)."""
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _reducer_for(mesh):
+    """One jitted reduce per mesh; jax then caches one program per shape."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if jax.process_count() == 1:
-        return nd_arr
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    devs = np.array(jax.devices()).reshape(jax.process_count(), -1)[:, :1].reshape(-1)
-    mesh = Mesh(devs, ("w",))
-    local = nd_arr.asjax()[None]
-    garr = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("w")), np.asarray(local))
-
-    @jax.jit
-    def reduce_fn(x):
-        return jnp.sum(x, axis=0)
-
-    out = reduce_fn(garr)
-    from ..ndarray import NDArray
-
-    return NDArray(jax.device_get(out))
+    return jax.jit(lambda x: jnp.sum(x, axis=0),
+                   out_shardings=NamedSharding(mesh, P()))
 
 
-def _cross_process_sum_scalar():
-    import jax
-    import numpy as np
-
-    from ..ndarray import array
-
-    _cross_process_sum(array(np.zeros(1, np.float32)))
+def _sum_over_workers(garr, mesh):
+    return _reducer_for(mesh)(garr)

@@ -1,0 +1,90 @@
+"""Known-value distributed kvstore worker (reference
+``tests/nightly/dist_sync_kvstore.py`` pattern — expected path per SURVEY.md
+§4; launched by tools/launch.py from tests/test_dist.py).
+
+Each worker pushes rank-determined values and asserts exact aggregates, so
+any lost/duplicated/reordered reduction fails loudly. Exit code 0 == pass.
+
+Order matters: the kvstore must be created before the first jax array so
+jax.distributed initializes before the local backend (dist_sync mode).
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    mode = sys.argv[1]  # dist_sync | dist_async
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create(mode)
+    rank, n = kv.rank, kv.num_workers
+    assert n >= 2, f"need >=2 workers, got {n}"
+    shape = (2, 3)
+
+    # --- init: rank 0's value must win everywhere
+    kv.init("w", nd.array(np.full(shape, 5.0 + rank, np.float32)))
+    out = nd.zeros(shape)
+    if mode == "dist_sync":
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.full(shape, 5.0), rtol=0)
+    else:  # async: first init wins — any of the ranks' values is legal, but
+        kv.barrier()  # all ranks must agree on which one won
+        kv.pull("w", out=out)
+        first = out.asnumpy()
+        assert np.all(first == first.flat[0]), first
+        kv.barrier()  # nobody pushes until everyone has read the init value
+
+    # --- push: aggregate must be the exact cross-worker sum
+    kv.push("w", nd.array(np.full(shape, float(rank + 1), np.float32)))
+    kv.barrier()
+    kv.pull("w", out=out)
+    expect_sum = n * (n + 1) / 2.0
+    if mode == "dist_sync":
+        # local-store semantics: push replaces the value with the aggregate
+        np.testing.assert_allclose(out.asnumpy(), np.full(shape, expect_sum))
+    else:
+        # async server accumulates into the stored weight: init + sum
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.full(shape, first.flat[0] + expect_sum))
+
+    # --- two pushes before a pull accumulate (reference merge semantics)
+    if mode == "dist_sync":
+        kv.init("g", nd.zeros(shape))
+        kv.push("g", nd.array(np.full(shape, 1.0, np.float32)))
+        kv.push("g", nd.array(np.full(shape, 10.0, np.float32)))
+        kv.pull("g", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.full(shape, 11.0 * n))
+
+    # --- optimizer-on-store: w -= lr * sum(grads), identically on all ranks
+    kv2_key = "opt_w"
+    kv.init(kv2_key, nd.array(np.ones(shape, np.float32)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0))
+    kv.barrier()
+    kv.push(kv2_key, nd.array(np.full(shape, float(rank + 1), np.float32)))
+    kv.barrier()
+    kv.pull(kv2_key, out=out)
+    if mode == "dist_sync":
+        expect = 1.0 - 0.1 * expect_sum
+        np.testing.assert_allclose(out.asnumpy(), np.full(shape, expect),
+                                   rtol=1e-6)
+    else:
+        # async: n sequential sgd steps, one per worker's push
+        expect = 1.0 - 0.1 * expect_sum
+        np.testing.assert_allclose(out.asnumpy(), np.full(shape, expect),
+                                   rtol=1e-5)
+
+    kv.barrier()
+    print(f"dist_worker rank {rank}/{n} mode={mode}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
