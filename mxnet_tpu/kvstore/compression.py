@@ -1,0 +1,115 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: ``src/kvstore/gradient_compression.cc`` (expected path per
+SURVEY.md §2.4 — mount empty this round). Semantics reproduced:
+
+- Each f32 gradient value quantizes to 2 bits against a threshold:
+  ``01`` if residual >= threshold, ``10`` if residual <= -threshold, ``00``
+  otherwise (4 values per byte, little-endian within the byte).
+- Error feedback: the worker keeps a per-key residual; each round
+  ``residual += grad``, the quantized value ``±threshold`` is sent, and the
+  sent amount is subtracted from the residual — no gradient mass is ever
+  dropped, only delayed.
+
+Wire format (shared with the PS servers — python twin and
+native/ps/ps_server.cc): dtype code ``16`` in the standard array framing,
+payload = ``f32 threshold | packed bytes``. 16× smaller on the wire than f32
+for large tensors.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+TWO_BIT_DTYPE_CODE = 16
+
+__all__ = ["GradientCompression", "TWO_BIT_DTYPE_CODE",
+           "quantize_2bit", "dequantize_2bit", "validate_compression_params"]
+
+
+def validate_compression_params(params) -> Optional[dict]:
+    """Reference kvstore.set_gradient_compression contract: type in
+    {'none', '2bit'}, threshold > 0. Anything else must raise, not no-op."""
+    if params is None:
+        return None
+    params = dict(params)
+    ctype = params.pop("type", None)
+    if ctype in (None, "none"):
+        if params:
+            raise MXNetError(f"unexpected compression params {params}")
+        return None
+    if ctype != "2bit":
+        raise MXNetError(
+            f"gradient compression type {ctype!r} is not supported "
+            "(supported: '2bit')")
+    threshold = float(params.pop("threshold", 0.5))
+    if threshold <= 0:
+        raise MXNetError("threshold must be > 0")
+    if params:
+        raise MXNetError(f"unexpected compression params {params}")
+    return {"type": "2bit", "threshold": threshold}
+
+
+def quantize_2bit(residual: np.ndarray, threshold: float):
+    """Quantize `residual` in place: returns packed uint8 codes and subtracts
+    the transmitted amount from `residual` (error feedback)."""
+    pos = residual >= threshold
+    neg = residual <= -threshold
+    codes = np.where(pos, np.uint8(1), np.where(neg, np.uint8(2), np.uint8(0)))
+    codes = codes.astype(np.uint8).ravel()
+    residual -= threshold * (pos.astype(np.float32) - neg.astype(np.float32))
+    pad = (-len(codes)) % 4
+    if pad:
+        codes = np.concatenate([codes, np.zeros(pad, np.uint8)])
+    c = codes.reshape(-1, 4)
+    packed = (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4) | (c[:, 3] << 6))
+    return packed.astype(np.uint8)
+
+
+def dequantize_2bit(packed: np.ndarray, threshold: float, size: int,
+                    dtype=np.float32) -> np.ndarray:
+    """Unpack 2-bit codes back to ±threshold / 0 floats (flat, length=size)."""
+    p = packed.astype(np.uint8)
+    codes = np.empty((len(p), 4), np.uint8)
+    codes[:, 0] = p & 3
+    codes[:, 1] = (p >> 2) & 3
+    codes[:, 2] = (p >> 4) & 3
+    codes[:, 3] = (p >> 6) & 3
+    flat = codes.ravel()[:size]
+    out = np.zeros(size, dtype)
+    out[flat == 1] = threshold
+    out[flat == 2] = -threshold
+    return out
+
+
+class GradientCompression:
+    """Worker-side state: residuals per key + pack/unpack helpers."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = float(threshold)
+        self._residuals: Dict[str, np.ndarray] = {}
+
+    def compress(self, key: str, grad: np.ndarray) -> np.ndarray:
+        res = self._residuals.get(key)
+        if res is None or res.shape != grad.shape:
+            res = self._residuals[key] = np.zeros(grad.shape, np.float32)
+        res += grad.astype(np.float32)
+        return quantize_2bit(res, self.threshold)
+
+    def decompress(self, packed: np.ndarray, shape) -> np.ndarray:
+        size = int(np.prod(shape)) if len(shape) else 1
+        return dequantize_2bit(packed, self.threshold, size).reshape(shape)
+
+    def pack_wire(self, key: str, grad: np.ndarray) -> bytes:
+        """Array framing payload with dtype code 16 (see module docstring)."""
+        import struct
+
+        packed = self.compress(key, grad)
+        head = struct.pack("<B", grad.ndim) \
+            + struct.pack(f"<{grad.ndim}I", *grad.shape) \
+            + struct.pack("<B", TWO_BIT_DTYPE_CODE) \
+            + struct.pack("<f", self.threshold)
+        return head + packed.tobytes()

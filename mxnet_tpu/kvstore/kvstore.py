@@ -132,7 +132,16 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        # reference contract: only dist kvstores compress; anything else must
+        # fail loudly, not silently alter training semantics
+        from .compression import validate_compression_params
+
+        params = validate_compression_params(compression_params)
+        if params is not None:
+            raise MXNetError(
+                f"gradient compression is not supported for kvstore type "
+                f"{self._kind!r}; use dist_sync or dist_async")
+        self._compression = None
 
     # -- persistence / misc ----------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):

@@ -1,32 +1,82 @@
-"""Worker-side client for the async parameter server (see ps_server.py)."""
+"""Worker-side client for the async parameter server (see ps_server.py).
+
+Failure handling (SURVEY.md §5.3): every RPC has a socket timeout and
+reconnect-retry with backoff, so a killed/restarted server looks like a slow
+RPC, not a worker crash. Retries give at-least-once semantics — a PUSH whose
+reply was lost may be applied twice, the same contract the reference's
+ps-lite resend path has.
+"""
 from __future__ import annotations
 
-import pickle
 import socket
 import threading
+import time
 
 import numpy as np
 
+from ..base import MXNetError
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PUSH, OP_SET_OPT,
                         OP_SHUTDOWN, _pack_array, _recv_msg, _send_msg,
                         _unpack_array)
 
 
 class PSClient:
-    def __init__(self, host: str, port: int):
-        self._sock = socket.create_connection((host, port), timeout=30)
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 retries: int = 5, retry_interval: float = 0.5):
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._retries = max(1, int(retries))
+        self._retry_interval = retry_interval
         self._lock = threading.Lock()
+        self._sock = None
+        self._connect()
 
-    def _rpc(self, opcode, key="", payload=b""):
+    def _connect(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+
+    def _rpc(self, opcode, key="", payload=b"", timeout=None, retries=None):
+        retries = self._retries if retries is None else retries
         with self._lock:
-            _send_msg(self._sock, opcode, key, payload)
-            return _recv_msg(self._sock)
+            last_err = None
+            for attempt in range(retries):
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    if timeout is not None:
+                        self._sock.settimeout(timeout)
+                    _send_msg(self._sock, opcode, key, payload)
+                    reply = _recv_msg(self._sock)
+                    if timeout is not None:
+                        self._sock.settimeout(self._timeout)
+                    return reply
+                except (ConnectionError, OSError) as e:  # incl. timeouts
+                    last_err = e
+                    if self._sock is not None:  # reconnect itself may fail
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    time.sleep(self._retry_interval * (attempt + 1))
+            raise MXNetError(
+                f"PS rpc op={opcode} key={key!r} failed after "
+                f"{retries} attempts: {last_err}")
 
     def init(self, key: str, value: np.ndarray):
         self._rpc(OP_INIT, key, _pack_array(np.ascontiguousarray(value)))
 
-    def push(self, key: str, grad: np.ndarray):
-        self._rpc(OP_PUSH, key, _pack_array(np.ascontiguousarray(grad)))
+    def push(self, key: str, grad: np.ndarray, compressor=None):
+        if compressor is not None:
+            payload = compressor.pack_wire(key, np.ascontiguousarray(grad))
+        else:
+            payload = _pack_array(np.ascontiguousarray(grad))
+        self._rpc(OP_PUSH, key, payload)
 
     def pull(self, key: str) -> np.ndarray:
         _, _, payload = self._rpc(OP_PULL, key)
@@ -48,7 +98,9 @@ class PSClient:
         self._rpc(OP_SET_OPT, "", spec.encode("ascii"))
 
     def barrier(self):
-        self._rpc(OP_BARRIER)
+        # not idempotent (a lost reply would double-enter the barrier) and
+        # may legitimately block for the server's 60s straggler window
+        self._rpc(OP_BARRIER, timeout=90.0, retries=1)
 
     def shutdown(self):
         self._rpc(OP_SHUTDOWN)

@@ -44,6 +44,16 @@ def _unpack_array(buf: memoryview) -> np.ndarray:
     ndim = struct.unpack_from("<B", buf, 0)[0]
     shape = struct.unpack_from(f"<{ndim}I", buf, 1)
     code = struct.unpack_from("<B", buf, 1 + 4 * ndim)[0]
+    if code == 16:  # 2-bit compressed gradient (see kvstore/compression.py)
+        from .compression import dequantize_2bit
+
+        size = int(np.prod(shape)) if ndim else 1
+        off = 6 + 4 * ndim
+        if len(buf) < off or len(buf) - off < (size + 3) // 4:
+            raise ConnectionError("truncated 2-bit payload")  # drops the conn
+        (threshold,) = struct.unpack_from("<f", buf, 2 + 4 * ndim)
+        packed = np.frombuffer(buf, dtype=np.uint8, offset=off)
+        return dequantize_2bit(packed, threshold, size).reshape(shape)
     dtype = np.dtype(CODE_TO_DTYPE[code])
     data = np.frombuffer(buf, dtype=dtype, offset=2 + 4 * ndim)
     return data.reshape(shape).copy()
@@ -97,6 +107,7 @@ class PSServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads = []
+        self._conns = []
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -107,8 +118,10 @@ class PSServer:
                 continue
             except OSError:
                 break
+            self._conns.append(conn)
             t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
             t.start()
+            self._threads = [th for th in self._threads if th.is_alive()]
             self._threads.append(t)
 
     def start(self):
@@ -122,9 +135,27 @@ class PSServer:
             self._sock.close()
         except OSError:
             pass
+        for c in self._conns:  # sever live sessions too — a stopped server
+            try:               # must look dead, not half-alive
+                c.close()
+            except OSError:
+                pass
 
     # ------------------------------------------------------------------
     def _handle(self, conn: socket.socket):
+        try:
+            self._handle_loop(conn)
+        finally:  # prune: reconnect-retrying clients make churn routine
+            try:
+                conn.close()
+            except OSError:
+                pass
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+
+    def _handle_loop(self, conn: socket.socket):
         try:
             while True:
                 opcode, key, payload = _recv_msg(conn)

@@ -1,0 +1,96 @@
+"""Failure-detection & debug subsystems (SURVEY.md §5.2/§5.3):
+MX_SYNC=1 naive-engine debug mode, and PS client surviving a killed and
+restarted server."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_mx_sync_mode_subprocess():
+    """MX_SYNC=1 must block after every invoke — verified by flipping the
+    module flag in a child process and checking ops still compute right."""
+    code = """
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import ndarray as nd_mod
+assert nd_mod._MX_SYNC, "MX_SYNC env not honored"
+a = nd.array(np.arange(6, np.float32).reshape(2, 3)) if False else nd.array(np.arange(6).astype(np.float32).reshape(2, 3))
+b = (a * 2 + 1).sum()
+assert float(b.asnumpy()) == 36.0, float(b.asnumpy())
+print("MX_SYNC OK")
+"""
+    env = dict(os.environ)
+    env["MX_SYNC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, timeout=120)
+    assert out.returncode == 0 and "MX_SYNC OK" in out.stdout, out.stdout[-2000:]
+
+
+def test_naive_engine_alias_subprocess():
+    """Reference spelling MXNET_ENGINE_TYPE=NaiveEngine enables the same mode."""
+    code = """
+import mxnet_tpu
+from mxnet_tpu.ndarray import ndarray as nd_mod
+assert nd_mod._MX_SYNC
+print("alias OK")
+"""
+    env = dict(os.environ)
+    env.pop("MX_SYNC", None)
+    env["MXNET_ENGINE_TYPE"] = "NaiveEngine"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, timeout=120)
+    assert out.returncode == 0 and "alias OK" in out.stdout, out.stdout[-2000:]
+
+
+def test_ps_client_survives_server_restart():
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv = PSServer(host="127.0.0.1", port=0, num_workers=1)
+    srv.start()
+    port = srv.port
+    cli = PSClient("127.0.0.1", port, timeout=5, retries=8,
+                   retry_interval=0.25)
+    cli.init("w", np.zeros(4, np.float32))
+    cli.push("w", np.ones(4, np.float32))
+    np.testing.assert_allclose(cli.pull("w"), np.ones(4))
+
+    srv.stop()  # hard kill: connections die mid-session
+    time.sleep(0.5)
+    srv2 = PSServer(host="127.0.0.1", port=port, num_workers=1)
+    srv2.start()
+    try:
+        # state was lost with the server; the client reconnects transparently
+        cli.init("w", np.zeros(4, np.float32))
+        cli.push("w", np.full(4, 3.0, np.float32))
+        np.testing.assert_allclose(cli.pull("w"), np.full(4, 3.0))
+    finally:
+        srv2.stop()
+
+
+def test_ps_client_fails_loudly_when_server_gone():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv = PSServer(host="127.0.0.1", port=0, num_workers=1)
+    srv.start()
+    cli = PSClient("127.0.0.1", srv.port, timeout=2, retries=2,
+                   retry_interval=0.1)
+    cli.init("w", np.zeros(2, np.float32))
+    srv.stop()
+    time.sleep(0.3)
+    with pytest.raises(MXNetError):
+        cli.pull("w")
