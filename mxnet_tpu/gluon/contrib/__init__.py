@@ -1,0 +1,3 @@
+"""gluon.contrib — experimental Gluon surface (reference
+python/mxnet/gluon/contrib/, expected path per SURVEY.md §2.3)."""
+from . import estimator  # noqa: F401

@@ -1,0 +1,7 @@
+"""Estimator API (reference gluon/contrib/estimator/ — SURVEY.md §2.3)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,  # noqa: F401
+                            EarlyStoppingHandler, EpochBegin, EpochEnd,
+                            EventHandler, LoggingHandler, MetricHandler,
+                            StoppingHandler, TrainBegin, TrainEnd,
+                            ValidationHandler)

@@ -59,7 +59,7 @@ class MultiHeadAttention(HybridBlock):
         if mesh is not None:
             sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sp", 1)
 
-        from ..parallel.ring_attention import plain_attention
+        from ..ops.attention import fused_attention
 
         if mesh is not None and sp > 1:
             out = invoke_fn(
@@ -67,8 +67,11 @@ class MultiHeadAttention(HybridBlock):
                     qq, kk, vv, mesh, causal=self._causal),
                 [q, k, v])
         else:
+            # single-chip path: flash (Pallas) for long sequences, fused
+            # XLA softmax-attention otherwise — see ops/attention.py policy
             def attn(qq, kk, vv, mm=None):
-                return plain_attention(qq, kk, vv, mask=mm, causal=self._causal)
+                return fused_attention(qq, kk, vv, mask=mm,
+                                       causal=self._causal)
 
             ins = [q, k, v] + ([mask] if mask is not None else [])
             out = invoke_fn(attn, ins)

@@ -1,0 +1,60 @@
+"""Attention dispatch: plain XLA vs the Pallas flash kernel.
+
+Policy (measured on the round-2 chip, tests/test_flash_attention.py):
+- short sequences: XLA's fused softmax-attention is fastest and the S×S
+  scores fit — use ``plain``.
+- long sequences (≥ _FLASH_MIN_SEQ): the scores tensor is the memory wall;
+  the flash kernel keeps O(S·D) live and wins on time too — use ``flash``.
+- explicit masks: plain (the kernel handles causal only).
+
+``MXNET_ATTENTION_IMPL`` ∈ {auto, plain, flash} overrides.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import flash_attention, flash_attention_with_lse
+
+__all__ = ["fused_attention", "plain_attention"]
+
+_FLASH_MIN_SEQ = 1024
+
+
+def plain_attention(q, k, v, mask=None, causal=False, scale=None):
+    """Single-device reference attention. q,k,v: (B, H, S, D)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -jnp.inf)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def _flash_ok(q, k):
+    # block specs cover the full head dim, so only S needs tiling-friendly
+    # factors (block sizes are shrunk to divide S; 8 is the sublane minimum)
+    s_q, s_k = q.shape[-2], k.shape[-2]
+    return s_q == s_k and s_q % 8 == 0 and q.ndim == 4
+
+
+def fused_attention(q, k, v, mask=None, causal=False, scale=None, impl=None):
+    """The attention entry point for the model zoo (MultiHeadAttention)."""
+    impl = impl or os.environ.get("MXNET_ATTENTION_IMPL", "auto")
+    if impl == "flash":
+        use_flash = mask is None and _flash_ok(q, k)
+    elif impl == "plain":
+        use_flash = False
+    else:  # auto
+        use_flash = (mask is None and _flash_ok(q, k)
+                     and q.shape[-2] >= _FLASH_MIN_SEQ)
+    if use_flash:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return plain_attention(q, k, v, mask=mask, causal=causal, scale=scale)

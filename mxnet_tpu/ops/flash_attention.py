@@ -1,0 +1,249 @@
+"""Hand-written Pallas flash attention for TPU.
+
+The hot op of the transformer family (SURVEY.md §7 step 8). Forward is a
+Pallas kernel: one Q block stays in VMEM while the kernel streams K/V blocks,
+keeping online-softmax statistics in f32 registers — the S×S score matrix is
+never materialized in HBM, so memory is O(S·D) instead of O(S²) and long
+contexts fit on chip. Backward is the standard flash recompute, expressed as
+a blocked ``lax.scan`` over K/V blocks in plain JAX (XLA fuses it; memory
+O(S·block)).
+
+Causal masking takes a **dynamic row offset**: visibility is
+``row + offset >= col``. offset=0 is standard causal; ring attention
+(parallel/ring_attention.py) passes ``(my_rank - src_rank) * s_local`` so one
+kernel call handles fully-visible (offset ≥ S), diagonal (0), and
+fully-masked (≤ -S) visiting blocks — the masked case runs zero K/V
+iterations. Returns (out, lse); lse is the statistic the ring uses to merge
+per-device blocks, so the same kernel serves single-chip and
+sequence-parallel paths.
+
+Reference counterpart: none — upstream MXNet 1.x has no fused attention op;
+this is TPU-first new surface. Kernel structure follows the public
+FlashAttention formulation (Dao et al.) and the Pallas TPU guide.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["flash_attention", "flash_attention_with_lse"]
+
+_NEG_INF = -1e30  # avoids -inf NaN propagation inside the kernel
+
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                scale, causal, block_q):
+    """Grid (BH, S // block_q). q block resident; stream K/V blocks."""
+    import jax.experimental.pallas as pl
+
+    q_blk_idx = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, D)
+    bq, d = q.shape
+    s_total = k_ref.shape[1]
+    nk = s_total // block_k
+    offset = off_ref[0]
+    if causal:
+        # K/V blocks beyond the last visible column contribute nothing:
+        # max visible col = q_global_end + offset
+        q_end = q_blk_idx * block_q + bq
+        last = (q_end + offset + block_k - 1) // block_k
+        nk_run = jnp.clip(last, 0, nk)
+    else:
+        nk_run = nk
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # (bq,bk)
+        if causal:
+            rows = q_blk_idx * block_q + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+        blk_max = jnp.max(s, axis=-1)                  # (bq,)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m[:, None])
+        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        acc = acc * corr[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        l = l * corr + jnp.sum(p, axis=-1)
+        return acc, new_m, l
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = lax.fori_loop(0, nk_run, body, (acc0, m0, l0))
+    safe_l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(safe_l), _NEG_INF)
+    # lse lives in an (bq, 8)-lane block purely to satisfy TPU tiling
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (bq, 8))
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the input's vma so the kernel composes with
+    shard_map's check_vma (ring attention calls this inside shard_map)."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _fwd_pallas(q, k, v, offset, scale, causal, block_q, block_k, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    bh = b * h
+    q3 = q.reshape(bh, s, d)
+    k3 = k.reshape(bh, s, d)
+    v3 = v.reshape(bh, s, d)
+    off = jnp.asarray(offset, jnp.int32).reshape(1)
+    try:
+        vma = jax.typeof(q).vma
+        if vma and hasattr(lax, "pvary"):
+            missing = tuple(sorted(set(vma) - set(jax.typeof(off).vma)))
+            if missing:
+                off = lax.pvary(off, missing)
+    except (AttributeError, TypeError):
+        pass
+    grid = (bh, s // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
+                               causal=causal, block_q=block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            _sds((bh, s, d), q.dtype, q),
+            _sds((bh, s, 8), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(off, q3, k3, v3)
+    return out.reshape(b, h, s, d), lse[..., 0].reshape(b, h, s)
+
+
+def _bwd_blocked(scale, causal, block_k, res, g):
+    """Flash backward: blocked scan over K/V blocks with saved lse.
+
+    dS = P ∘ (dP − δ + dlse) with δ = rowsum(dO ∘ O); memory O(S·block_k).
+    """
+    q, k, v, offset, o, lse = res
+    do = g[0].astype(jnp.float32)
+    g_lse = g[1].astype(jnp.float32)  # ring attention differentiates lse too
+    b, h, s, d = q.shape
+    qf = q.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    delta = jnp.sum(do * of, axis=-1)                      # (B,H,S)
+    nk = s // block_k
+
+    rows = lax.broadcasted_iota(jnp.int32, (s, block_k), 0)
+
+    def blk(j):
+        k_blk = lax.dynamic_slice_in_dim(k, j * block_k, block_k, 2) \
+            .astype(jnp.float32)
+        v_blk = lax.dynamic_slice_in_dim(v, j * block_k, block_k, 2) \
+            .astype(jnp.float32)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk) * scale
+        if causal:
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (s, block_k), 1)
+            sc = jnp.where(rows + offset >= cols, sc, _NEG_INF)
+        p = jnp.exp(sc - lse[..., None])                   # (B,H,S,bk)
+        p = jnp.where(sc <= _NEG_INF / 2, 0.0, p)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_blk)
+        ds = p * (dp - delta[..., None] + g_lse[..., None]) * scale
+        dq_contrib = jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq_contrib, dk_blk, dv_blk
+
+    def step(dq, j):
+        dq_c, dk_blk, dv_blk = blk(j)
+        return dq + dq_c, (dk_blk, dv_blk)
+
+    dq, (dk_blocks, dv_blocks) = lax.scan(step, jnp.zeros_like(qf),
+                                          jnp.arange(nk))
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, s, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, s, d)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            _int_zero(offset))  # offset is int32: float0 cotangent
+
+
+def _int_zero(x):
+    import numpy as np
+
+    return np.zeros(x.shape, jax.dtypes.float0)
+
+
+def _pick_block(s, target):
+    blk = min(s, target)
+    while s % blk:
+        blk //= 2
+    return max(blk, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, offset, scale, causal, block_q, block_k, interpret):
+    return _fwd_pallas(q, k, v, offset, scale, causal, block_q, block_k,
+                       interpret)
+
+
+def _flash_fwd(q, k, v, offset, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, offset, scale, causal, block_q, block_k,
+                           interpret)
+    return (out, lse), (q, k, v, offset, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _bwd_blocked(scale, causal, block_k, res, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None, offset=0,
+                             block_q=256, block_k=256):
+    """(out, lse) — lse feeds ring attention's cross-device block combine.
+
+    ``offset`` (int scalar, may be traced): causal visibility is
+    ``row + offset >= col``; ignored when causal=False.
+    """
+    d = q.shape[-1]
+    s = q.shape[-2]
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    offset = jnp.asarray(offset, jnp.int32)
+    return _flash(q, k, v, offset, scale, causal, bq, bk, _use_interpret())
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=256):
+    """Flash attention. q,k,v: (B, H, S, D) → (B, H, S, D)."""
+    out, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                      block_q=block_q, block_k=block_k)
+    return out

@@ -46,7 +46,7 @@ class ShardedTrainer:
     def __init__(self, net, loss_fn, mesh: Mesh, rules: Optional[ShardingRules] = None,
                  optimizer: str = "sgd", optimizer_params: Optional[Dict] = None,
                  input_specs=P("dp"), label_specs=P("dp"), grad_clip: float = -1.0,
-                 donate: bool = True):
+                 donate: bool = True, compute_dtype=None):
         if optimizer not in _SUPPORTED:
             raise ValueError(f"optimizer {optimizer!r} not in {_SUPPORTED}")
         self.net = net
@@ -59,6 +59,11 @@ class ShardedTrainer:
         self._opt = opt
         self._grad_clip = grad_clip
         self._donate = donate
+        # AMP: fwd/bwd in compute_dtype (bf16 on the MXU), fp32 master
+        # weights + optimizer state. No loss scaling — bf16's exponent range
+        # matches fp32 (amp.py documents the same policy).
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype is not None else None)
 
         self._params = {p.name: p for p in net._iter_params() if p._data is not None}
         self._grad_names = [n for n, p in self._params.items() if p.grad_req != "null"]
@@ -124,11 +129,23 @@ class ShardedTrainer:
     def _build(self, n_extra_inputs):
         grad_names = self._grad_names
 
+        cdt = self._compute_dtype
+
+        def _cast(x):
+            if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(cdt)
+            return x
+
         def step_fn(param_vals, opt_state, lr, t, *batch):
             def loss_f(grad_part):
                 full = dict(param_vals)
                 full.update(grad_part)
-                out, aux = self._apply(full, *batch[:-1])
+                if cdt is not None:
+                    full = {k: _cast(v) for k, v in full.items()}
+                    batch_c = tuple(_cast(b) for b in batch[:-1]) + batch[-1:]
+                else:
+                    batch_c = batch
+                out, aux = self._apply(full, *batch_c[:-1])
                 outs = out if isinstance(out, tuple) else (out,)
                 loss_nd = self.loss_fn(*[NDArray(o) for o in outs],
                                        NDArray(batch[-1]))
@@ -144,7 +161,10 @@ class ShardedTrainer:
                                              opt_state[n], lr, t)
                 new_params[n] = new_w.astype(param_vals[n].dtype)
                 new_state[n] = st
-            new_params.update(aux)  # BatchNorm moving stats etc.
+            # BatchNorm moving stats etc. — keep master dtype under AMP
+            new_params.update({k: (v.astype(param_vals[k].dtype)
+                                   if k in param_vals else v)
+                               for k, v in aux.items()})
             return loss, new_params, new_state
 
         in_shardings = (

@@ -1,0 +1,105 @@
+"""gluon.contrib.estimator: fit loop, handler lifecycle, checkpoint/early
+stop (reference gluon/contrib/estimator tests pattern)."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               Estimator, EventHandler,
+                                               LoggingHandler)
+from mxnet_tpu.gluon.contrib.estimator.event_handler import (BatchEnd,
+                                                             EpochBegin,
+                                                             EpochEnd,
+                                                             TrainBegin,
+                                                             TrainEnd)
+
+
+def _toy_data(n=64, dim=8, classes=4, batch=16):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, dim).astype(np.float32)
+    y = rng.randint(0, classes, n).astype(np.float32)
+    ds = mx.gluon.data.ArrayDataset(nd.array(x), nd.array(y))
+    return mx.gluon.data.DataLoader(ds, batch_size=batch)
+
+
+def _toy_net(classes=4, dim=8):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=dim),
+            nn.Dense(classes, in_units=16))
+    net.initialize()
+    return net
+
+
+def test_estimator_fit_and_handlers():
+    events = []
+
+    class Recorder(TrainBegin, EpochBegin, BatchEnd, EpochEnd, TrainEnd):
+        def train_begin(self, est, *a, **k):
+            events.append("train_begin")
+
+        def epoch_begin(self, est, *a, **k):
+            events.append("epoch_begin")
+
+        def batch_end(self, est, *a, **k):
+            events.append("batch_end")
+
+        def epoch_end(self, est, *a, **k):
+            events.append("epoch_end")
+
+        def train_end(self, est, *a, **k):
+            events.append("train_end")
+
+    net = _toy_net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "adam",
+                                             {"learning_rate": 0.05}))
+    metrics = est.fit(_toy_data(), epochs=2, event_handlers=[Recorder()])
+    assert events[0] == "train_begin" and events[-1] == "train_end"
+    assert events.count("epoch_begin") == 2 and events.count("epoch_end") == 2
+    assert events.count("batch_end") == 8
+    names = [m.get()[0] for m in metrics]
+    assert "accuracy" in names and "loss" in names
+    loss_val = dict(m.get() for m in metrics)["loss"]
+    assert np.isfinite(loss_val)
+
+
+def test_estimator_converges_and_validates():
+    net = _toy_net(classes=2)
+    rng = np.random.RandomState(1)
+    x = rng.randn(128, 8).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    train = mx.gluon.data.DataLoader(
+        mx.gluon.data.ArrayDataset(nd.array(x), nd.array(y)), batch_size=32)
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "adam",
+                                             {"learning_rate": 0.05}))
+    est.fit(train, val_data=train, epochs=10)
+    acc = dict(m.get() for m in est.train_metrics)["accuracy"]
+    val_acc = dict(m.get() for m in est.val_metrics)["validation accuracy"]
+    assert acc > 0.8, acc
+    assert val_acc > 0.8, val_acc
+
+
+def test_estimator_checkpoint_and_early_stop(tmp_path):
+    net = _toy_net()
+    est = Estimator(net, mx.gluon.loss.SoftmaxCrossEntropyLoss(),
+                    metrics=mx.metric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "sgd",
+                                             {"learning_rate": 0.0}))
+    loss_metric = [m for m in est.train_metrics if m.get()[0] == "loss"][0]
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                             monitor=loss_metric, save_best=True)
+    # lr=0 → loss never improves → patience 1 stops at epoch 2
+    early = EarlyStoppingHandler(monitor=loss_metric, patience=1)
+    est.fit(_toy_data(), epochs=50, event_handlers=[ckpt, early])
+    assert early.stop_training
+    assert os.path.exists(str(tmp_path / "toy-epoch0.params"))
+    # checkpoint loads back
+    net2 = _toy_net()
+    net2.load_parameters(str(tmp_path / "toy-epoch0.params"))

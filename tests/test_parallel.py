@@ -153,3 +153,25 @@ def test_bert_forward_shape():
     net.initialize()
     out = net(nd.array(np.zeros((2, 10), np.int32)))
     assert out.shape == (2, 10, 64)
+
+
+def test_sharded_trainer_bf16_compute():
+    """AMP: bf16 fwd/bwd, fp32 master weights, loss decreases."""
+    import jax.numpy as jnp
+
+    net = bert_tiny(vocab_size=64, dropout=0.0, max_length=32)
+    net.initialize()
+    x = nd.array(np.random.RandomState(1).randint(0, 64, (4, 16)).astype(np.int32))
+    net(x)
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = par.make_mesh({"dp": 2}, devices=__import__("jax").devices()[:2])
+    trainer = par.ShardedTrainer(net, loss_fn, mesh, optimizer="adam",
+                                 optimizer_params={"learning_rate": 1e-3},
+                                 compute_dtype="bfloat16")
+    losses = [float(trainer.step(x, x).asnumpy()) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # master weights stay fp32 across steps (incl. BN/LN aux merges)
+    for n, v in trainer.param_vals.items():
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            assert v.dtype == jnp.float32, (n, v.dtype)
