@@ -77,6 +77,16 @@ class KVStore:
             merged = vs[0]
             for extra in vs[1:]:
                 merged = merged + extra
+            # DistKVStore keeps the raw params dict in _compression and does
+            # its own wire-level compression; only the device-kvstore path
+            # stores a GradientCompression here
+            comp = self._compression
+            if comp is not None and hasattr(comp, "compress"):
+                import numpy as _np
+
+                g = merged.asnumpy().astype(_np.float32)
+                packed = comp.compress(k, g)
+                merged = type(merged)(comp.decompress(packed, g.shape))
             if self._updater is not None:
                 self._updater(int(k) if k.isdigit() else k, merged, self._store[k])
             else:
@@ -132,16 +142,22 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        # reference contract: only dist kvstores compress; anything else must
-        # fail loudly, not silently alter training semantics
-        from .compression import validate_compression_params
+        # reference contract (kvstore.py set_gradient_compression): device
+        # and dist kvstores accept compression; cpu-only 'local' rejects it.
+        from .compression import GradientCompression, validate_compression_params
 
         params = validate_compression_params(compression_params)
-        if params is not None:
+        if params is None:
+            self._compression = None
+            return
+        if self._kind not in ("device", "ici", "nccl"):
             raise MXNetError(
                 f"gradient compression is not supported for kvstore type "
-                f"{self._kind!r}; use dist_sync or dist_async")
-        self._compression = None
+                f"{self._kind!r}; use device or dist_sync/dist_async")
+        # single-process semantics: quantize+dequantize each pushed gradient
+        # (with error feedback) so numerics match the dist wire format —
+        # there is no bandwidth to save inside one process
+        self._compression = GradientCompression(params["threshold"])
 
     # -- persistence / misc ----------------------------------------------
     def save_optimizer_states(self, fname, dump_optimizer=False):

@@ -100,7 +100,10 @@ class PSClient:
     def barrier(self):
         # not idempotent (a lost reply would double-enter the barrier) and
         # may legitimately block for the server's 60s straggler window
-        self._rpc(OP_BARRIER, timeout=90.0, retries=1)
+        _, _, payload = self._rpc(OP_BARRIER, timeout=90.0, retries=1)
+        if bytes(payload[:1]) == b"\x01":
+            raise TimeoutError(
+                "kvstore barrier timed out waiting for stragglers")
 
     def shutdown(self):
         self._rpc(OP_SHUTDOWN)

@@ -24,6 +24,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -99,6 +100,7 @@ class PSServer:
         self._global_lock = threading.Lock()
         self._num_workers = num_workers
         self._barrier_count = 0
+        self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -183,14 +185,28 @@ class PSServer:
                     self._set_optimizer_bytes(bytes(payload))
                     _send_msg(conn, OP_SET_OPT, key, b"\x00")
                 elif opcode == OP_BARRIER:
+                    # generation-counted barrier: a straggler timeout rolls
+                    # its arrival back instead of poisoning the next round
+                    ok = True
                     with self._barrier_cv:
+                        gen = self._barrier_gen
                         self._barrier_count += 1
                         if self._barrier_count >= self._num_workers:
                             self._barrier_count = 0
+                            self._barrier_gen += 1
                             self._barrier_cv.notify_all()
                         else:
-                            self._barrier_cv.wait(timeout=60)
-                    _send_msg(conn, OP_BARRIER, key, b"\x00")
+                            deadline = time.monotonic() + 60
+                            while self._barrier_gen == gen:
+                                remaining = deadline - time.monotonic()
+                                if remaining <= 0:
+                                    self._barrier_count = max(
+                                        0, self._barrier_count - 1)
+                                    ok = False
+                                    break
+                                self._barrier_cv.wait(timeout=remaining)
+                    _send_msg(conn, OP_BARRIER, key,
+                              b"\x00" if ok else b"\x01")
                 elif opcode == OP_SHUTDOWN:
                     _send_msg(conn, OP_SHUTDOWN, key, b"\x00")
                     self.stop()

@@ -57,6 +57,14 @@ _CPU_DEV_TYPE = 1  # Context::kCPU — loads are device-agnostic anyway
 
 
 def _write_array(out: List[bytes], arr: np.ndarray) -> None:
+    if arr.ndim == 0:
+        # The reference's ndim==0 record means "none" and carries NO
+        # ctx/dtype/data (1.x NDArrays are never 0-d; legacy scalars are
+        # shape (1,)). Writing trailing bytes after ndim=0 would desync any
+        # reader — promote genuine 0-d saves to shape (1,) instead.
+        warnings.warn("0-d NDArray saved as shape (1,) for reference "
+                      "format compatibility")
+        arr = arr.reshape(1)
     out.append(struct.pack("<Ii", _ND_V2, 0))  # magic, stype=default(dense)
     out.append(struct.pack("<I", arr.ndim))
     out.append(struct.pack(f"<{arr.ndim}q", *arr.shape))

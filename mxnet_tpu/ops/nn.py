@@ -298,13 +298,21 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
         gamma = jnp.ones_like(gamma)
     bshape = tuple(data.shape[i] if i == ax else 1 for i in range(data.ndim))
     if train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # batch stats in >=f32 so the f32 moving averages downstream don't
+        # accumulate bf16 rounding under AMP (XLA keeps this fused/cheap)
+        stat_t = jnp.promote_types(data.dtype, jnp.float32)
+        mean = jnp.mean(data.astype(stat_t), axis=red)
+        var = jnp.var(data.astype(stat_t), axis=red)
     else:
         mean, var = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) * gamma.reshape(bshape) \
-        + beta.reshape(bshape)
+    # Normalize in data's dtype: under AMP the statistics buffers stay in the
+    # f32 master dtype while activations run bf16 — without the cast the f32
+    # stats would silently promote the output and break dtype-strict consumers
+    # (lax.conv_general_dilated requires matching dtypes).
+    inv = lax.rsqrt(var + eps).astype(data.dtype)
+    out = (data - mean.astype(data.dtype).reshape(bshape)) * inv.reshape(bshape) \
+        * gamma.astype(data.dtype).reshape(bshape) \
+        + beta.astype(data.dtype).reshape(bshape)
     if output_mean_var:
         return out, mean, var
     return out

@@ -65,6 +65,29 @@ class ShardedTrainer:
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)
 
+        self._t = 0
+        self._in_sh = batch_sharding(mesh, input_specs if isinstance(input_specs, P)
+                                     else P(*input_specs))
+        self._label_sh = batch_sharding(mesh, label_specs if isinstance(label_specs, P)
+                                        else P(*label_specs))
+        self._step_fn = None
+        self._captured = False
+        self._params = {}
+        self._grad_names = []
+        self.param_vals = {}
+        self._param_shardings = {}
+        self.opt_state = {}
+        # Deferred-shape params (BatchNorm with in_channels=0 etc.) are still
+        # None here; capture must wait until the first step resolves shapes —
+        # capturing early would silently freeze those params out of training.
+        if not any(p._data is None for p in net._iter_params()):
+            self._capture()
+
+    def _capture(self):
+        """Snapshot the (now fully materialized) parameter set into sharded
+        device values + optimizer state. Runs once, at construction when all
+        shapes are known, else at the first step()."""
+        net, mesh = self.net, self.mesh
         self._params = {p.name: p for p in net._iter_params() if p._data is not None}
         self._grad_names = [n for n, p in self._params.items() if p.grad_req != "null"]
         names, self._apply = functionalize(net, train=True)
@@ -79,12 +102,7 @@ class ShardedTrainer:
             self.param_vals[n] = jax.device_put(p.data()._data, sh)
         self.opt_state = {n: self._init_state(self.param_vals[n])
                           for n in self._grad_names}
-        self._t = 0
-        self._in_sh = batch_sharding(mesh, input_specs if isinstance(input_specs, P)
-                                     else P(*input_specs))
-        self._label_sh = batch_sharding(mesh, label_specs if isinstance(label_specs, P)
-                                        else P(*label_specs))
-        self._step_fn = None
+        self._captured = True
 
     # ------------------------------------------------------------------
     def _init_state(self, val):
@@ -130,6 +148,11 @@ class ShardedTrainer:
         grad_names = self._grad_names
 
         cdt = self._compute_dtype
+        # AMP policy (reference contrib/amp: FP32 op list keeps norms' stats):
+        # cast trainable weights + inputs to the compute dtype; statistics
+        # buffers (grad_req="null" — BN running mean/var) keep the master
+        # dtype so moving averages don't accumulate bf16 rounding.
+        stat_names = {n for n, p in self._params.items() if p.grad_req == "null"}
 
         def _cast(x):
             if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
@@ -141,7 +164,8 @@ class ShardedTrainer:
                 full = dict(param_vals)
                 full.update(grad_part)
                 if cdt is not None:
-                    full = {k: _cast(v) for k, v in full.items()}
+                    full = {k: (v if k in stat_names else _cast(v))
+                            for k, v in full.items()}
                     batch_c = tuple(_cast(b) for b in batch[:-1]) + batch[-1:]
                 else:
                     batch_c = batch
@@ -184,6 +208,16 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     def step(self, *batch):
         """batch = (*inputs, labels); returns the (device) loss scalar."""
+        if not self._captured:
+            if any(p._data is None for p in self.net._iter_params()):
+                # resolve deferred shapes with one throwaway eager forward
+                # (pause() also switches training mode off for the duration)
+                from .. import autograd
+
+                with autograd.pause():
+                    self.net(*[b if isinstance(b, NDArray) else NDArray(jnp.asarray(b))
+                               for b in batch[:-1]])
+            self._capture()
         vals = [b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch]
         vals = [jax.device_put(v, self._in_sh if i < len(vals) - 1 else self._label_sh)
                 for i, v in enumerate(vals)]

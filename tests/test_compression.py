@@ -47,6 +47,24 @@ def test_set_gradient_compression_validation():
         mx.kv.create("local").set_gradient_compression({"type": "1bit"})
 
 
+def test_device_kvstore_compression():
+    """Reference permits 2-bit compression on 'device' kvstores: pushes are
+    quantized (with error feedback) so numerics match the dist wire format."""
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("3", mx.nd.zeros((4,)))
+    g = mx.nd.array(np.array([0.6, -0.6, 0.1, 0.0], np.float32))
+    kv.push("3", g)
+    out = mx.nd.zeros((4,))
+    kv.pull("3", out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # error feedback: leftover 0.1s accumulate and eventually transmit
+    for _ in range(5):
+        kv.push("3", g)
+    kv.pull("3", out=out)
+    assert out.asnumpy()[2] >= 0.5  # 6 * 0.1 > threshold
+
+
 def _with_python_ps(fn, num_workers=1):
     from mxnet_tpu.kvstore.ps_server import PSServer
 

@@ -175,3 +175,40 @@ def test_sharded_trainer_bf16_compute():
     for n, v in trainer.param_vals.items():
         if jnp.issubdtype(v.dtype, jnp.floating):
             assert v.dtype == jnp.float32, (n, v.dtype)
+
+
+def test_sharded_trainer_bf16_conv_bn():
+    """AMP on a conv+BN net — the ResNet-shaped path that crashed in round 2
+    (bf16 conv input meeting f32 BN output / frozen deferred BN params)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, in_channels=3))
+        net.add(nn.BatchNorm())  # in_channels deferred — the failing config
+        net.add(nn.Activation("relu"))
+        net.add(nn.Conv2D(8, 3, padding=1, in_channels=8))
+        net.add(nn.BatchNorm())
+        net.add(nn.Flatten())
+        net.add(nn.Dense(4))
+    net.initialize()
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = par.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    trainer = par.ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                                 optimizer_params={"learning_rate": 0.1,
+                                                   "momentum": 0.9},
+                                 compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 3, 8, 8).astype(np.float32))
+    y = nd.array(rng.randint(0, 4, 4).astype(np.int32))
+    losses = [float(trainer.step(x, y).asnumpy()) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    gammas = [n for n in trainer.param_vals if "gamma" in n]
+    rmeans = [n for n in trainer.param_vals if "running_mean" in n]
+    assert gammas and rmeans
+    # BN scale/shift are trained (deferred params captured), stats stay f32
+    # master dtype and actually move
+    for g in gammas:
+        assert g in trainer._grad_names
+    for rm in rmeans:
+        assert trainer.param_vals[rm].dtype == jnp.float32
+        assert bool(jnp.any(trainer.param_vals[rm] != 0))

@@ -115,3 +115,18 @@ def test_module_checkpoint_binary(tmp_path):
         assert ser.is_binary_nd(f.read(8))
     loaded_sym, args, aux = mx.model.load_checkpoint(prefix, 1)
     assert "fc_weight" in args and args["fc_weight"].shape == (3, 5)
+
+
+def test_zero_dim_roundtrip(tmp_path):
+    """A 0-d save must not desync the container (round-2 advisor finding):
+    scalars are promoted to shape (1,) — the reference's legacy encoding —
+    and arrays after the scalar still load."""
+    path = str(tmp_path / "scalar.params")
+    scalar = np.float32(3.25).reshape(())  # genuine 0-d
+    tail = np.arange(6, dtype=np.float32).reshape(2, 3)
+    with pytest.warns(UserWarning, match="0-d"):
+        ser.save_nd(path, [np.asarray(scalar), tail], ["loss", "w"])
+    loaded = ser.load_nd(path)
+    assert loaded["loss"].shape == (1,)
+    assert float(loaded["loss"][0]) == 3.25
+    np.testing.assert_array_equal(loaded["w"], tail)

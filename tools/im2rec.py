@@ -154,7 +154,8 @@ def main(argv=None):
                    help="phase 1: build the .lst file")
     p.add_argument("--recursive", action="store_true",
                    help="label images by subdirectory")
-    p.add_argument("--shuffle", type=bool, default=True)
+    p.add_argument("--shuffle", action=argparse.BooleanOptionalAction,
+                   default=True, help="shuffle the list (--no-shuffle off)")
     p.add_argument("--train-ratio", type=float, default=1.0)
     p.add_argument("--resize", type=int, default=0,
                    help="resize shorter side, 0 = keep")
