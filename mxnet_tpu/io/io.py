@@ -260,10 +260,17 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, part_index=0, num_parts=1, preprocess_threads=4,
                  round_batch=True, data_name="data", label_name="softmax_label",
-                 path_imgidx=None, **kwargs):
+                 path_imgidx=None, dtype="float32", **kwargs):
         super().__init__(batch_size)
         from .recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
 
+        # dtype="uint8" is the TPU-first fast path: raw pixels cross the
+        # host→device link (4x smaller) and mean/std normalization fuses
+        # into the jitted train step (see parallel.ShardedTrainer preprocess;
+        # .mean/.std expose the deferred constants).
+        if dtype not in ("float32", "uint8"):
+            raise ValueError(f"dtype must be float32|uint8, got {dtype!r}")
+        self.dtype = dtype
         self._unpack_img = unpack_img
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
@@ -272,6 +279,7 @@ class ImageRecordIter(DataIter):
         self._resize = resize
         self._mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
         self._std = np.array([std_r, std_g, std_b], np.float32).reshape(3, 1, 1)
+        self.mean, self.std = self._mean, self._std  # public for fused normalize
         self._shuffle = shuffle
         self._threads = max(1, int(preprocess_threads))
 
@@ -311,7 +319,8 @@ class ImageRecordIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        dt = np.uint8 if self.dtype == "uint8" else np.float32
+        return [DataDesc("data", (self.batch_size,) + self.data_shape, dt)]
 
     @property
     def provide_label(self):
@@ -338,8 +347,11 @@ class ImageRecordIter(DataIter):
             img = _center_crop(img, h, w)
         if self._rand_mirror and np.random.rand() < 0.5:
             img = img[:, ::-1]
-        chw = img.astype(np.float32).transpose(2, 0, 1)
-        chw = (chw - self._mean) / self._std
+        if self.dtype == "uint8":
+            chw = img.transpose(2, 0, 1)
+        else:
+            chw = img.astype(np.float32).transpose(2, 0, 1)
+            chw = (chw - self._mean) / self._std
         label = header.label
         if np.ndim(label) == 0:
             label = np.float32(label)
@@ -376,22 +388,32 @@ class ImageRecordIter(DataIter):
 
         bs = len(offsets)
         c, h, w = self.data_shape
-        data = np.empty((bs, 3, h, w), np.float32)
         labels = np.empty((bs, self.label_width), np.float32)
         offs = (ctypes.c_int64 * bs)(*offsets)
-        mean = (ctypes.c_float * 3)(*self._mean.ravel())
-        std = (ctypes.c_float * 3)(*self._std.ravel())
         self._seed_counter += 1
         seed = int(np.random.randint(0, 2 ** 31)) if (self._rand_crop or
                                                       self._rand_mirror) else \
             self._seed_counter
-        fails = self._native.mxtpu_decode_batch(
-            self._path.encode(), offs, bs, h, w, int(self._resize),
-            int(bool(self._rand_crop)), int(bool(self._rand_mirror)),
-            ctypes.c_uint64(seed), mean, std,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            self.label_width, self._threads)
+        if self.dtype == "uint8":
+            data = np.empty((bs, 3, h, w), np.uint8)
+            fails = self._native.mxtpu_decode_batch_u8(
+                self._path.encode(), offs, bs, h, w, int(self._resize),
+                int(bool(self._rand_crop)), int(bool(self._rand_mirror)),
+                ctypes.c_uint64(seed),
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.label_width, self._threads)
+        else:
+            data = np.empty((bs, 3, h, w), np.float32)
+            mean = (ctypes.c_float * 3)(*self._mean.ravel())
+            std = (ctypes.c_float * 3)(*self._std.ravel())
+            fails = self._native.mxtpu_decode_batch(
+                self._path.encode(), offs, bs, h, w, int(self._resize),
+                int(bool(self._rand_crop)), int(bool(self._rand_mirror)),
+                ctypes.c_uint64(seed), mean, std,
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                self.label_width, self._threads)
         if fails:
             raise RuntimeError(f"native decode failed for {fails} records")
         lab = labels[:, 0] if self.label_width == 1 else labels

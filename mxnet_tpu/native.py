@@ -66,11 +66,21 @@ def io_lib():
                         ctypes.POINTER(ctypes.c_float),
                         ctypes.POINTER(ctypes.c_float), ctypes.c_int,
                         ctypes.c_int]
+                    lib.mxtpu_decode_batch_u8.restype = ctypes.c_int
+                    lib.mxtpu_decode_batch_u8.argtypes = [
+                        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                        ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+                        ctypes.POINTER(ctypes.c_uint8),
+                        ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+                        ctypes.c_int]
                     lib.mxtpu_scan_offsets.restype = ctypes.c_int64
                     lib.mxtpu_scan_offsets.argtypes = [
                         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
                         ctypes.c_int64]
-                except OSError:
+                except (OSError, AttributeError):
+                    # OSError: unloadable .so; AttributeError: stale build
+                    # missing a newer symbol — fall back to the PIL path
                     lib = None
             _cache["io"] = lib
         return _cache["io"]

@@ -170,16 +170,31 @@ class Server {
                                    payload_len));
         SendMsg(conn, SET_OPT, key, std::string("\x00", 1));
       } else if (op == BARRIER) {
+        // Generation-counted barrier, matching the Python twin: a straggler
+        // timeout rolls its arrival back (instead of poisoning the next
+        // round) and replies \x01 so the client can surface the failure.
+        bool ok = true;
         {
           std::unique_lock<std::mutex> lk(barrier_mu_);
+          uint64_t gen = barrier_gen_;
           if (++barrier_count_ >= num_workers_) {
             barrier_count_ = 0;
+            ++barrier_gen_;
             barrier_cv_.notify_all();
           } else {
-            barrier_cv_.wait_for(lk, std::chrono::seconds(60));
+            auto deadline =
+                std::chrono::steady_clock::now() + std::chrono::seconds(60);
+            while (barrier_gen_ == gen) {
+              if (barrier_cv_.wait_until(lk, deadline) ==
+                  std::cv_status::timeout && barrier_gen_ == gen) {
+                if (barrier_count_ > 0) --barrier_count_;
+                ok = false;
+                break;
+              }
+            }
           }
         }
-        SendMsg(conn, BARRIER, key, std::string("\x00", 1));
+        SendMsg(conn, BARRIER, key, std::string(ok ? "\x00" : "\x01", 1));
       } else if (op == SHUTDOWN) {
         SendMsg(conn, SHUTDOWN, key, std::string("\x00", 1));
         stop_.store(true);
@@ -350,6 +365,7 @@ class Server {
   std::mutex barrier_mu_;
   std::condition_variable barrier_cv_;
   int barrier_count_ = 0;
+  uint64_t barrier_gen_ = 0;
 };
 
 }  // namespace

@@ -132,3 +132,40 @@ def test_resize_and_prefetch_iters():
     assert len(batches) == 5
     got = np.concatenate([b.data[0].asnumpy() for b in batches])
     np.testing.assert_allclose(np.sort(got), x)
+
+
+def test_image_record_iter_uint8(tmp_path):
+    """dtype="uint8" ships raw pixels; normalizing on "device" must match the
+    host-normalized float32 path (within JPEG fast-DCT tolerance)."""
+    from mxnet_tpu.io.recordio import pack_img
+
+    uri = str(tmp_path / "u8.rec")
+    w = MXRecordIO(uri, "w")
+    # smooth gradients: JPEG-decoder differences (fast DCT, plain chroma
+    # upsampling) are sub-LSB here, so mismatches indicate real plumbing bugs;
+    # noise images would measure codec divergence instead
+    yy, xx = np.mgrid[0:40, 0:40].astype(np.float32)
+    for i in range(8):
+        img = np.stack([yy * 6, xx * 6, (yy + xx) * 3 + i * 8], -1)
+        img = np.clip(img, 0, 255).astype(np.uint8)
+        w.write(pack_img(IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+
+    kw = dict(path_imgrec=uri, data_shape=(3, 32, 32), batch_size=8,
+              shuffle=False, rand_crop=False, rand_mirror=False,
+              mean_r=123.68, mean_g=116.78, mean_b=103.94,
+              std_r=58.4, std_g=57.12, std_b=57.38)
+    iu = ImageRecordIter(dtype="uint8", **kw)
+    bu = iu.next()
+    bf = ImageRecordIter(dtype="float32", **kw).next()
+    u8 = bu.data[0].asnumpy()
+    assert u8.dtype == np.uint8
+    assert iu.provide_data[0].dtype == np.uint8
+    mean = np.array([123.68, 116.78, 103.94], np.float32).reshape(3, 1, 1)
+    std = np.array([58.4, 57.12, 57.38], np.float32).reshape(3, 1, 1)
+    normalized = (u8.astype(np.float32) - mean) / std
+    # fast-DCT u8 decode vs exact f32 decode: a few LSB / std ≈ 0.1
+    assert np.abs(normalized - bf.data[0].asnumpy()).max() < 0.15
+    np.testing.assert_array_equal(bu.label[0].asnumpy(), bf.label[0].asnumpy())
+    with pytest.raises(ValueError):
+        ImageRecordIter(dtype="float16", **kw)

@@ -212,3 +212,36 @@ def test_sharded_trainer_bf16_conv_bn():
     for rm in rmeans:
         assert trainer.param_vals[rm].dtype == jnp.float32
         assert bool(jnp.any(trainer.param_vals[rm] != 0))
+
+
+def test_sharded_trainer_preprocess_uint8():
+    """preprocess= fuses input normalization into the step program: uint8
+    batches train a conv+BN net (deferred shapes resolve through preprocess)."""
+    import jax.numpy as jnp
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, 3, padding=1, in_channels=3))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation("relu"))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(4))
+    net.initialize()
+    mean = jnp.asarray(np.full((3, 1, 1), 128.0, np.float32))
+
+    def preprocess(x):
+        if x.dtype == jnp.uint8:
+            return (x.astype(jnp.float32) - mean) / 64.0
+        return x
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = par.make_mesh({"dp": 2}, devices=jax.devices()[:2])
+    trainer = par.ShardedTrainer(net, loss_fn, mesh, optimizer="sgd",
+                                 optimizer_params={"learning_rate": 0.1},
+                                 preprocess=preprocess)
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randint(0, 255, (8, 3, 8, 8)).astype(np.uint8))
+    y = nd.array(rng.randint(0, 4, 8).astype(np.float32))  # f32 labels: in-jit cast
+    losses = [float(trainer.step(x, y).asnumpy()) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
