@@ -1,10 +1,12 @@
 """Attention dispatch: plain XLA vs the Pallas flash kernel.
 
-Policy (measured on the round-2 chip, tests/test_flash_attention.py):
-- short sequences: XLA's fused softmax-attention is fastest and the S×S
-  scores fit — use ``plain``.
-- long sequences (≥ _FLASH_MIN_SEQ): the scores tensor is the memory wall;
-  the flash kernel keeps O(S·D) live and wins on time too — use ``flash``.
+Policy (measured round 3 on v5e via bench.py bench_lm_long, TransformerLM
+bf16 train step, flash vs plain end-to-end): flash wins 1.05-1.08x at seq
+1024/2048/4096 *and* keeps memory O(S·D) — so:
+- short sequences (< _FLASH_MIN_SEQ): XLA's fused softmax-attention; the
+  S×S scores fit easily and kernel launch granularity doesn't pay off.
+- sequences ≥ _FLASH_MIN_SEQ: the Pallas flash kernel (bf16 MXU dots with
+  f32 accumulation — precision pinned DEFAULT, see flash_attention.py).
 - explicit masks: plain (the kernel handles causal only).
 
 ``MXNET_ATTENTION_IMPL`` ∈ {auto, plain, flash} overrides.

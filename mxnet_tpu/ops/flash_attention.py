@@ -34,6 +34,19 @@ __all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30  # avoids -inf NaN propagation inside the kernel
 
+# The package default is jax_default_matmul_precision=highest (fp32-accurate
+# fp32 GEMMs for reference parity). For bf16 operands that would mean a
+# Mosaic "Bad lhs type" reject in-kernel (fp32 contract precision on bf16
+# vectors) — the whole point is single-pass bf16 MXU with f32 accumulation,
+# so bf16 dots pin DEFAULT. f32 operands keep HIGHEST: the package promises
+# true-fp32 matmuls to non-AMP callers, and DEFAULT would silently truncate
+# them to one-pass bf16 multiplies.
+
+
+def _dot_prec(dt):
+    return (lax.Precision.DEFAULT if jnp.dtype(dt).itemsize <= 2
+            else lax.Precision.HIGHEST)
+
 
 def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
                 scale, causal, block_q):
@@ -41,7 +54,10 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
     import jax.experimental.pallas as pl
 
     q_blk_idx = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, D)
+    # Keep q/k/v in their storage dtype for the MXU dots (bf16×bf16 with f32
+    # accumulation runs at full MXU rate; pre-casting to f32 would quarter
+    # it) — only the softmax statistics live in f32.
+    q = q_ref[0]                                      # (bq, D)
     bq, d = q.shape
     s_total = k_ref.shape[1]
     nk = s_total // block_k
@@ -57,9 +73,10 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
 
     def body(j, carry):
         acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # (bq,bk)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32,
+                    precision=_dot_prec(q.dtype)) * scale  # (bq,bk)
         if causal:
             rows = q_blk_idx * block_q + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -72,7 +89,8 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
         p = jnp.exp(s - new_m[:, None])
         p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
         acc = acc * corr[:, None] + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32)
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32,
+            precision=_dot_prec(v_blk.dtype))
         l = l * corr + jnp.sum(p, axis=-1)
         return acc, new_m, l
 
@@ -148,41 +166,41 @@ def _bwd_blocked(scale, causal, block_k, res, g):
     dS = P ∘ (dP − δ + dlse) with δ = rowsum(dO ∘ O); memory O(S·block_k).
     """
     q, k, v, offset, o, lse = res
-    do = g[0].astype(jnp.float32)
+    do = g[0]
     g_lse = g[1].astype(jnp.float32)  # ring attention differentiates lse too
     b, h, s, d = q.shape
-    qf = q.astype(jnp.float32)
-    of = o.astype(jnp.float32)
-    delta = jnp.sum(do * of, axis=-1)                      # (B,H,S)
+    dt = q.dtype  # matmul operands stay in storage dtype (full-rate MXU),
+    f32 = functools.partial(jnp.einsum, preferred_element_type=jnp.float32,
+                            precision=_dot_prec(q.dtype))
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     nk = s // block_k
 
     rows = lax.broadcasted_iota(jnp.int32, (s, block_k), 0)
 
     def blk(j):
-        k_blk = lax.dynamic_slice_in_dim(k, j * block_k, block_k, 2) \
-            .astype(jnp.float32)
-        v_blk = lax.dynamic_slice_in_dim(v, j * block_k, block_k, 2) \
-            .astype(jnp.float32)
-        sc = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk) * scale
+        k_blk = lax.dynamic_slice_in_dim(k, j * block_k, block_k, 2)
+        v_blk = lax.dynamic_slice_in_dim(v, j * block_k, block_k, 2)
+        sc = f32("bhqd,bhkd->bhqk", q, k_blk) * scale
         if causal:
             cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (s, block_k), 1)
             sc = jnp.where(rows + offset >= cols, sc, _NEG_INF)
         p = jnp.exp(sc - lse[..., None])                   # (B,H,S,bk)
         p = jnp.where(sc <= _NEG_INF / 2, 0.0, p)
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, do)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_blk)
-        ds = p * (dp - delta[..., None] + g_lse[..., None]) * scale
-        dq_contrib = jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dv_blk = f32("bhqk,bhqd->bhkd", p.astype(dt), do)
+        dp = f32("bhqd,bhkd->bhqk", do, v_blk)
+        ds = (p * (dp - delta[..., None] + g_lse[..., None])
+              * scale).astype(dt)
+        dq_contrib = f32("bhqk,bhkd->bhqd", ds, k_blk)
+        dk_blk = f32("bhqk,bhqd->bhkd", ds, q)
         return dq_contrib, dk_blk, dv_blk
 
     def step(dq, j):
         dq_c, dk_blk, dv_blk = blk(j)
         return dq + dq_c, (dk_blk, dv_blk)
 
-    dq, (dk_blocks, dv_blocks) = lax.scan(step, jnp.zeros_like(qf),
-                                          jnp.arange(nk))
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        step, jnp.zeros((b, h, s, d), jnp.float32), jnp.arange(nk))
     dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, s, d)
     dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, s, d)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
