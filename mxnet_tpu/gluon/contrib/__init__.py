@@ -1,3 +1,4 @@
 """gluon.contrib — experimental Gluon surface (reference
 python/mxnet/gluon/contrib/, expected path per SURVEY.md §2.3)."""
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401

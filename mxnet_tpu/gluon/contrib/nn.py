@@ -1,0 +1,39 @@
+"""gluon.contrib.nn — SyncBatchNorm and friends (reference
+python/mxnet/gluon/contrib/nn/basic_layers.py — TBV).
+"""
+from __future__ import annotations
+
+from ..nn.basic_layers import BatchNorm
+
+__all__ = ["SyncBatchNorm"]
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm: batch moments are reduced over the
+    data-parallel mesh axis (the reference reduces over ``num_devices`` GPUs
+    via its cross-GPU key-value AllReduce; here the reduction is a
+    ``lax.pmean`` inserted when the layer is traced inside the sharded train
+    step — see ops/contrib.py _contrib_SyncBatchNorm).
+
+    ``num_devices`` is accepted for API compat but unused: the mesh in scope
+    at trace time defines the reduction group.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", axis_name="dp", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+        self._axis_name = axis_name
+
+    def _bn_op(self, F):
+        return F.SyncBatchNorm, {"axis_name": self._axis_name}

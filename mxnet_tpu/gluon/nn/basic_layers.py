@@ -255,13 +255,20 @@ class BatchNorm(HybridBlock):
         for p in (self.gamma, self.beta, self.running_mean, self.running_var):
             p.shape_inferred((c,))
 
+    def _bn_op(self, F):
+        """Overridable hook: (op, extra kwargs). SyncBatchNorm swaps in the
+        cross-device op without duplicating the stats-folding logic below."""
+        return F.BatchNorm, {}
+
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         train = autograd.is_training()
+        op, extra = self._bn_op(F)
         if train and not self._use_global_stats:
-            out, mean, var = F.BatchNorm(
+            out, mean, var = op(
                 x, gamma, beta, running_mean, running_var, eps=self._eps,
                 momentum=self._momentum, fix_gamma=not self._scale,
-                use_global_stats=False, output_mean_var=True, axis=self._axis)
+                use_global_stats=False, output_mean_var=True, axis=self._axis,
+                **extra)
             m = self._momentum
             with autograd.pause():
                 self.running_mean.data()._set_data(
@@ -269,10 +276,10 @@ class BatchNorm(HybridBlock):
                 self.running_var.data()._set_data(
                     (m * running_var + (1 - m) * var.detach())._data)
             return out
-        return F.BatchNorm(x, gamma, beta, running_mean, running_var, eps=self._eps,
-                           momentum=self._momentum, fix_gamma=not self._scale,
-                           use_global_stats=True, output_mean_var=False,
-                           axis=self._axis)
+        return op(x, gamma, beta, running_mean, running_var, eps=self._eps,
+                  momentum=self._momentum, fix_gamma=not self._scale,
+                  use_global_stats=True, output_mean_var=False,
+                  axis=self._axis, **extra)
 
 
 class LayerNorm(HybridBlock):

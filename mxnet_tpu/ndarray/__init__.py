@@ -38,7 +38,7 @@ def _make_dispatcher(name: str):
 
 
 def __getattr__(name: str):
-    if name in ("contrib", "sparse"):
+    if name in ("contrib", "sparse", "image"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -1,0 +1,18 @@
+"""``mx.nd.image`` — image op namespace (reference python/mxnet/ndarray/
+image.py, generated from the ``_image_*`` registry names — TBV).
+
+Resolves ``nd.image.to_tensor`` → registered op ``_image_to_tensor``.
+"""
+from __future__ import annotations
+
+from ..ops import has_op
+from . import _make_dispatcher
+
+
+def __getattr__(name: str):
+    cand = f"_image_{name}"
+    if has_op(cand):
+        fn = _make_dispatcher(cand)
+        globals()[name] = fn
+        return fn
+    raise AttributeError(f"no image operator {name!r}")

@@ -19,3 +19,6 @@ from . import rnn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib  # noqa: F401
 from . import control_flow  # noqa: F401
+from . import image_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import loss_ops  # noqa: F401

@@ -1,0 +1,204 @@
+"""Image operators (``mx.nd.image.*`` / ``mx.sym.image.*``).
+
+Reference: ``src/operator/image/image_random.cc``, ``resize.cc``, ``crop.cc``
+(TBV — SURVEY.md §2.2 Image row): GPU-side augmentations used by Gluon vision
+transforms. Layout is HWC (or NHWC batched), matching the reference; the
+random ops draw from the framework RNG stream (random.next_key) so they are
+trace-safe under hybridize and reproducible via MXNET_SEED.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _hwc_axes(data):
+    """(h_axis, w_axis, c_axis) for HWC or NHWC input."""
+    if data.ndim == 4:
+        return 1, 2, 3
+    return 0, 1, 2
+
+
+def _key():
+    from ..random import next_key
+
+    return next_key()
+
+
+@register("_image_to_tensor", aliases=["image_to_tensor"])
+def _to_tensor(data):
+    """uint8 HWC [0,255] → float32 CHW [0,1] (batched: NHWC→NCHW)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 4:
+        return jnp.transpose(x, (0, 3, 1, 2))
+    return jnp.transpose(x, (2, 0, 1))
+
+
+@register("_image_normalize", aliases=["image_normalize"])
+def _normalize(data, mean=0.0, std=1.0):
+    """CHW (or NCHW) float input; mean/std per-channel sequences."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    shape = (-1, 1, 1)
+    if data.ndim == 4:
+        shape = (1, -1, 1, 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register("_image_flip_left_right", aliases=["image_flip_left_right"])
+def _flip_lr(data):
+    return jnp.flip(data, axis=_hwc_axes(data)[1])
+
+
+@register("_image_flip_top_bottom", aliases=["image_flip_top_bottom"])
+def _flip_tb(data):
+    return jnp.flip(data, axis=_hwc_axes(data)[0])
+
+
+@register("_image_random_flip_left_right",
+          aliases=["image_random_flip_left_right"])
+def _random_flip_lr(data, p=0.5):
+    coin = jax.random.bernoulli(_key(), p)
+    return jnp.where(coin, _flip_lr(data), data)
+
+
+@register("_image_random_flip_top_bottom",
+          aliases=["image_random_flip_top_bottom"])
+def _random_flip_tb(data, p=0.5):
+    coin = jax.random.bernoulli(_key(), p)
+    return jnp.where(coin, _flip_tb(data), data)
+
+
+@register("_image_resize", aliases=["image_resize"])
+def _resize(data, size=0, keep_ratio=False, interp=1):
+    ha, wa, _ = _hwc_axes(data)
+    h, w = data.shape[ha], data.shape[wa]
+    if isinstance(size, int):
+        if keep_ratio:
+            if h < w:
+                nh, nw = size, max(1, int(w * size / h))
+            else:
+                nh, nw = max(1, int(h * size / w)), size
+        else:
+            nh = nw = size
+    else:
+        nw, nh = size  # reference order: (w, h)
+    shape = list(data.shape)
+    shape[ha], shape[wa] = nh, nw
+    method = "nearest" if interp == 0 else "linear"
+    return jax.image.resize(data, tuple(shape), method=method) \
+        .astype(data.dtype)
+
+
+@register("_image_crop", aliases=["image_crop"])
+def _crop(data, x=0, y=0, width=1, height=1):
+    ha, wa, _ = _hwc_axes(data)
+    idx = [slice(None)] * data.ndim
+    idx[ha] = slice(int(y), int(y) + int(height))
+    idx[wa] = slice(int(x), int(x) + int(width))
+    return data[tuple(idx)]
+
+
+def _blend(a, b, factor):
+    return (a.astype(jnp.float32) * factor
+            + b * (1.0 - factor)).astype(a.dtype)
+
+
+@register("_image_random_brightness", aliases=["image_random_brightness"])
+def _random_brightness(data, min_factor=0.0, max_factor=0.0):
+    f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
+                           float(max_factor))
+    return (data.astype(jnp.float32) * f).astype(data.dtype)
+
+
+def _grayscale(data):
+    ca = _hwc_axes(data)[2]
+    wts = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    shape = [1] * data.ndim
+    shape[ca] = 3
+    g = jnp.sum(data.astype(jnp.float32) * wts.reshape(shape), axis=ca,
+                keepdims=True)
+    return g
+
+
+@register("_image_random_contrast", aliases=["image_random_contrast"])
+def _random_contrast(data, min_factor=0.0, max_factor=0.0):
+    f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
+                           float(max_factor))
+    mean = jnp.mean(_grayscale(data))
+    return _blend(data, mean, f)
+
+
+@register("_image_random_saturation", aliases=["image_random_saturation"])
+def _random_saturation(data, min_factor=0.0, max_factor=0.0):
+    f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
+                           float(max_factor))
+    return _blend(data, _grayscale(data), f)
+
+
+@register("_image_random_hue", aliases=["image_random_hue"])
+def _random_hue(data, min_factor=0.0, max_factor=0.0):
+    """YIQ-rotation hue shift (the reference's image_random.cc recipe)."""
+    f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
+                           float(max_factor))
+    theta = f * jnp.pi
+    ca = _hwc_axes(data)[2]
+    t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], jnp.float32)
+    t_rgb = jnp.linalg.inv(t_yiq)
+    c, s = jnp.cos(theta), jnp.sin(theta)
+    rot = jnp.stack([jnp.stack([jnp.float32(1), jnp.float32(0), jnp.float32(0)]),
+                     jnp.stack([jnp.float32(0), c, -s]),
+                     jnp.stack([jnp.float32(0), s, c])])
+    m = t_rgb @ rot @ t_yiq
+    x = jnp.moveaxis(data.astype(jnp.float32), ca, -1)
+    out = x @ m.T
+    return jnp.moveaxis(out, -1, ca).astype(data.dtype)
+
+
+@register("_image_random_color_jitter", aliases=["image_random_color_jitter"])
+def _random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
+                         hue=0.0):
+    if brightness:
+        data = _random_brightness(data, 1.0 - brightness, 1.0 + brightness)
+    if contrast:
+        data = _random_contrast(data, 1.0 - contrast, 1.0 + contrast)
+    if saturation:
+        data = _random_saturation(data, 1.0 - saturation, 1.0 + saturation)
+    if hue:
+        data = _random_hue(data, -hue, hue)
+    return data
+
+
+# numpy on purpose: module import must not touch the XLA backend (the
+# dist workers call jax.distributed.initialize after importing mxnet_tpu)
+import numpy as _np
+
+_EIGVAL = _np.asarray([55.46, 4.794, 1.148], _np.float32)
+_EIGVEC = _np.asarray([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+
+@register("_image_adjust_lighting", aliases=["image_adjust_lighting"])
+def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """AlexNet-style PCA lighting with fixed alpha (reference convention:
+    RGB channel shift = eigvec @ (eigval * alpha))."""
+    alpha = jnp.asarray(alpha, jnp.float32)
+    shift = jnp.asarray(_EIGVEC) @ (jnp.asarray(_EIGVAL) * alpha)
+    ca = _hwc_axes(data)[2]
+    shape = [1] * data.ndim
+    shape[ca] = 3
+    return (data.astype(jnp.float32)
+            + shift.reshape(shape)).astype(data.dtype)
+
+
+@register("_image_random_lighting", aliases=["image_random_lighting"])
+def _random_lighting(data, alpha_std=0.05):
+    alpha = jax.random.normal(_key(), (3,), jnp.float32) * alpha_std
+    return _adjust_lighting(data, alpha)

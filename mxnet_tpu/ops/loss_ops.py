@@ -1,0 +1,116 @@
+"""CTC loss (reference ``src/operator/nn/ctc_loss.*`` wrapping warp-ctc /
+cuDNN CTC — TBV, SURVEY.md §2.2).
+
+TPU redesign: the forward algorithm over the blank-interleaved label lattice
+runs as one ``lax.scan`` over time in log space — static shapes, fully
+differentiable by jax.grad (no hand-written backward), batched by vmap.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+_NEG = -1e30
+
+
+def _logsumexp2(a, b):
+    m = jnp.maximum(a, b)
+    m_safe = jnp.where(m <= _NEG / 2, 0.0, m)
+    out = m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b - m_safe))
+    return jnp.where(m <= _NEG / 2, _NEG, out)
+
+
+def _logsumexp3(a, b, c):
+    return _logsumexp2(_logsumexp2(a, b), c)
+
+
+def _ctc_single(logprobs, labels, t_len, l_len, blank):
+    """logprobs (T, C) log-softmax; labels (L,) int32; returns -log p(l|x)."""
+    T, C = logprobs.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((S,), blank, jnp.int32)
+    ext = ext.at[1::2].set(labels)
+    pos = jnp.arange(S)
+    valid_s = pos < 2 * l_len + 1
+    # skip-transition allowed when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((S,), _NEG)
+    alpha0 = alpha0.at[0].set(logprobs[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(l_len > 0, logprobs[0, ext[1]], _NEG))
+
+    def step(alpha, t):
+        lp = logprobs[t]
+        a_prev = jnp.concatenate([jnp.array([_NEG]), alpha[:-1]])
+        a_prev2 = jnp.concatenate([jnp.full((2,), _NEG), alpha[:-2]])
+        a = _logsumexp3(alpha, a_prev,
+                        jnp.where(can_skip, a_prev2, _NEG))
+        a = a + lp[ext]
+        a = jnp.where(valid_s, a, _NEG)
+        # frozen past t_len: keep alpha unchanged for padded frames
+        a = jnp.where(t < t_len, a, alpha)
+        return a, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = alpha[jnp.maximum(2 * l_len - 1, 0)]
+    end2 = alpha[2 * l_len]
+    ll = _logsumexp2(jnp.where(l_len > 0, end1, _NEG), end2)
+    return -ll
+
+
+def _ctc_n_out(kwargs):
+    return 2
+
+
+@register("ctc_loss", aliases=["CTCLoss", "_contrib_ctc_loss", "_contrib_CTCLoss"],
+          num_outputs=_ctc_n_out)
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", _pad_value=0):
+    """data (T, B, C) unnormalized activations; label (B, L).
+
+    Returns (loss (B,), log_softmax(data)) — the reference emits the
+    (gradient-carrying) normalized activations as the second output.
+    Labels: with blank_label="first", blank is class 0 and labels are
+    1-based offsets; "last" puts blank at C-1 with 0-based labels.
+    When use_label_lengths is False, padding value (0 for "first",
+    -1 for "last") terminates each label row.
+    """
+    T, B, C = data.shape
+    logprobs = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    lab = label.astype(jnp.int32)
+
+    if blank_label == "first":
+        blank = 0
+        pad = jnp.int32(_pad_value)
+        eff = jnp.where(lab == pad, -1, lab)  # padding → sentinel
+    else:
+        blank = C - 1
+        pad = jnp.int32(-1)
+        eff = lab
+
+    if use_label_lengths and label_lengths is not None:
+        l_lens = label_lengths.astype(jnp.int32)
+    else:
+        l_lens = jnp.sum((eff >= 0).astype(jnp.int32), axis=-1)
+    if use_data_lengths and data_lengths is not None:
+        t_lens = data_lengths.astype(jnp.int32)
+    else:
+        t_lens = jnp.full((B,), T, jnp.int32)
+
+    if blank_label == "first":
+        eff = jnp.maximum(eff, 0)  # safe index; masked out by l_lens anyway
+    else:
+        eff = jnp.maximum(eff, 0)
+
+    losses = jax.vmap(_ctc_single, in_axes=(1, 0, 0, 0, None))(
+        logprobs, eff, t_lens, l_lens, blank)
+    return losses.astype(data.dtype), logprobs.astype(data.dtype)

@@ -6,7 +6,9 @@ SURVEY.md §2.2). Includes the reference's special ``Reshape`` codes
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register, alias
@@ -318,3 +320,108 @@ def _histogram(data, bins=None, bin_cnt=None, range=None):
     else:
         cnt, edges = jnp.histogram(data.reshape(-1), bins=bins)
     return cnt, edges
+
+
+@register("_linalg_det", aliases=["linalg_det"])
+def _linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=["linalg_slogdet"], num_outputs=2)
+def _linalg_slogdet(A):
+    sign, logabsdet = jnp.linalg.slogdet(A)
+    return sign, logabsdet
+
+
+@register("_linalg_inverse", aliases=["linalg_inverse"])
+def _linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_trmm", aliases=["linalg_trmm"])
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = (B @ tri) if rightside else (tri @ B)
+    return alpha * out
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+def _linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=int(offset), axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"])
+def _linalg_makediag(A, offset=0):
+    def one(v):
+        return jnp.diag(v, k=int(offset))
+    for _ in range(A.ndim - 1):
+        one = jax.vmap(one)
+    return one(A)
+
+
+def _trian_indices(n, offset, lower):
+    """Reference semantics (linalg.extracttrian docs): offset>0 packs the
+    triangle ABOVE the main diagonal starting at that superdiagonal,
+    offset<0 the one below; ``lower`` only disambiguates offset=0."""
+    k = int(offset)
+    if k > 0:
+        return jnp.triu_indices(n, k=k)
+    if k < 0:
+        return jnp.tril_indices(n, k=k)
+    return jnp.tril_indices(n) if lower else jnp.triu_indices(n)
+
+
+@register("_linalg_extracttrian", aliases=["linalg_extracttrian"])
+def _linalg_extracttrian(A, offset=0, lower=True):
+    rows, cols = _trian_indices(A.shape[-1], offset, lower)
+    return A[..., rows, cols]
+
+
+@register("_linalg_maketrian", aliases=["linalg_maketrian"])
+def _linalg_maketrian(A, offset=0, lower=True):
+    m = A.shape[-1]
+    # recover n: packed length is a strictly increasing function of n
+    n = 1
+    while len(_trian_indices(n, offset, lower)[0]) < m:
+        n += 1
+    rows, cols = _trian_indices(n, offset, lower)
+    if len(rows) != m:
+        raise ValueError(f"packed length {m} matches no n for offset={offset}")
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register("cumsum", aliases=["_np_cumsum"])
+def _cumsum(a, axis=None, dtype=None):
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(a, axis=int(axis))
+    return out.astype(dtype) if dtype else out
+
+
+@register("cumprod", aliases=["_np_cumprod"])
+def _cumprod(a, axis=None, dtype=None):
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    out = jnp.cumprod(a, axis=int(axis))
+    return out.astype(dtype) if dtype else out
+
+
+@register("batch_take", differentiable=False)
+def _batch_take(a, indices):
+    """a (N, ...) with indices (N,): per-row take (reference batch_take)."""
+    return jnp.take_along_axis(
+        a.reshape(a.shape[0], -1), indices.reshape(-1, 1).astype(jnp.int32),
+        axis=1).reshape(indices.shape)
+
+
+@register("cast_storage")
+def _cast_storage(data, stype="default"):
+    """Storage casts are identity on TPU — sparse NDArrays are emulated over
+    dense jax.Arrays (ndarray/sparse.py); the wrapper layer rebuilds the
+    requested stype view around this result."""
+    return data

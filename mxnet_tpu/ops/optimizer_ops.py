@@ -146,16 +146,21 @@ def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_gra
     return w, mom
 
 
+def _lamb_states(grad, mean, var, beta1=0.9, beta2=0.999, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """Shared lamb state advance (single and multi-tensor ops must agree)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return beta1 * mean + (1 - beta1) * g, beta2 * var + (1 - beta2) * jnp.square(g)
+
+
 @register("lamb_update_phase1")
 def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
                         t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
                         clip_gradient=-1.0):
-    g = grad * rescale_grad
-    if clip_gradient is not None and clip_gradient > 0:
-        g = jnp.clip(g, -clip_gradient, clip_gradient)
-    mean_new = beta1 * mean + (1 - beta1) * g
-    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
-    m, v = mean_new, var_new
+    m, v = _lamb_states(grad, mean, var, beta1, beta2, rescale_grad,
+                        clip_gradient)
     if bias_correction:
         m = m / (1 - beta1 ** t)
         v = v / (1 - beta2 ** t)
@@ -203,3 +208,182 @@ def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999, epsil
     sigma = d_new - beta1 * d
     z = beta1 * z + (1 - beta1) * g - sigma * weight
     return -z / d_new, d_new, v, z
+
+
+# ---------------------------------------------------------------------------
+# Multi-tensor updates (reference src/operator/optimizer_op.* multi_sgd_*,
+# contrib multi_lamb/multi_adamw — TBV, SURVEY.md §2.2 optimizer row).
+# The reference fuses N small parameter updates into one kernel launch; here
+# each group update is the single-tensor op applied per group — inside a jit
+# XLA fuses across groups into one program, which is the TPU-native analog of
+# the multi-tensor apply. Inputs arrive flattened per the reference calling
+# convention ([w0,g0, w1,g1, ...]); lrs/wds are per-group lists.
+# ---------------------------------------------------------------------------
+
+def _per_group(kwargs, name, i, default):
+    v = kwargs.get(name, None)
+    if v is None:
+        return default
+    if isinstance(v, (list, tuple)):
+        return v[i]
+    return v
+
+
+def _multi(step, n_in, n_out_per, arrays, kwargs):
+    num = int(kwargs.get("num_weights", len(arrays) // n_in))
+    outs = []
+    for i in range(num):
+        group = arrays[i * n_in:(i + 1) * n_in]
+        outs.append(step(i, *group))
+    # flatten [(w,m), ...] -> (w0, w1, ..., m0, m1, ...): reference multi ops
+    # emit all updated weights first (their aux states follow)
+    flat = []
+    for j in range(n_out_per):
+        for o in outs:
+            flat.append(o[j] if isinstance(o, tuple) else o)
+    return tuple(flat) if len(flat) > 1 else flat[0]
+
+
+def _multi_n_out(n_in, n_out_per):
+    def n(kwargs):
+        return int(kwargs["num_weights"]) * n_out_per if "num_weights" in kwargs else n_out_per
+    return n
+
+
+@register("multi_sgd_update", num_outputs=_multi_n_out(2, 1))
+def _multi_sgd_update(*arrays, **kwargs):
+    def step(i, w, g):
+        return _sgd_update(w, g, lr=_per_group(kwargs, "lrs", i, 0.01),
+                           wd=_per_group(kwargs, "wds", i, 0.0),
+                           rescale_grad=kwargs.get("rescale_grad", 1.0),
+                           clip_gradient=kwargs.get("clip_gradient", -1.0))
+    return _multi(step, 2, 1, arrays, kwargs)
+
+
+@register("multi_sgd_mom_update", num_outputs=_multi_n_out(3, 2))
+def _multi_sgd_mom_update(*arrays, **kwargs):
+    def step(i, w, g, m):
+        return _sgd_mom_update(w, g, m, lr=_per_group(kwargs, "lrs", i, 0.01),
+                               momentum=kwargs.get("momentum", 0.0),
+                               wd=_per_group(kwargs, "wds", i, 0.0),
+                               rescale_grad=kwargs.get("rescale_grad", 1.0),
+                               clip_gradient=kwargs.get("clip_gradient", -1.0))
+    return _multi(step, 3, 2, arrays, kwargs)
+
+
+@register("multi_mp_sgd_update", num_outputs=_multi_n_out(3, 2))
+def _multi_mp_sgd_update(*arrays, **kwargs):
+    def step(i, w, g, w32):
+        return _mp_sgd_update(w, g, w32, lr=_per_group(kwargs, "lrs", i, 0.01),
+                              wd=_per_group(kwargs, "wds", i, 0.0),
+                              rescale_grad=kwargs.get("rescale_grad", 1.0),
+                              clip_gradient=kwargs.get("clip_gradient", -1.0))
+    return _multi(step, 3, 2, arrays, kwargs)
+
+
+@register("multi_mp_sgd_mom_update", num_outputs=_multi_n_out(4, 3))
+def _multi_mp_sgd_mom_update(*arrays, **kwargs):
+    def step(i, w, g, m, w32):
+        return _mp_sgd_mom_update(w, g, m, w32,
+                                  lr=_per_group(kwargs, "lrs", i, 0.01),
+                                  momentum=kwargs.get("momentum", 0.0),
+                                  wd=_per_group(kwargs, "wds", i, 0.0),
+                                  rescale_grad=kwargs.get("rescale_grad", 1.0),
+                                  clip_gradient=kwargs.get("clip_gradient", -1.0))
+    return _multi(step, 4, 3, arrays, kwargs)
+
+
+def _preloaded(base_fn, n_in, n_out_per):
+    """preloaded_multi_*: lrs/wds arrive as device arrays (last two inputs)
+    instead of python lists — the reference variant that keeps hyperparams
+    on-device across steps."""
+    def fn(*arrays, **kwargs):
+        lrs, wds = arrays[-2], arrays[-1]
+        body = arrays[:-2]
+        num = int(kwargs.get("num_weights", len(body) // n_in))
+        kw = dict(kwargs)
+        kw["num_weights"] = num
+        kw["lrs"] = [lrs.reshape(-1)[i] for i in range(num)]
+        kw["wds"] = [wds.reshape(-1)[i] for i in range(num)]
+        return base_fn(*body, **kw)
+    return fn
+
+
+register("preloaded_multi_sgd_update",
+         num_outputs=_multi_n_out(2, 1))(
+    _preloaded(_multi_sgd_update, 2, 1))
+register("preloaded_multi_sgd_mom_update",
+         num_outputs=_multi_n_out(3, 2))(
+    _preloaded(_multi_sgd_mom_update, 3, 2))
+register("preloaded_multi_mp_sgd_update",
+         num_outputs=_multi_n_out(3, 2))(
+    _preloaded(_multi_mp_sgd_update, 3, 2))
+register("preloaded_multi_mp_sgd_mom_update",
+         num_outputs=_multi_n_out(4, 3))(
+    _preloaded(_multi_mp_sgd_mom_update, 4, 3))
+
+
+@register("multi_lamb_update_phase1", aliases=["_multi_lamb_update_phase1"],
+          num_outputs=_multi_n_out(4, 3))
+def _multi_lamb_phase1(*arrays, **kwargs):
+    def step(i, w, g, mean, var):
+        mean_new, var_new = _lamb_states(
+            g, mean, var, beta1=kwargs.get("beta1", 0.9),
+            beta2=kwargs.get("beta2", 0.999),
+            rescale_grad=kwargs.get("rescale_grad", 1.0),
+            clip_gradient=kwargs.get("clip_gradient", -1.0))
+        upd = _lamb_update_phase1(
+            w, g, mean, var, beta1=kwargs.get("beta1", 0.9),
+            beta2=kwargs.get("beta2", 0.999),
+            epsilon=kwargs.get("epsilon", 1e-6),
+            t=_per_group(kwargs, "step_count",
+                         i, _per_group(kwargs, "t", i, 1)),
+            bias_correction=kwargs.get("bias_correction", True),
+            wd=_per_group(kwargs, "wds", i, 0.0),
+            rescale_grad=kwargs.get("rescale_grad", 1.0),
+            clip_gradient=kwargs.get("clip_gradient", -1.0))
+        return upd, mean_new, var_new
+    return _multi(step, 4, 3, arrays, kwargs)
+
+
+@register("multi_lamb_update_phase2", aliases=["_multi_lamb_update_phase2"],
+          num_outputs=_multi_n_out(4, 1))
+def _multi_lamb_phase2(*arrays, **kwargs):
+    def step(i, w, g, r1, r2):
+        return _lamb_update_phase2(
+            w, g, r1, r2, lr=_per_group(kwargs, "lrs", i, 0.01),
+            lower_bound=kwargs.get("lower_bound", -1.0),
+            upper_bound=kwargs.get("upper_bound", -1.0))
+    return _multi(step, 4, 1, arrays, kwargs)
+
+
+@register("multi_adamw_update", aliases=["_multi_adamw_update"],
+          num_outputs=_multi_n_out(4, 3))
+def _multi_adamw_update(*arrays, **kwargs):
+    def step(i, w, g, mean, var):
+        return _adamw_update(
+            w, g, mean, var, lr=_per_group(kwargs, "lrs", i, 0.01),
+            beta1=kwargs.get("beta1", 0.9), beta2=kwargs.get("beta2", 0.999),
+            epsilon=kwargs.get("epsilon", 1e-8),
+            wd=_per_group(kwargs, "wds", i, 0.0),
+            eta=_per_group(kwargs, "etas", i, kwargs.get("eta", 1.0)),
+            rescale_grad=kwargs.get("rescale_grad", 1.0),
+            clip_gradient=kwargs.get("clip_gradient", -1.0))
+    return _multi(step, 4, 3, arrays, kwargs)
+
+
+@register("multi_mp_adamw_update", aliases=["_multi_mp_adamw_update"],
+          num_outputs=_multi_n_out(5, 4))
+def _multi_mp_adamw_update(*arrays, **kwargs):
+    def step(i, w, g, mean, var, w32):
+        nw32, m, v = _adamw_update(
+            w32, g.astype(jnp.float32), mean, var,
+            lr=_per_group(kwargs, "lrs", i, 0.01),
+            beta1=kwargs.get("beta1", 0.9), beta2=kwargs.get("beta2", 0.999),
+            epsilon=kwargs.get("epsilon", 1e-8),
+            wd=_per_group(kwargs, "wds", i, 0.0),
+            eta=_per_group(kwargs, "etas", i, kwargs.get("eta", 1.0)),
+            rescale_grad=kwargs.get("rescale_grad", 1.0),
+            clip_gradient=kwargs.get("clip_gradient", -1.0))
+        return nw32.astype(w.dtype), m, v, nw32
+    return _multi(step, 5, 4, arrays, kwargs)

@@ -1,0 +1,281 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/random/sample_op.*`` (``_random_*``: scalar-param
+draws), ``multisample_op.*`` (``_sample_*``: tensor-param draws, one
+distribution per input element), ``pdf_op.*`` (``_random_pdf_*``: density
+evaluation, differentiable) and ``shuffle_op.cc`` (TBV — SURVEY.md §2.2
+Random row). Draws come from the framework RNG stream (random.next_key) —
+per-context curand states become splittable threefry keys, trace-safe under
+jit and seeded by MXNET_SEED.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _key():
+    from ..random import next_key
+
+    return next_key()
+
+
+def _dt(dtype):
+    from ..base import dtype_np
+
+    if dtype in (None, "None"):
+        return jnp.float32
+    return dtype_np(dtype)
+
+
+def _shp(shape):
+    if shape is None or shape == "None":
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+# ---------------------------------------------------------------------------
+# _random_*: scalar-parameter draws
+# ---------------------------------------------------------------------------
+
+@register("_random_uniform", aliases=["random_uniform"], differentiable=False)
+def _random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None):
+    return jax.random.uniform(_key(), _shp(shape), _dt(dtype), low, high)
+
+
+@register("_random_normal", aliases=["random_normal"], differentiable=False)
+def _random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None):
+    return loc + scale * jax.random.normal(_key(), _shp(shape), _dt(dtype))
+
+
+@register("_random_gamma", aliases=["random_gamma"], differentiable=False)
+def _random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
+    return beta * jax.random.gamma(_key(), alpha, _shp(shape), _dt(dtype))
+
+
+@register("_random_exponential", aliases=["random_exponential"],
+          differentiable=False)
+def _random_exponential(lam=1.0, shape=None, dtype="float32", ctx=None):
+    return jax.random.exponential(_key(), _shp(shape), _dt(dtype)) / lam
+
+
+@register("_random_poisson", aliases=["random_poisson"], differentiable=False)
+def _random_poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
+    return jax.random.poisson(_key(), lam, _shp(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=["random_randint"], differentiable=False)
+def _random_randint(low=0, high=1, shape=None, dtype="int32", ctx=None):
+    return jax.random.randint(_key(), _shp(shape), int(low), int(high),
+                              _dt(dtype))
+
+
+@register("_random_negative_binomial", aliases=["random_negative_binomial"],
+          differentiable=False)
+def _random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32",
+                              ctx=None):
+    # NB(k, p) = Poisson(lam) with lam ~ Gamma(k, (1-p)/p)
+    lam = jax.random.gamma(_key(), float(k), _shp(shape)) * ((1 - p) / p)
+    return jax.random.poisson(_key(), lam, _shp(shape)).astype(_dt(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=["random_generalized_negative_binomial"],
+          differentiable=False)
+def _random_gen_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None):
+    if alpha == 0.0:
+        return jax.random.poisson(_key(), mu, _shp(shape)).astype(_dt(dtype))
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    lam = jax.random.gamma(_key(), k, _shp(shape)) * ((1 - p) / p)
+    return jax.random.poisson(_key(), lam, _shp(shape)).astype(_dt(dtype))
+
+
+# ---------------------------------------------------------------------------
+# _sample_*: tensor-parameter draws. Output shape = param.shape + shape —
+# each input element parameterizes an independent distribution.
+# ---------------------------------------------------------------------------
+
+def _tensor_draw(draw, params, shape, dtype):
+    shape = _shp(shape)
+    out_shape = params[0].shape + shape
+    broadcast = [jnp.broadcast_to(
+        p.reshape(p.shape + (1,) * len(shape)), out_shape) for p in params]
+    return draw(out_shape, *broadcast).astype(_dt(dtype))
+
+
+@register("_sample_uniform", aliases=["sample_uniform"], differentiable=False)
+def _sample_uniform(low, high, shape=None, dtype="float32"):
+    return _tensor_draw(
+        lambda s, lo, hi: lo + (hi - lo) * jax.random.uniform(_key(), s),
+        [low, high], shape, dtype)
+
+
+@register("_sample_normal", aliases=["sample_normal"], differentiable=False)
+def _sample_normal(mu, sigma, shape=None, dtype="float32"):
+    return _tensor_draw(
+        lambda s, m, sd: m + sd * jax.random.normal(_key(), s),
+        [mu, sigma], shape, dtype)
+
+
+@register("_sample_gamma", aliases=["sample_gamma"], differentiable=False)
+def _sample_gamma(alpha, beta, shape=None, dtype="float32"):
+    return _tensor_draw(
+        lambda s, a, b: b * jax.random.gamma(_key(), a, s),
+        [alpha, beta], shape, dtype)
+
+
+@register("_sample_exponential", aliases=["sample_exponential"],
+          differentiable=False)
+def _sample_exponential(lam, shape=None, dtype="float32"):
+    return _tensor_draw(
+        lambda s, l: jax.random.exponential(_key(), s) / l,
+        [lam], shape, dtype)
+
+
+@register("_sample_poisson", aliases=["sample_poisson"], differentiable=False)
+def _sample_poisson(lam, shape=None, dtype="float32"):
+    return _tensor_draw(
+        lambda s, l: jax.random.poisson(_key(), l, s).astype(jnp.float32),
+        [lam], shape, dtype)
+
+
+@register("_sample_negative_binomial", aliases=["sample_negative_binomial"],
+          differentiable=False)
+def _sample_negative_binomial(k, p, shape=None, dtype="float32"):
+    def draw(s, kk, pp):
+        lam = jax.random.gamma(_key(), kk, s) * ((1 - pp) / pp)
+        return jax.random.poisson(_key(), lam, s).astype(jnp.float32)
+    return _tensor_draw(draw, [k, p], shape, dtype)
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=["sample_generalized_negative_binomial"],
+          differentiable=False)
+def _sample_gen_negative_binomial(mu, alpha, shape=None, dtype="float32"):
+    def draw(s, m, a):
+        k = 1.0 / jnp.maximum(a, 1e-12)
+        p = k / (k + m)
+        lam = jax.random.gamma(_key(), k, s) * ((1 - p) / p)
+        pois = jax.random.poisson(_key(), jnp.broadcast_to(m, s), s)
+        nb = jax.random.poisson(_key(), lam, s)
+        return jnp.where(a <= 0, pois, nb).astype(jnp.float32)
+    return _tensor_draw(draw, [mu, alpha], shape, dtype)
+
+
+@register("_sample_multinomial", aliases=["sample_multinomial"],
+          differentiable=False)
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """data (..., K) probabilities → draws of shape data.shape[:-1] + shape."""
+    shape = _shp(shape)
+    batch = data.shape[:-1]
+    k = data.shape[-1]
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    n = 1
+    for s in shape:
+        n *= s
+    flat = logits.reshape(-1, k)
+    draws = jax.vmap(lambda lg, key: jax.random.categorical(key, lg, shape=(max(n, 1),)))(
+        flat, jax.random.split(_key(), flat.shape[0]))
+    out = draws.reshape(batch + (shape if shape else ()))
+    out = out.astype(_dt(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jnp.log_softmax(logits.reshape(-1, k), axis=-1)
+            if hasattr(jnp, "log_softmax") else jax.nn.log_softmax(
+                logits.reshape(-1, k), axis=-1),
+            draws.astype(jnp.int32), axis=-1)
+        return out, logp.reshape(out.shape)
+    return out
+
+
+@register("_shuffle", aliases=["shuffle"], differentiable=False)
+def _shuffle_op(data):
+    """Shuffle along the first axis (reference shuffle_op.cc)."""
+    return jax.random.permutation(_key(), data, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# _random_pdf_*: density evaluation (differentiable w.r.t. sample + params)
+# ---------------------------------------------------------------------------
+
+@register("_random_pdf_uniform", aliases=["random_pdf_uniform"])
+def _pdf_uniform(sample, low, high, is_log=False):
+    low = low[..., None]
+    high = high[..., None]
+    inside = (sample >= low) & (sample <= high)
+    pdf = jnp.where(inside, 1.0 / (high - low), 0.0)
+    return jnp.log(jnp.maximum(pdf, 1e-30)) if is_log else pdf
+
+
+@register("_random_pdf_normal", aliases=["random_pdf_normal"])
+def _pdf_normal(sample, mu, sigma, is_log=False):
+    mu = mu[..., None]
+    sigma = sigma[..., None]
+    logp = (-0.5 * jnp.square((sample - mu) / sigma)
+            - jnp.log(sigma * jnp.sqrt(2 * jnp.pi)))
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_gamma", aliases=["random_pdf_gamma"])
+def _pdf_gamma(sample, alpha, beta, is_log=False):
+    a = alpha[..., None]
+    b = 1.0 / beta[..., None]  # reference: beta is a scale parameter
+    logp = (a * jnp.log(b) + (a - 1) * jnp.log(sample) - b * sample
+            - jax.scipy.special.gammaln(a))
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_exponential", aliases=["random_pdf_exponential"])
+def _pdf_exponential(sample, lam, is_log=False):
+    lam = lam[..., None]
+    logp = jnp.log(lam) - lam * sample
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_poisson", aliases=["random_pdf_poisson"])
+def _pdf_poisson(sample, lam, is_log=False):
+    lam = lam[..., None]
+    logp = (sample * jnp.log(jnp.maximum(lam, 1e-30)) - lam
+            - jax.scipy.special.gammaln(sample + 1))
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_negative_binomial",
+          aliases=["random_pdf_negative_binomial"])
+def _pdf_negative_binomial(sample, k, p, is_log=False):
+    k = k[..., None]
+    p = p[..., None]
+    binln = (jax.scipy.special.gammaln(sample + k)
+             - jax.scipy.special.gammaln(sample + 1)
+             - jax.scipy.special.gammaln(k))
+    logp = binln + k * jnp.log(p) + sample * jnp.log1p(-p)
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_generalized_negative_binomial",
+          aliases=["random_pdf_generalized_negative_binomial"])
+def _pdf_gen_negative_binomial(sample, mu, alpha, is_log=False):
+    mu = mu[..., None]
+    alpha = alpha[..., None]
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    binln = (jax.scipy.special.gammaln(sample + k)
+             - jax.scipy.special.gammaln(sample + 1)
+             - jax.scipy.special.gammaln(k))
+    logp = binln + k * jnp.log(p) + sample * jnp.log1p(-p)
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_dirichlet", aliases=["random_pdf_dirichlet"])
+def _pdf_dirichlet(sample, alpha, is_log=False):
+    a = alpha[..., None, :] if alpha.ndim == sample.ndim - 1 else alpha
+    logp = (jnp.sum((a - 1) * jnp.log(sample), axis=-1)
+            + jax.scipy.special.gammaln(jnp.sum(a, axis=-1))
+            - jnp.sum(jax.scipy.special.gammaln(a), axis=-1))
+    return logp if is_log else jnp.exp(logp)

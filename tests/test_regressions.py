@@ -68,3 +68,21 @@ def test_multinomial_batched_get_prob():
 def test_compare_with_none():
     assert (nd.ones((2,)) == None) is False  # noqa: E711
     assert (nd.ones((2,)) != None) is True  # noqa: E711
+
+
+def test_import_does_not_init_backend():
+    """dist workers must be able to call jax.distributed.initialize AFTER
+    importing mxnet_tpu — any module-level jnp.asarray/jax.devices call in
+    the package breaks multi-process kvstore bring-up (round-3 regression:
+    image_ops module constants)."""
+    import subprocess
+    import sys
+
+    code = ("import mxnet_tpu\n"
+            "import jax._src.xla_bridge as xb\n"
+            "assert not xb._backends, 'XLA backend initialized at import'\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=120,
+                       cwd=__import__('os').path.dirname(
+                           __import__('os').path.dirname(__file__)))
+    assert r.returncode == 0, r.stderr[-2000:]
