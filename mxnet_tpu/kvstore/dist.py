@@ -149,9 +149,21 @@ class DistKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         if self._ps is not None:
+            from ..ndarray.sparse import RowSparseNDArray
+
             keys, values = _as_list(key), _as_list(value)
             for k, v in zip(keys, values):
                 vs = _as_list(v)
+                if all(isinstance(e, RowSparseNDArray) for e in vs):
+                    # sparse wire: concatenated (indices, rows) — the server
+                    # scatter-merges; only touched rows cross the DCN
+                    import numpy as np
+
+                    idx = np.concatenate(
+                        [e.indices.asnumpy().astype(np.int32) for e in vs])
+                    rows = np.concatenate([e.data.asnumpy() for e in vs])
+                    self._ps.push_row_sparse(str(k), idx, rows)
+                    continue
                 merged = vs[0]
                 for e in vs[1:]:
                     merged = merged + e
@@ -177,6 +189,23 @@ class DistKVStore(KVStore):
                 super().push(str(k), self._allreduce(merged))
             return
         super().push(key, value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if self._ps is not None:
+            import numpy as np
+
+            from ..ndarray import array
+
+            keys, outs, rids = _as_list(key), _as_list(out), _as_list(row_ids)
+            for k, o, r in zip(keys, outs, rids):
+                idx = (r.asnumpy() if hasattr(r, "asnumpy")
+                       else np.asarray(r)).astype(np.int32)
+                rows = self._ps.pull_row_sparse(str(k), idx)
+                for oo in _as_list(o):
+                    oo._set_data(array(rows)._data)
+            return
+        super().row_sparse_pull(key, out=out, priority=priority,
+                                row_ids=row_ids)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._ps is not None:

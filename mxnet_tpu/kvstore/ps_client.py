@@ -15,8 +15,9 @@ import time
 import numpy as np
 
 from ..base import MXNetError
-from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PUSH, OP_SET_OPT,
-                        OP_SHUTDOWN, _pack_array, _recv_msg, _send_msg,
+from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
+                        OP_PUSH, OP_PUSH_SPARSE, OP_SET_OPT, OP_SHUTDOWN,
+                        _pack_array, _pack_sparse, _recv_msg, _send_msg,
                         _unpack_array)
 
 
@@ -80,6 +81,27 @@ class PSClient:
 
     def pull(self, key: str) -> np.ndarray:
         _, _, payload = self._rpc(OP_PULL, key)
+        return _unpack_array(payload)
+
+    def push_row_sparse(self, key: str, indices: np.ndarray,
+                        rows: np.ndarray):
+        """Push only the touched rows (reference sparse ZPush: wire moves
+        len(indices) rows, not the full embedding matrix)."""
+        _, _, payload = self._rpc(OP_PUSH_SPARSE, key,
+                                  _pack_sparse(indices, rows))
+        if bytes(payload[:1]) != b"\x00":
+            raise MXNetError(
+                f"sparse push rejected for key {key!r} (bad dtype, "
+                "uninitialized key, or out-of-range row index)")
+
+    def pull_row_sparse(self, key: str, indices: np.ndarray) -> np.ndarray:
+        _, _, payload = self._rpc(
+            OP_PULL_SPARSE, key,
+            _pack_array(np.ascontiguousarray(indices, np.int32)))
+        if len(payload) == 0:  # server signals failure with an empty reply
+            raise MXNetError(
+                f"sparse pull rejected for key {key!r} (uninitialized key "
+                "or out-of-range row index)")
         return _unpack_array(payload)
 
     def set_optimizer(self, optimizer):

@@ -31,7 +31,8 @@ import numpy as np
 
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
 
-OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN = range(6)
+(OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
+ OP_PUSH_SPARSE, OP_PULL_SPARSE) = range(8)
 
 
 def _pack_array(arr: np.ndarray) -> bytes:
@@ -58,6 +59,29 @@ def _unpack_array(buf: memoryview) -> np.ndarray:
     dtype = np.dtype(CODE_TO_DTYPE[code])
     data = np.frombuffer(buf, dtype=dtype, offset=2 + 4 * ndim)
     return data.reshape(shape).copy()
+
+
+def _array_nbytes(buf: memoryview) -> int:
+    """Byte length of one packed array at the head of ``buf`` (so two arrays
+    can ride one payload — the sparse wire format: indices then rows)."""
+    ndim = struct.unpack_from("<B", buf, 0)[0]
+    shape = struct.unpack_from(f"<{ndim}I", buf, 1)
+    code = struct.unpack_from("<B", buf, 1 + 4 * ndim)[0]
+    size = 1
+    for s in shape:
+        size *= s
+    itemsize = np.dtype(CODE_TO_DTYPE[code]).itemsize
+    return 2 + 4 * ndim + size * itemsize
+
+
+def _pack_sparse(indices: np.ndarray, rows: np.ndarray) -> bytes:
+    return (_pack_array(np.ascontiguousarray(indices, np.int32))
+            + _pack_array(np.ascontiguousarray(rows)))
+
+
+def _unpack_sparse(buf: memoryview):
+    n = _array_nbytes(buf)
+    return _unpack_array(buf[:n]), _unpack_array(buf[n:])
 
 
 def _send_msg(sock: socket.socket, opcode: int, key: str = "", payload: bytes = b""):
@@ -181,6 +205,42 @@ class PSServer:
                     with self._locks.get(key, self._global_lock):
                         arr = self._weights[key]
                     _send_msg(conn, OP_PULL, key, _pack_array(arr))
+                elif opcode == OP_PUSH_SPARSE:
+                    # reference kvstore_dist.h sparse PSKV: only touched rows
+                    # cross the wire; the server applies a row-sparse update.
+                    # Same validation contract as the C++ twin: bad key /
+                    # out-of-range or negative index → \x01, never corruption
+                    ok = False
+                    if key in self._weights:
+                        idx, rows = _unpack_sparse(payload)
+                        idx = idx.astype(np.int64)
+                        w = self._weights[key]
+                        if (idx.ndim == 1 and rows.shape[:1] == idx.shape
+                                and rows.shape[1:] == w.shape[1:]
+                                and idx.size > 0
+                                and 0 <= idx.min() and idx.max() < w.shape[0]):
+                            with self._locks[key]:
+                                if self._updater is not None:
+                                    grad = np.zeros_like(w)
+                                    np.add.at(grad, idx, rows.astype(w.dtype))
+                                    self._apply(key, grad, w)
+                                else:
+                                    np.add.at(w, idx, rows.astype(w.dtype))
+                            ok = True
+                    _send_msg(conn, OP_PUSH_SPARSE, key,
+                              b"\x00" if ok else b"\x01")
+                elif opcode == OP_PULL_SPARSE:
+                    reply = b""  # empty = failure, matching the C++ twin
+                    if key in self._weights:
+                        idx = _unpack_array(payload).astype(np.int64)
+                        w = self._weights[key]
+                        if (idx.ndim == 1 and idx.size > 0
+                                and 0 <= idx.min()
+                                and idx.max() < w.shape[0]):
+                            with self._locks.get(key, self._global_lock):
+                                reply = _pack_array(
+                                    np.ascontiguousarray(w[idx]))
+                    _send_msg(conn, OP_PULL_SPARSE, key, reply)
                 elif opcode == OP_SET_OPT:
                     self._set_optimizer_bytes(bytes(payload))
                     _send_msg(conn, OP_SET_OPT, key, b"\x00")

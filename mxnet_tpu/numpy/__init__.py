@@ -1,11 +1,15 @@
 """``mx.np`` — the NumPy-compatible frontend.
 
 Reference: ``python/mxnet/numpy/`` (a large re-implementation of numpy
-semantics over the op registry — TBV, SURVEY.md §2.3). TPU redesign: jax
-already IS a numpy-compatible array API, so this module is a thin
-delegation layer — any ``jnp.<name>`` resolves here, unwrapping/wrapping
-:class:`NDArray` at the boundary. mxnet-specific dtype defaults (float32)
-are applied on creation.
+semantics over the op registry — TBV, SURVEY.md §2.3).
+
+Two layers here:
+- ``_ops.py`` carries EXPLICIT implementations of the most-used numpy ops
+  with mxnet-numpy semantics — ``out=``, ``where=``, float32-default dtype
+  promotion, NDArray returns (see its docstring; tests:
+  tests/test_numpy_semantics.py);
+- anything not explicitly implemented falls back to a jnp delegate,
+  unwrapping/wrapping :class:`NDArray` at the boundary.
 """
 from __future__ import annotations
 
@@ -14,6 +18,7 @@ import numpy as _onp
 
 from ..ndarray import NDArray
 from ..ndarray.ndarray import invoke_fn
+from ._ops import *  # noqa: F401,F403
 
 __all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange"]
 
@@ -115,6 +120,8 @@ def _make_delegate(name):
 
 
 def __getattr__(name):
+    # explicit ops are bound by the star-import above; only unimplemented
+    # names reach this fallback delegate
     if hasattr(jnp, name):
         attr = getattr(jnp, name)
         if callable(attr) and not isinstance(attr, type):

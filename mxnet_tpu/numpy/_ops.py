@@ -1,0 +1,328 @@
+"""Explicit mx.np operator implementations with mxnet-numpy semantics.
+
+Reference: ``python/mxnet/numpy/multiarray.py`` + ``src/operator/numpy/*``
+(TBV — SURVEY.md §2.2 Numpy row). What "mxnet-numpy semantics" means beyond
+raw jnp delegation (the round-2 approach, which got these wrong silently):
+
+- ``out=``: the result lands in the given ndarray (rebinding its buffer —
+  reference in-place write) and that same ndarray is returned;
+- ``where=`` on binary ufuncs: elements where the mask is False come from
+  ``out`` (which numpy requires to be meaningful in that case);
+- default float dtype is float32 — integer inputs to mean/std/var/divide
+  promote to float32, never float64 (the reference's global
+  ``npx.set_np(dtype=...)`` default);
+- every result is an :class:`NDArray` (mx.np.ndarray), recorded on the
+  autograd tape via invoke_fn.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..ndarray import NDArray
+from ..ndarray.ndarray import invoke_fn
+
+__all__: list = []
+
+_EXPLICIT = {}
+
+
+def _np_op(name):
+    def deco(fn):
+        _EXPLICIT[name] = fn
+        fn.__name__ = name
+        globals()[name] = fn  # the ufunc factories don't assign the name
+        __all__.append(name)
+        return fn
+    return deco
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _invoke(pure, arrays, out=None):
+    """Run ``pure`` over the NDArray inputs (autograd-recorded); honor out=."""
+    nds = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+           for a in arrays]
+    res = invoke_fn(pure, nds)
+    if out is not None:
+        if not isinstance(out, NDArray):
+            raise TypeError("out= must be an mx.np.ndarray")
+        first = res[0] if isinstance(res, (tuple, list)) else res
+        out._set_data(first._data.astype(out.dtype))
+        return out
+    return res
+
+
+def _binary(name, fn):
+    @_np_op(name)
+    def op(x1, x2, out=None, where=True, **kwargs):
+        if where is True or where is None:
+            return _invoke(lambda a, b: fn(a, b), [x1, x2], out)
+        if out is None:
+            raise ValueError(
+                f"np.{name}: where= requires out= (unselected elements are "
+                "taken from out, matching numpy)")
+        mask = _unwrap(where)
+        return _invoke(
+            lambda a, b, base: jnp.where(mask, fn(a, b).astype(base.dtype),
+                                         base),
+            [x1, x2, out], out)
+    return op
+
+
+_binary("add", jnp.add)
+_binary("subtract", jnp.subtract)
+_binary("multiply", jnp.multiply)
+_binary("mod", jnp.mod)
+_binary("remainder", jnp.remainder)
+_binary("power", jnp.power)
+_binary("maximum", jnp.maximum)
+_binary("minimum", jnp.minimum)
+_binary("hypot", jnp.hypot)
+_binary("arctan2", jnp.arctan2)
+_binary("copysign", jnp.copysign)
+
+
+def _to_float(x):
+    return (x.astype(jnp.float32)
+            if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_
+            else x)
+
+
+@_np_op("divide")
+def divide(x1, x2, out=None, where=True, **kwargs):
+    # int/int division is float32 (mxnet default float), never float64
+    if where is True or where is None:
+        return _invoke(lambda a, b: jnp.divide(_to_float(a), _to_float(b)),
+                       [x1, x2], out)
+    if out is None:
+        raise ValueError("np.divide: where= requires out=")
+    mask = _unwrap(where)
+    return _invoke(
+        lambda a, b, base: jnp.where(
+            mask, jnp.divide(_to_float(a), _to_float(b)).astype(base.dtype),
+            base),
+        [x1, x2, out], out)
+
+
+true_divide = divide
+_EXPLICIT["true_divide"] = divide
+__all__.append("true_divide")
+
+
+def _unary(name, fn):
+    @_np_op(name)
+    def op(x, out=None, where=True, **kwargs):
+        if where is True or where is None:
+            return _invoke(fn, [x], out)
+        if out is None:
+            raise ValueError(f"np.{name}: where= requires out=")
+        mask = _unwrap(where)
+        return _invoke(
+            lambda a, base: jnp.where(mask, fn(a).astype(base.dtype), base),
+            [x, out], out)
+    return op
+
+
+_unary("sqrt", jnp.sqrt)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("abs", jnp.abs)
+_unary("absolute", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("negative", jnp.negative)
+_unary("reciprocal", lambda x: jnp.reciprocal(_to_float(x)))
+_unary("square", jnp.square)
+_unary("rint", jnp.rint)
+_unary("floor", jnp.floor)
+_unary("ceil", jnp.ceil)
+_unary("trunc", jnp.trunc)
+
+
+def _axis_tuple(axis):
+    if axis is None or isinstance(axis, int):
+        return axis
+    return tuple(axis)
+
+
+def _reduction(name, fn, float_result=False):
+    @_np_op(name)
+    def op(a, axis=None, dtype=None, out=None, keepdims=False, **kwargs):
+        def pure(x):
+            xx = _to_float(x) if float_result and dtype is None else x
+            if dtype is not None:
+                xx = x.astype(dtype)
+            return fn(xx, axis=_axis_tuple(axis), keepdims=keepdims)
+        return _invoke(pure, [a], out)
+    return op
+
+
+_reduction("sum", jnp.sum)
+_reduction("prod", jnp.prod)
+_reduction("mean", jnp.mean, float_result=True)
+_reduction("max", jnp.max)
+_reduction("min", jnp.min)
+_reduction("amax", jnp.max)
+_reduction("amin", jnp.min)
+
+
+@_np_op("std")
+def std(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False, **kw):
+    def pure(x):
+        xx = _to_float(x) if dtype is None else x.astype(dtype)
+        return jnp.std(xx, axis=_axis_tuple(axis), ddof=ddof,
+                       keepdims=keepdims)
+    return _invoke(pure, [a], out)
+
+
+@_np_op("var")
+def var(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False, **kw):
+    def pure(x):
+        xx = _to_float(x) if dtype is None else x.astype(dtype)
+        return jnp.var(xx, axis=_axis_tuple(axis), ddof=ddof,
+                       keepdims=keepdims)
+    return _invoke(pure, [a], out)
+
+
+@_np_op("argmax")
+def argmax(a, axis=None, out=None, **kw):
+    # reference returns int64; with x64 disabled int32 is the TPU-native max
+    return _invoke(lambda x: jnp.argmax(x, axis=axis).astype(jnp.int32),
+                   [a], out)
+
+
+@_np_op("argmin")
+def argmin(a, axis=None, out=None, **kw):
+    return _invoke(lambda x: jnp.argmin(x, axis=axis).astype(jnp.int32),
+                   [a], out)
+
+
+@_np_op("clip")
+def clip(a, a_min=None, a_max=None, out=None, **kw):
+    return _invoke(lambda x: jnp.clip(x, a_min, a_max), [a], out)
+
+
+@_np_op("dot")
+def dot(a, b, out=None):
+    return _invoke(lambda x, y: jnp.dot(x, y), [a, b], out)
+
+
+@_np_op("matmul")
+def matmul(a, b, out=None, **kw):
+    return _invoke(lambda x, y: jnp.matmul(x, y), [a, b], out)
+
+
+@_np_op("tensordot")
+def tensordot(a, b, axes=2):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(x) if isinstance(x, (list, tuple)) else x for x in ax)
+    return _invoke(lambda x, y: jnp.tensordot(x, y, axes=ax), [a, b])
+
+
+@_np_op("concatenate")
+def concatenate(seq, axis=0, out=None):
+    arrays = list(seq)
+    return _invoke(lambda *ts: jnp.concatenate(ts, axis=axis), arrays, out)
+
+
+@_np_op("stack")
+def stack(arrays, axis=0, out=None):
+    arrays = list(arrays)
+    return _invoke(lambda *ts: jnp.stack(ts, axis=axis), arrays, out)
+
+
+@_np_op("split")
+def split(ary, indices_or_sections, axis=0):
+    ios = indices_or_sections
+    if isinstance(ios, (list, tuple)):
+        ios = tuple(int(i) for i in ios)
+    n_out = (len(ios) + 1 if isinstance(ios, tuple) else int(ios))
+    outs = _invoke(lambda x: tuple(jnp.split(x, ios, axis=axis)), [ary])
+    return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+
+
+@_np_op("where")
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        cond = _unwrap(condition)
+        return tuple(NDArray(i.astype(jnp.int32)) for i in jnp.nonzero(cond))
+    return _invoke(lambda c, a, b: jnp.where(c, a, b), [condition, x, y])
+
+
+@_np_op("reshape")
+def reshape(a, newshape, order="C"):
+    return _invoke(lambda x: jnp.reshape(x, newshape), [a])
+
+
+@_np_op("transpose")
+def transpose(a, axes=None):
+    return _invoke(lambda x: jnp.transpose(x, axes), [a])
+
+
+@_np_op("swapaxes")
+def swapaxes(a, axis1, axis2):
+    return _invoke(lambda x: jnp.swapaxes(x, axis1, axis2), [a])
+
+
+@_np_op("expand_dims")
+def expand_dims(a, axis):
+    return _invoke(lambda x: jnp.expand_dims(x, axis), [a])
+
+
+@_np_op("squeeze")
+def squeeze(a, axis=None):
+    return _invoke(lambda x: jnp.squeeze(x, axis), [a])
+
+
+@_np_op("broadcast_to")
+def broadcast_to(array, shape):
+    return _invoke(lambda x: jnp.broadcast_to(x, shape), [array])
+
+
+@_np_op("repeat")
+def repeat(a, repeats, axis=None):
+    return _invoke(lambda x: jnp.repeat(x, repeats, axis=axis), [a])
+
+
+@_np_op("tile")
+def tile(a, reps):
+    return _invoke(lambda x: jnp.tile(x, reps), [a])
+
+
+@_np_op("cumsum")
+def cumsum(a, axis=None, dtype=None, out=None):
+    def pure(x):
+        r = jnp.cumsum(x.reshape(-1) if axis is None else x,
+                       axis=0 if axis is None else axis)
+        return r.astype(dtype) if dtype else r
+    return _invoke(pure, [a], out)
+
+
+@_np_op("copy")
+def copy(a):
+    return _invoke(lambda x: x + jnp.zeros((), x.dtype), [a])
+
+
+@_np_op("linspace")
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    out = jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                       retstep=retstep, dtype=dtype or jnp.float32, axis=axis)
+    if retstep:
+        return NDArray(out[0]), float(out[1])
+    return NDArray(out)

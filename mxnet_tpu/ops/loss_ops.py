@@ -106,10 +106,7 @@ def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
     else:
         t_lens = jnp.full((B,), T, jnp.int32)
 
-    if blank_label == "first":
-        eff = jnp.maximum(eff, 0)  # safe index; masked out by l_lens anyway
-    else:
-        eff = jnp.maximum(eff, 0)
+    eff = jnp.maximum(eff, 0)  # safe index; masked out by l_lens anyway
 
     losses = jax.vmap(_ctc_single, in_axes=(1, 0, 0, 0, None))(
         logprobs, eff, t_lens, l_lens, blank)

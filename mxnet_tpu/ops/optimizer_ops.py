@@ -327,22 +327,21 @@ register("preloaded_multi_mp_sgd_mom_update",
           num_outputs=_multi_n_out(4, 3))
 def _multi_lamb_phase1(*arrays, **kwargs):
     def step(i, w, g, mean, var):
-        mean_new, var_new = _lamb_states(
-            g, mean, var, beta1=kwargs.get("beta1", 0.9),
-            beta2=kwargs.get("beta2", 0.999),
+        b1 = kwargs.get("beta1", 0.9)
+        b2 = kwargs.get("beta2", 0.999)
+        m, v = _lamb_states(
+            g, mean, var, beta1=b1, beta2=b2,
             rescale_grad=kwargs.get("rescale_grad", 1.0),
             clip_gradient=kwargs.get("clip_gradient", -1.0))
-        upd = _lamb_update_phase1(
-            w, g, mean, var, beta1=kwargs.get("beta1", 0.9),
-            beta2=kwargs.get("beta2", 0.999),
-            epsilon=kwargs.get("epsilon", 1e-6),
-            t=_per_group(kwargs, "step_count",
-                         i, _per_group(kwargs, "t", i, 1)),
-            bias_correction=kwargs.get("bias_correction", True),
-            wd=_per_group(kwargs, "wds", i, 0.0),
-            rescale_grad=kwargs.get("rescale_grad", 1.0),
-            clip_gradient=kwargs.get("clip_gradient", -1.0))
-        return upd, mean_new, var_new
+        mb, vb = m, v
+        if kwargs.get("bias_correction", True):
+            t = _per_group(kwargs, "step_count",
+                           i, _per_group(kwargs, "t", i, 1))
+            mb = m / (1 - b1 ** t)
+            vb = v / (1 - b2 ** t)
+        upd = (mb / (jnp.sqrt(vb) + kwargs.get("epsilon", 1e-6))
+               + _per_group(kwargs, "wds", i, 0.0) * w)
+        return upd, m, v
     return _multi(step, 4, 3, arrays, kwargs)
 
 

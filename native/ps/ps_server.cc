@@ -33,7 +33,7 @@
 namespace {
 
 enum Op : uint8_t { INIT = 0, PUSH = 1, PULL = 2, SET_OPT = 3, BARRIER = 4,
-                    SHUTDOWN = 5 };
+                    SHUTDOWN = 5, PUSH_SPARSE = 6, PULL_SPARSE = 7 };
 
 struct Entry {
   std::vector<uint32_t> shape;
@@ -165,6 +165,25 @@ class Server {
           out = PackArray(*e);
         }
         SendMsg(conn, PULL, key, out);
+      } else if (op == PUSH_SPARSE) {
+        // payload: [int32 indices array][f32 rows array] — only touched
+        // rows cross the wire (reference sparse PSKV push)
+        Entry* e = GetEntry(key, false);
+        bool ok = false;
+        if (e) {
+          std::lock_guard<std::mutex> lk(e->mu);
+          ok = ApplySparsePush(e, payload, payload_len);
+        }
+        SendMsg(conn, PUSH_SPARSE, key, std::string(ok ? "\x00" : "\x01", 1));
+      } else if (op == PULL_SPARSE) {
+        Entry* e = GetEntry(key, false);
+        std::string out;
+        bool ok = false;
+        if (e) {
+          std::lock_guard<std::mutex> lk(e->mu);
+          ok = PackRows(*e, payload, payload_len, &out);
+        }
+        SendMsg(conn, PULL_SPARSE, key, ok ? out : std::string());
       } else if (op == SET_OPT) {
         ParseOptimizer(std::string(reinterpret_cast<const char*>(payload),
                                    payload_len));
@@ -276,6 +295,12 @@ class Server {
       count = (n - off) / 4;
     }
     if (count != e->weight.size()) return;
+    ApplyGrad(e, g, count);
+  }
+
+  // Optimizer application on a full-size dense gradient (shared by the
+  // dense PUSH path and the scatter-densified sparse path).
+  void ApplyGrad(Entry* e, const float* g, size_t count) {
     Optimizer o;
     {
       std::lock_guard<std::mutex> lk(opt_mu_);
@@ -318,6 +343,87 @@ class Server {
         }
       }
     }
+  }
+
+  // --- sparse wire helpers ------------------------------------------------
+
+  // Parse "[int32 indices (n,)] [f32 rows (n, row...)]" from the payload.
+  // Returns false on any malformed field (connection-safe: caller replies
+  // \x01 and carries on).
+  static bool ParseSparse(const Entry& e, const uint8_t* p, size_t n,
+                          std::vector<int64_t>* idx, const float** rows,
+                          size_t* row_len) {
+    std::vector<uint32_t> ishape;
+    uint8_t code = 0;
+    size_t off = ParseHeader(p, n, &ishape, &code);
+    if (off == 0 || ishape.size() != 1 || code != 4) return false;  // int32
+    size_t cnt = ishape[0];
+    if (n - off < cnt * 4) return false;
+    const int32_t* ip = reinterpret_cast<const int32_t*>(p + off);
+    size_t off2 = off + cnt * 4;
+    std::vector<uint32_t> rshape;
+    uint8_t rcode = 0;
+    size_t roff = ParseHeader(p + off2, n - off2, &rshape, &rcode);
+    if (roff == 0 || rcode != 0 || rshape.empty() || rshape[0] != cnt)
+      return false;
+    size_t rl = 1;
+    for (size_t i = 1; i < rshape.size(); ++i) rl *= rshape[i];
+    if (e.shape.empty() || e.weight.size() / e.shape[0] != rl) return false;
+    if ((n - off2 - roff) / 4 < cnt * rl) return false;
+    idx->assign(ip, ip + cnt);
+    for (int64_t v : *idx)
+      if (v < 0 || uint64_t(v) >= e.shape[0]) return false;
+    *rows = reinterpret_cast<const float*>(p + off2 + roff);
+    *row_len = rl;
+    return true;
+  }
+
+  bool ApplySparsePush(Entry* e, const uint8_t* p, size_t n) {
+    std::vector<int64_t> idx;
+    const float* rows = nullptr;
+    size_t rl = 0;
+    if (!ParseSparse(*e, p, n, &idx, &rows, &rl)) return false;
+    bool have_opt;
+    {
+      std::lock_guard<std::mutex> lk(opt_mu_);
+      have_opt = !opt_.name.empty();
+    }
+    if (!have_opt) {  // aggregate-only: scatter-add straight into weights
+      for (size_t r = 0; r < idx.size(); ++r)
+        for (size_t j = 0; j < rl; ++j)
+          e->weight[size_t(idx[r]) * rl + j] += rows[r * rl + j];
+      return true;
+    }
+    // optimizer installed: densify (zeros elsewhere) and run the shared
+    // update — optimizer state stays full-size like the reference server
+    std::vector<float> grad(e->weight.size(), 0.f);
+    for (size_t r = 0; r < idx.size(); ++r)
+      for (size_t j = 0; j < rl; ++j)
+        grad[size_t(idx[r]) * rl + j] += rows[r * rl + j];
+    ApplyGrad(e, grad.data(), grad.size());
+    return true;
+  }
+
+  static bool PackRows(const Entry& e, const uint8_t* p, size_t n,
+                       std::string* out) {
+    std::vector<uint32_t> ishape;
+    uint8_t code = 0;
+    size_t off = ParseHeader(p, n, &ishape, &code);
+    if (off == 0 || ishape.size() != 1 || code != 4) return false;
+    size_t cnt = ishape[0];
+    if (n - off < cnt * 4 || e.shape.empty()) return false;
+    const int32_t* ip = reinterpret_cast<const int32_t*>(p + off);
+    size_t rl = e.weight.size() / e.shape[0];
+    uint32_t shape2[2] = {uint32_t(cnt), uint32_t(rl)};
+    out->push_back(2);
+    out->append(reinterpret_cast<const char*>(shape2), 8);
+    out->push_back(0);  // f32
+    for (size_t r = 0; r < cnt; ++r) {
+      if (ip[r] < 0 || uint32_t(ip[r]) >= e.shape[0]) return false;
+      out->append(reinterpret_cast<const char*>(
+                      e.weight.data() + size_t(ip[r]) * rl), rl * 4);
+    }
+    return true;
   }
 
   static std::string PackArray(const Entry& e) {

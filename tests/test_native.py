@@ -135,3 +135,117 @@ def test_native_ps_server_adam_converges():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def _sparse_roundtrip(cli):
+    """Shared known-value assertions for row_sparse push/pull (both servers)."""
+    w0 = np.zeros((6, 3), np.float32)
+    cli.init("emb", w0)
+    idx = np.array([1, 4], np.int32)
+    rows = np.stack([np.full(3, 2.0, np.float32),
+                     np.full(3, 5.0, np.float32)])
+    # aggregate-only: rows scatter-add into the weight
+    cli.push_row_sparse("emb", idx, rows)
+    got = cli.pull_row_sparse("emb", np.array([0, 1, 4], np.int32))
+    np.testing.assert_allclose(got, [[0, 0, 0], [2, 2, 2], [5, 5, 5]])
+    full = cli.pull("emb")
+    assert full[2].sum() == 0 and full[3].sum() == 0
+    # duplicate indices accumulate (gradient merge semantics)
+    cli.push_row_sparse("emb", np.array([1, 1], np.int32),
+                        np.ones((2, 3), np.float32))
+    got = cli.pull_row_sparse("emb", np.array([1], np.int32))
+    np.testing.assert_allclose(got, [[4, 4, 4]])
+    # server-side optimizer applies to touched rows only
+    from mxnet_tpu.optimizer import create as opt_create
+
+    cli.set_optimizer(opt_create("sgd", learning_rate=1.0))
+    cli.push_row_sparse("emb", np.array([4], np.int32),
+                        np.full((1, 3), 1.0, np.float32))
+    got = cli.pull_row_sparse("emb", np.array([4, 0], np.int32))
+    np.testing.assert_allclose(got, [[4, 4, 4], [0, 0, 0]])  # 5 - 1, untouched
+
+
+@pytest.mark.skipif(ps_server_binary() is None, reason="ps server not built")
+def test_native_ps_row_sparse():
+    from mxnet_tpu.kvstore.ps_client import PSClient
+
+    proc = subprocess.Popen([ps_server_binary(), "--port", "0"],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline().strip().rsplit(":", 1)[1])
+        cli = PSClient("127.0.0.1", port)
+        _sparse_roundtrip(cli)
+        cli.shutdown()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_python_ps_row_sparse():
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv = PSServer(port=0, num_workers=1)
+    srv.start()
+    try:
+        cli = PSClient("127.0.0.1", srv.port)
+        _sparse_roundtrip(cli)
+    finally:
+        srv.stop()
+
+
+def test_dist_async_row_sparse_kvstore(monkeypatch):
+    """DistKVStore('dist_async') end-to-end: RowSparse push + row_sparse_pull
+    move only touched rows through the PS."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.kvstore.ps_server import PSServer
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    srv = PSServer(port=0, num_workers=1)
+    srv.start()
+    monkeypatch.setenv("MXNET_PS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("MXNET_PS_PORT", str(srv.port))
+    try:
+        import mxnet_tpu as mx
+
+        kv = mx.kv.create("dist_async")
+        kv.init("emb", nd.zeros((8, 2)))
+        dense = np.zeros((8, 2), np.float32)
+        dense[3] = 7.0
+        rs = RowSparseNDArray.from_dense(nd.array(dense))
+        kv.push("emb", rs)
+        out = nd.zeros((2, 2))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array(
+            np.array([3, 0], np.float32)))
+        np.testing.assert_allclose(out.asnumpy(), [[7, 7], [0, 0]])
+    finally:
+        srv.stop()
+
+
+def test_python_ps_sparse_rejects_bad_requests():
+    """Validation contract shared with the C++ twin: bad indices/keys get a
+    clean error, never corruption or a dead handler thread."""
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv = PSServer(port=0, num_workers=1)
+    srv.start()
+    try:
+        cli = PSClient("127.0.0.1", srv.port)
+        cli.init("w", np.zeros((4, 2), np.float32))
+        # negative index must NOT wrap to the last row
+        with pytest.raises(MXNetError):
+            cli.push_row_sparse("w", np.array([-1], np.int32),
+                                np.ones((1, 2), np.float32), )
+        # out-of-range index
+        with pytest.raises(MXNetError):
+            cli.push_row_sparse("w", np.array([9], np.int32),
+                                np.ones((1, 2), np.float32))
+        # unknown key on pull
+        with pytest.raises(MXNetError):
+            cli.pull_row_sparse("nope", np.array([0], np.int32))
+        # server is still alive and uncorrupted after all rejects
+        np.testing.assert_allclose(cli.pull("w"), np.zeros((4, 2)))
+    finally:
+        srv.stop()

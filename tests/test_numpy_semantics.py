@@ -1,0 +1,165 @@
+"""mx.np mxnet-numpy semantics (VERDICT r2 #6): out=, where=, float32
+dtype rules, ndarray returns, autograd recording — modeled on the
+reference's tests/python/unittest/test_numpy_op.py (TBV)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.ndarray import NDArray
+
+
+def test_returns_ndarray_and_values():
+    a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mnp.array([[10.0, 20.0], [30.0, 40.0]])
+    out = mnp.add(a, b)
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [[11, 22], [33, 44]])
+    onp.testing.assert_allclose(mnp.subtract(b, a).asnumpy(),
+                                [[9, 18], [27, 36]])
+    onp.testing.assert_allclose(mnp.sqrt(mnp.array([4.0, 9.0])).asnumpy(),
+                                [2, 3])
+
+
+def test_out_parameter_binary_and_reduction():
+    a = mnp.array([1.0, 2.0, 3.0])
+    b = mnp.array([4.0, 5.0, 6.0])
+    buf = mnp.zeros((3,))
+    r = mnp.add(a, b, out=buf)
+    assert r is buf
+    onp.testing.assert_allclose(buf.asnumpy(), [5, 7, 9])
+    sbuf = mnp.zeros(())
+    r2 = mnp.sum(a, out=sbuf)
+    assert r2 is sbuf
+    assert float(sbuf.asnumpy()) == 6.0
+    # out= with dtype conversion: result cast to out's dtype
+    ibuf = mnp.zeros((3,), dtype="int32")
+    mnp.add(a, b, out=ibuf)
+    assert ibuf.dtype == onp.int32
+    onp.testing.assert_array_equal(ibuf.asnumpy(), [5, 7, 9])
+
+
+def test_where_parameter():
+    a = mnp.array([1.0, 2.0, 3.0, 4.0])
+    b = mnp.array([10.0, 10.0, 10.0, 10.0])
+    base = mnp.full((4,), -1.0)
+    mask = mnp.array([True, False, True, False])
+    r = mnp.add(a, b, out=base, where=mask)
+    onp.testing.assert_allclose(r.asnumpy(), [11, -1, 13, -1])
+    with pytest.raises(ValueError):
+        mnp.add(a, b, where=mask)  # where= without out= is ambiguous
+    u = mnp.full((4,), 7.0)
+    r2 = mnp.sqrt(mnp.array([4.0, 9.0, 16.0, 25.0]), out=u, where=mask)
+    onp.testing.assert_allclose(r2.asnumpy(), [2, 7, 4, 7])
+
+
+def test_float32_dtype_rules():
+    # int/int divide -> float32 (NOT float64: mxnet default float)
+    i = mnp.array([1, 2, 3], dtype="int32")
+    j = mnp.array([2, 2, 2], dtype="int32")
+    d = mnp.divide(i, j)
+    assert d.dtype == onp.float32
+    onp.testing.assert_allclose(d.asnumpy(), [0.5, 1.0, 1.5])
+    assert mnp.true_divide(i, j).dtype == onp.float32
+    # mean/std/var of ints -> float32
+    assert mnp.mean(i).dtype == onp.float32
+    assert mnp.std(i).dtype == onp.float32
+    assert mnp.var(i).dtype == onp.float32
+    # sum of ints stays integral
+    assert mnp.sum(i).dtype == onp.int32
+    # creation default is float32
+    assert mnp.array([1.5]).dtype == onp.float32
+    assert mnp.zeros((2,)).dtype == onp.float32
+    assert mnp.linspace(0, 1, 5).dtype == onp.float32
+
+
+def test_reductions_axis_keepdims():
+    x = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    onp.testing.assert_allclose(mnp.sum(x, axis=0).asnumpy(), [4, 6])
+    onp.testing.assert_allclose(mnp.sum(x, axis=1, keepdims=True).asnumpy(),
+                                [[3], [7]])
+    onp.testing.assert_allclose(float(mnp.mean(x).asnumpy()), 2.5)
+    onp.testing.assert_allclose(mnp.max(x, axis=1).asnumpy(), [2, 4])
+    onp.testing.assert_allclose(mnp.var(x, axis=0, ddof=1).asnumpy(), [2, 2])
+    am = mnp.argmax(x, axis=1)
+    assert am.dtype == onp.int32
+    onp.testing.assert_array_equal(am.asnumpy(), [1, 1])
+
+
+def test_shape_manipulation():
+    x = mnp.arange(0, 6)
+    r = mnp.reshape(x, (2, 3))
+    assert r.shape == (2, 3)
+    t = mnp.transpose(r)
+    assert t.shape == (3, 2)
+    e = mnp.expand_dims(x, 0)
+    assert e.shape == (1, 6)
+    s = mnp.squeeze(e)
+    assert s.shape == (6,)
+    parts = mnp.split(r, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+    parts2 = mnp.split(x, [2, 4])
+    assert [p.shape[0] for p in parts2] == [2, 2, 2]
+    c = mnp.concatenate([r, r], axis=0)
+    assert c.shape == (4, 3)
+    st = mnp.stack([x, x], axis=0)
+    assert st.shape == (2, 6)
+    bt = mnp.broadcast_to(mnp.array([1.0, 2.0]), (3, 2))
+    assert bt.shape == (3, 2)
+    onp.testing.assert_allclose(mnp.tile(mnp.array([1.0]), 3).asnumpy(),
+                                [1, 1, 1])
+
+
+def test_where_and_nonzero_form():
+    c = mnp.array([True, False, True])
+    x = mnp.array([1.0, 2.0, 3.0])
+    y = mnp.array([-1.0, -2.0, -3.0])
+    onp.testing.assert_allclose(mnp.where(c, x, y).asnumpy(), [1, -2, 3])
+    idx = mnp.where(c)
+    assert isinstance(idx, tuple)
+    onp.testing.assert_array_equal(idx[0].asnumpy(), [0, 2])
+
+
+def test_matmul_dot_tensordot():
+    a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mnp.array([[5.0, 6.0], [7.0, 8.0]])
+    onp.testing.assert_allclose(mnp.matmul(a, b).asnumpy(),
+                                onp.array([[19, 22], [43, 50]]))
+    onp.testing.assert_allclose(mnp.dot(a, b).asnumpy(),
+                                onp.array([[19, 22], [43, 50]]))
+    td = mnp.tensordot(a, b, axes=([1], [0]))
+    onp.testing.assert_allclose(td.asnumpy(), onp.array([[19, 22], [43, 50]]))
+
+
+def test_autograd_records_np_ops():
+    x = mnp.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mnp.sum(mnp.multiply(x, x))
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_delegate_fallback_still_works():
+    # ops not explicitly implemented fall through to the jnp delegate
+    x = mnp.array([1.0, 4.0, 9.0])
+    out = mnp.cbrt(mnp.array([8.0, 27.0]))
+    onp.testing.assert_allclose(out.asnumpy(), [2, 3], rtol=1e-6)
+    assert isinstance(out, NDArray)
+    s = mnp.sort(mnp.array([3.0, 1.0, 2.0]))
+    onp.testing.assert_allclose(s.asnumpy(), [1, 2, 3])
+
+
+def test_unary_and_clip_misc():
+    x = mnp.array([-2.0, 0.5, 3.0])
+    onp.testing.assert_allclose(mnp.clip(x, 0.0, 1.0).asnumpy(), [0, 0.5, 1])
+    onp.testing.assert_allclose(mnp.sign(x).asnumpy(), [-1, 1, 1])
+    onp.testing.assert_allclose(mnp.negative(x).asnumpy(), [2, -0.5, -3])
+    r = mnp.reciprocal(mnp.array([2, 4], dtype="int32"))
+    assert r.dtype == onp.float32
+    onp.testing.assert_allclose(r.asnumpy(), [0.5, 0.25])
+    cs = mnp.cumsum(mnp.array([[1.0, 2.0], [3.0, 4.0]]), axis=1)
+    onp.testing.assert_allclose(cs.asnumpy(), [[1, 3], [3, 7]])
+    cp = mnp.copy(x)
+    assert cp is not x
+    onp.testing.assert_allclose(cp.asnumpy(), x.asnumpy())
