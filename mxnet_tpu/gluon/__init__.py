@@ -1,5 +1,5 @@
 """Gluon — the imperative high-level API (reference python/mxnet/gluon/)."""
-from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock, load_stablehlo  # noqa: F401
 from .parameter import Parameter, Constant, ParameterDict  # noqa: F401
 from .trainer import Trainer  # noqa: F401
 from . import nn  # noqa: F401

@@ -2,7 +2,11 @@
 
 Reference: ``python/mxnet/monitor.py`` (executor output callback — TBV,
 SURVEY.md §5.5). Here the tap installs over Executor forward results and
-Gluon forward hooks.
+Gluon forward hooks — and, unlike round 2, it works **inside jitted
+programs**: when a hook fires during tracing (hybridize / CachedOp), the
+stat is computed in-graph and shipped out through ``jax.debug.callback``,
+so every compiled replay still reports; activation gating happens at
+runtime inside the callback.
 """
 from __future__ import annotations
 
@@ -16,16 +20,18 @@ from .ndarray import NDArray
 __all__ = ["Monitor"]
 
 
-def _default_stat(x: np.ndarray):
-    return np.abs(x).mean()
+def _default_stat(x):
+    """abs().mean() expressed over NDArray ops so it traces under jit (the
+    round-2 version called asnumpy(), which explodes on tracers)."""
+    if isinstance(x, NDArray):
+        return x.abs().mean()
+    return np.abs(np.asarray(x)).mean()
 
 
 class Monitor:
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
         self.interval = interval
-        self.stat_func = stat_func or (lambda x: _default_stat(x.asnumpy()
-                                                               if isinstance(x, NDArray)
-                                                               else np.asarray(x)))
+        self.stat_func = stat_func or _default_stat
         self.pattern = re.compile(pattern)
         self.sort = sort
         self.step = 0
@@ -43,15 +49,33 @@ class Monitor:
         """Attach forward hooks to every child of a Gluon block."""
 
         def hook(blk, inputs, output):
-            if not self.activated:
-                return
+            import jax
+
             name = blk.name
-            if self.pattern.match(name):
-                outs = output if isinstance(output, (list, tuple)) else [output]
-                for i, o in enumerate(outs):
-                    if isinstance(o, NDArray):
-                        self.queue.append((self.step, f"{name}_output{i}",
-                                           self.stat_func(o)))
+            if not self.pattern.match(name):
+                return
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                if not isinstance(o, NDArray):
+                    continue
+                tag = f"{name}_output{i}"
+                if isinstance(o._data, jax.core.Tracer):
+                    # tracing (CachedOp/jit): compute the stat in-graph and
+                    # emit it at every replay; gate on self.activated at
+                    # RUNTIME (trace-time gating would bake the decision in)
+                    s = self.stat_func(o)
+                    val = s._data if isinstance(s, NDArray) else s
+
+                    def emit(v, _tag=tag):
+                        if self.activated:
+                            self.queue.append((self.step, _tag, np.asarray(v)))
+
+                    jax.debug.callback(emit, val)
+                elif self.activated:
+                    s = self.stat_func(o)
+                    if isinstance(s, NDArray):
+                        s = s.asnumpy()
+                    self.queue.append((self.step, tag, s))
 
         def walk(b):
             b.register_forward_hook(hook)
@@ -68,12 +92,20 @@ class Monitor:
         self.step += 1
 
     def toc(self, exe=None):
+        import jax
+
+        # flush in-flight debug callbacks before draining the queue — on an
+        # async backend a compiled replay's emits may still be in transit
+        jax.effects_barrier()
         if not self.activated:
             return []
         if exe is not None:
             for name, out in zip(exe._symbol.list_outputs(), exe.outputs):
                 if self.pattern.match(name):
-                    self.queue.append((self.step, name, self.stat_func(out)))
+                    s = self.stat_func(out)
+                    if isinstance(s, NDArray):
+                        s = s.asnumpy()
+                    self.queue.append((self.step, name, s))
         self.activated = False
         res = list(self.queue)
         if self.sort:

@@ -496,14 +496,27 @@ def invoke(op: Any, inputs: Sequence[NDArray], kwargs: dict):
     """
     opdef = op if isinstance(op, OpDef) else get_op(op)
     out = kwargs.pop("out", None)
-    from .. import autograd
+    from .. import autograd, profiler
 
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    # skip timing under trace: block_until_ready is a no-op on tracers, so
+    # the "duration" would be trace-construction overhead, not execution
+    timing = profiler.aggregate_active() and not any(
+        isinstance(d, jax.core.Tracer) for d in datas)
+    if timing:
+        import time as _time
+
+        t0 = _time.perf_counter()
     if autograd.is_recording() and opdef.differentiable:
         result = autograd._record_op(opdef, inputs, datas, kwargs)
     else:
         result = opdef.fn(*datas, **kwargs)
         result = _wrap_result(result, inputs)
+    if timing:
+        jax.block_until_ready([r._data for r in
+                               (result if isinstance(result, (list, tuple))
+                                else [result]) if isinstance(r, NDArray)])
+        profiler.record_op(opdef.name, _time.perf_counter() - t0)
     if out is not None:
         if isinstance(result, (list, tuple)):
             for o, r in zip(out if isinstance(out, (list, tuple)) else [out], result):

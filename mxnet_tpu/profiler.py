@@ -21,6 +21,35 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
 _config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
 _state = {"running": False, "dir": None}
 
+# ---------------------------------------------------------------------------
+# Aggregate per-op statistics (reference MXAggregateProfileStatsPrint /
+# src/profiler/aggregate_stats.cc — TBV). The engine hook becomes a timing
+# wrapper at the eager dispatch choke point (ndarray.invoke): active only
+# while the profiler runs with aggregate_stats=True, because accurate
+# per-op timing must block on the async dispatch (NaiveEngine-style).
+# ---------------------------------------------------------------------------
+
+_agg: dict = {}
+
+
+def aggregate_active() -> bool:
+    return _state["running"] and bool(_config.get("aggregate_stats"))
+
+
+def record_op(name: str, seconds: float) -> None:
+    ent = _agg.get(name)
+    if ent is None:
+        _agg[name] = [1, seconds, seconds, seconds]
+    else:
+        ent[0] += 1
+        ent[1] += seconds
+        ent[2] = min(ent[2], seconds)
+        ent[3] = max(ent[3], seconds)
+
+
+def reset_stats() -> None:
+    _agg.clear()
+
 
 def set_config(**kwargs):
     """profile_{all,symbolic,imperative,memory,api}=..., filename=... —
@@ -68,7 +97,28 @@ def dump(finished=True, profile_process="worker"):
 
 
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    return f"profiler trace dir: {_state.get('dir')}"
+    """Aggregate per-op stats table (reference `profiler.dumps()` /
+    MXAggregateProfileStatsPrint analog) + the trace dir pointer."""
+    lines = [f"profiler trace dir: {_state.get('dir')}"]
+    if _agg:
+        key_idx = {"total": 1, "count": 0, "min": 2, "max": 3,
+                   "avg": None}.get(sort_by, 1)
+        items = list(_agg.items())
+        if key_idx is None:
+            items.sort(key=lambda kv: kv[1][1] / kv[1][0], reverse=not ascending)
+        else:
+            items.sort(key=lambda kv: kv[1][key_idx], reverse=not ascending)
+        lines.append("")
+        lines.append("Profile Statistics (eager op dispatch):")
+        lines.append(f"{'Name':<32}{'Count':>8}{'Total(ms)':>12}"
+                     f"{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}")
+        for name, (cnt, tot, mn, mx) in items:
+            lines.append(f"{name:<32}{cnt:>8}{tot * 1e3:>12.3f}"
+                         f"{mn * 1e3:>10.3f}{mx * 1e3:>10.3f}"
+                         f"{tot / cnt * 1e3:>10.3f}")
+    if reset:
+        reset_stats()
+    return "\n".join(lines)
 
 
 @contextlib.contextmanager
