@@ -1,25 +1,212 @@
-"""INT8 quantization shim (reference contrib/quantization.py — TBV).
+"""INT8 quantization (reference ``python/mxnet/contrib/quantization.py`` —
+TBV).
 
-The reference's INT8 path targets MKLDNN/TensorRT; TPU v5 has no INT8
-inference path exposed through XLA, so calibration/quantization raise with
-guidance (bf16 via mx.amp is the TPU reduced-precision path). API surface
-kept for import parity.
+``quantize_net`` is the Gluon API (reference 1.6+): calibrate a trained
+HybridBlock's activation ranges, then swap Dense children for int8 twins
+that quantize the input, run the MXU int8 op (ops/quantization.py:
+``quantized_fully_connected``, int32 accumulation), and dequantize the
+result. Unmatched layers stay f32 — the reference likewise quantizes a
+subset of ops and stitches (de)quantize nodes around them.
+
+``quantize_model`` (the raw-Symbol API) is intentionally routed to
+quantize_net; ``quantize_graph`` remains unsupported (no partition IR).
 """
 from __future__ import annotations
 
+import numpy as np
+
 __all__ = ["quantize_model", "quantize_net", "quantize_graph"]
 
-_MSG = ("INT8 quantization is not available in the TPU build; use "
-        "mx.amp (bfloat16) for reduced-precision inference/training")
+
+def _collect_ranges(net, calib_data, num_calib_batches=None):
+    """Naive calibration: run forwards, record per-block input min/max."""
+    import jax
+
+    from .. import autograd
+    from ..gluon import nn
+    from ..ndarray import NDArray
+
+    ranges = {}
+    installed = []  # (block, hook) pairs for removal
+
+    def make_hook(blk):
+        def pre_hook(b, inputs):
+            x = inputs[0]
+            if isinstance(x, NDArray) and not isinstance(x._data,
+                                                         jax.core.Tracer):
+                a = x.asnumpy()
+                lo, hi = float(a.min()), float(a.max())
+                old = ranges.get(b.name)
+                if old is None:
+                    ranges[b.name] = [lo, hi]
+                else:
+                    old[0] = min(old[0], lo)
+                    old[1] = max(old[1], hi)
+        return pre_hook
+
+    def walk(b):
+        if isinstance(b, nn.Dense):
+            h = make_hook(b)
+            b.register_forward_pre_hook(h)
+            installed.append((b, h))
+        for c in b._children.values():
+            walk(c)
+
+    walk(net)
+    try:
+        with autograd.pause():
+            n = 0
+            for batch in calib_data:
+                xs = batch.data if hasattr(batch, "data") else [batch]
+                net(*(xs if isinstance(xs, (list, tuple)) else [xs]))
+                n += 1
+                if num_calib_batches is not None and n >= num_calib_batches:
+                    break
+    finally:
+        for b, h in installed:
+            b._forward_pre_hooks.remove(h)
+    return ranges
 
 
-def quantize_model(*a, **kw):
-    raise NotImplementedError(_MSG)
+class _QuantizedDense:
+    """Callable twin of a calibrated Dense: int8 in/weights, int32 accum.
+
+    ``in_range=None`` (calib_mode='none') quantizes the input against its
+    runtime min/max each call — the reference's online mode.
+    """
+
+    def __init__(self, dense, in_range):
+        from ..ndarray import array
+
+        w = dense.weight.data().asnumpy()
+        self._w_max = float(np.abs(w).max()) or 1.0
+        scale = 127.0 / self._w_max
+        self._wq = array(np.clip(np.round(w * scale), -127, 127)
+                         .astype(np.int8))
+        self._bias = (dense.bias.data()
+                      if getattr(dense, "bias", None) is not None
+                      and dense.bias._data is not None else None)
+        self._in_range = in_range
+        self.name = dense.name
+
+    def __call__(self, x):
+        from ..ndarray.ndarray import invoke_fn
+        from ..ops.registry import get_op
+
+        rng = self._in_range
+
+        def pure(xd, wq, *maybe_bias):
+            import jax.numpy as jnp
+
+            if rng is not None:
+                qx, mn_d, mx_d = get_op("_contrib_quantize_v2").fn(
+                    xd, min_calib_range=rng[0], max_calib_range=rng[1])
+            else:  # online min/max
+                qx, mn_d, mx_d = get_op("_contrib_quantize_v2").fn(xd)
+            mn_w = jnp.float32(-self._w_max).reshape(1)
+            mx_w = jnp.float32(self._w_max).reshape(1)
+            acc, mn_o, mx_o = get_op("_contrib_quantized_fully_connected").fn(
+                qx, wq, None, mn_d, mx_d, mn_w, mx_w, no_bias=True)
+            out = get_op("_contrib_dequantize").fn(acc, mn_o, mx_o)
+            if maybe_bias:
+                out = out + maybe_bias[0]
+            return out
+
+        ins = [x, self._wq] + ([self._bias] if self._bias is not None else [])
+        return invoke_fn(pure, ins)
 
 
-def quantize_net(*a, **kw):
-    raise NotImplementedError(_MSG)
+class _CallableBlockShim:
+    """Block-like wrapper so a _QuantizedDense slots into child traversal.
+
+    Keeps the ORIGINAL Dense for everything but forward: checkpoints still
+    save/load the f32 weights (so a fresh unquantized net can load them),
+    hooks install on the original, params walk through it.
+    """
+
+    def __init__(self, q, original):
+        self._q = q
+        self._orig = original
+        self.name = q.name + "_int8"
+        self._children = {}
+        self._reg_params = original._reg_params
+        self._forward_hooks = original._forward_hooks
+        self._forward_pre_hooks = original._forward_pre_hooks
+
+    def __call__(self, x):
+        for h in self._forward_pre_hooks:
+            h(self, (x,))
+        out = self._q(x)
+        for h in self._forward_hooks:
+            h(self, (x,), out)
+        return out
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def hybridize(self, *a, **kw):
+        pass
+
+    def _iter_params(self):
+        return self._orig._iter_params()
+
+    def _cast_hook(self, dtype):
+        pass
+
+    def _collect_params_with_prefix(self, prefix=""):
+        return self._orig._collect_params_with_prefix(prefix)
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", num_calib_batches=None,
+                 exclude_layers=None, **kwargs):
+    """Quantize ``network``'s calibrated Dense layers to int8 in place and
+    return it. ``network._quantized_layers`` lists what was swapped."""
+    if quantized_dtype not in ("int8", "auto"):
+        raise ValueError(f"quantized_dtype {quantized_dtype!r} not supported")
+    if calib_mode not in ("naive", "none"):
+        raise ValueError(f"calib_mode {calib_mode!r} not supported "
+                         "(naive|none)")
+    if calib_mode == "naive":
+        if calib_data is None:
+            raise ValueError("calib_mode='naive' needs calib_data")
+        ranges = _collect_ranges(network, calib_data, num_calib_batches)
+    else:
+        ranges = {}
+    exclude = set(exclude_layers or ())
+
+    from ..gluon import nn
+
+    replaced = []
+
+    online = calib_mode == "none"
+
+    def walk(b):
+        for attr, c in list(b._children.items()):
+            if (isinstance(c, nn.Dense) and c.name not in exclude
+                    and (online or c.name in ranges)):
+                rng = None if online else tuple(ranges[c.name])
+                shim = _CallableBlockShim(_QuantizedDense(c, rng), c)
+                replaced.append(c.name)
+                b._children[attr] = shim
+            else:
+                walk(c)
+
+    walk(network)
+    network._quantized_layers = sorted(replaced)
+    return network
+
+
+def quantize_model(sym, arg_params=None, aux_params=None, **kwargs):
+    raise NotImplementedError(
+        "quantize_model operates on raw Symbols; wrap the symbol in a "
+        "SymbolBlock and use quantize_net")
 
 
 def quantize_graph(*a, **kw):
-    raise NotImplementedError(_MSG)
+    raise NotImplementedError(
+        "graph-level quantization partitioning is not supported; use "
+        "quantize_net")

@@ -62,8 +62,22 @@ class Monitor:
                 if isinstance(o._data, jax.core.Tracer):
                     # tracing (CachedOp/jit): compute the stat in-graph and
                     # emit it at every replay; gate on self.activated at
-                    # RUNTIME (trace-time gating would bake the decision in)
-                    s = self.stat_func(o)
+                    # RUNTIME (trace-time gating would bake the decision in).
+                    # NOTE: this bakes the stat + a host callback into the
+                    # compiled program for its lifetime — uninstall_gluon()
+                    # and re-hybridize to drop the overhead.
+                    try:
+                        s = self.stat_func(o)
+                    except Exception:
+                        # custom stat funcs that need concrete values
+                        # (asnumpy etc.) cannot tap inside jit — skip this
+                        # layer rather than poison the trace
+                        import warnings
+
+                        warnings.warn(
+                            f"Monitor: stat_func is not traceable; {tag} "
+                            "not monitored inside the jitted program")
+                        continue
                     val = s._data if isinstance(s, NDArray) else s
 
                     def emit(v, _tag=tag):
@@ -79,11 +93,23 @@ class Monitor:
 
         def walk(b):
             b.register_forward_hook(hook)
+            self._gluon_handles.append((b, hook))
             for c in b._children.values():
                 walk(c)
 
         walk(block)
         return block
+
+    def uninstall_gluon(self):
+        """Remove installed hooks. A net hybridized while monitored keeps
+        the baked-in taps until its CachedOp re-traces (call hybridize()
+        again to force that)."""
+        for b, h in self._gluon_handles:
+            try:
+                b._forward_hooks.remove(h)
+            except (ValueError, AttributeError):
+                pass
+        self._gluon_handles = []
 
     def tic(self):
         if self.step % self.interval == 0:

@@ -22,3 +22,4 @@ from . import control_flow  # noqa: F401
 from . import image_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
+from . import quantization  # noqa: F401

@@ -1,0 +1,175 @@
+"""INT8 quantization operators.
+
+Reference: ``src/operator/quantization/`` (quantize/quantize_v2/dequantize/
+requantize/quantized_conv/quantized_fully_connected + calibration — TBV,
+SURVEY.md §2.2 Quantization row; round 2 shipped a raise-only stub).
+
+TPU redesign: symmetric int8 with per-tensor scales. The MXU consumes int8
+operand pairs natively (XLA lowers ``lax.dot_general(preferred_element_type=
+int32)``), so quantized_conv / quantized_fc accumulate in int32 exactly like
+the reference's GPU int8 path, and the (value ↔ scale) bookkeeping rides as
+the reference's (min_range, max_range) output pair.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register
+
+__all__ = []
+
+
+def _range_scale(min_r, max_r, bits=8):
+    """Symmetric scale mapping [-m, m] → int8 (reference quantize's
+    ``MaxAbs(min_range, max_range)`` convention)."""
+    m = jnp.maximum(jnp.abs(min_r), jnp.abs(max_r))
+    return jnp.where(m > 0, 127.0 / m, 1.0)
+
+
+@register("_contrib_quantize", aliases=["quantize"], num_outputs=3,
+          differentiable=False)
+def _quantize(data, min_range, max_range, out_type="int8"):
+    """f32 → int8 against a given calibration range. Returns
+    (quantized, min_output, max_output)."""
+    scale = _range_scale(min_range.reshape(()), max_range.reshape(()))
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    m = 127.0 / scale
+    return q, -m.reshape(1), m.reshape(1)
+
+
+def _q_v2_n_out(kwargs):
+    return 3
+
+
+@register("_contrib_quantize_v2", aliases=["quantize_v2"],
+          num_outputs=_q_v2_n_out, differentiable=False)
+def _quantize_v2(data, out_type="int8", min_calib_range=None,
+                 max_calib_range=None):
+    """Like quantize, but the range comes from calibration kwargs or, when
+    absent, from the data itself (the reference's online min/max mode)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    else:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    scale = _range_scale(mn, mx)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    m = 127.0 / scale
+    return q, -m.reshape(1), m.reshape(1)
+
+
+@register("_contrib_dequantize", aliases=["dequantize"], differentiable=False)
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    """(min_range, max_range) give the real value of the integer dtype's
+    extremes — 127 for int8 inputs, 2^31-1 for the int32 accumulators the
+    quantized conv/fc ops emit."""
+    m = jnp.maximum(jnp.abs(min_range.reshape(())),
+                    jnp.abs(max_range.reshape(())))
+    qmax = 127.0 if data.dtype == jnp.int8 else 2.0 ** 31 - 1
+    return data.astype(jnp.float32) * (m / qmax)
+
+
+@register("_contrib_requantize", aliases=["requantize"], num_outputs=3,
+          differentiable=False)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, out_type="int8"):
+    """int32 accumulator → int8. min/max_range describe the int32 value
+    scale (the product scale from quantized_conv/fc)."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range.reshape(())),
+                    jnp.abs(max_range.reshape(()))) / (2.0 ** 31 - 1))
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.float32(min_calib_range)
+        mx = jnp.float32(max_calib_range)
+    else:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    scale = _range_scale(mn, mx)
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
+    m = 127.0 / scale
+    return q, -m.reshape(1), m.reshape(1)
+
+
+def _int32_range(min_a, max_a, min_b, max_b):
+    """Value range of the int32 accumulator expressed in real units —
+    the reference's quantized op (min_out, max_out) convention."""
+    ma = jnp.maximum(jnp.abs(min_a.reshape(())), jnp.abs(max_a.reshape(())))
+    mb = jnp.maximum(jnp.abs(min_b.reshape(())), jnp.abs(max_b.reshape(())))
+    m = ma * mb / (127.0 * 127.0) * (2.0 ** 31 - 1)
+    return -m.reshape(1), m.reshape(1)
+
+
+@register("_contrib_quantized_fully_connected",
+          aliases=["quantized_fully_connected"], num_outputs=3,
+          differentiable=False)
+def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
+                  max_weight, min_bias=None, max_bias=None, num_hidden=1,
+                  no_bias=False, flatten=True):
+    """int8 data (B, K) × int8 weight (N, K) → int32 (B, N) on the MXU."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    if bias is not None and not no_bias:
+        # bias arrives int8 with its own scale; rescale to the accumulator's
+        # (data_scale * weight_scale) grid, matching the reference
+        sd = _range_scale(min_data.reshape(()), max_data.reshape(()))
+        sw = _range_scale(min_weight.reshape(()), max_weight.reshape(()))
+        sb = _range_scale(min_bias.reshape(()), max_bias.reshape(()))
+        bias_acc = jnp.round(bias.astype(jnp.float32) / sb * (sd * sw))
+        acc = acc + bias_acc.astype(jnp.int32)
+    mn, mx = _int32_range(min_data, max_data, min_weight, max_weight)
+    return acc, mn, mx
+
+
+@register("_contrib_quantized_conv", aliases=["quantized_conv"],
+          num_outputs=3, differentiable=False)
+def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                    max_weight, min_bias=None, max_bias=None, kernel=(1, 1),
+                    stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_filter=1,
+                    num_group=1, no_bias=False, layout="NCHW"):
+    """int8 NCHW conv with int32 accumulation."""
+    sh = stride if isinstance(stride, (tuple, list)) else (stride, stride)
+    ph = pad if isinstance(pad, (tuple, list)) else (pad, pad)
+    dh = dilate if isinstance(dilate, (tuple, list)) else (dilate, dilate)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8), tuple(sh),
+        [(ph[0], ph[0]), (ph[1], ph[1])], rhs_dilation=tuple(dh),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+    if bias is not None and not no_bias:
+        sd = _range_scale(min_data.reshape(()), max_data.reshape(()))
+        sw = _range_scale(min_weight.reshape(()), max_weight.reshape(()))
+        sb = _range_scale(min_bias.reshape(()), max_bias.reshape(()))
+        bias_acc = jnp.round(bias.astype(jnp.float32) / sb * (sd * sw))
+        acc = acc + bias_acc.astype(jnp.int32).reshape(1, -1, 1, 1)
+    mn, mx = _int32_range(min_data, max_data, min_weight, max_weight)
+    return acc, mn, mx
+
+
+@register("_contrib_quantized_pooling", aliases=["quantized_pooling"],
+          num_outputs=3, differentiable=False)
+def _quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                       stride=None, pad=(0, 0), pool_type="max",
+                       global_pool=False):
+    from .nn import _pooling
+
+    out = _pooling(data.astype(jnp.float32), kernel=kernel, stride=stride,
+                   pad=pad, pool_type=pool_type, global_pool=global_pool)
+    if pool_type == "max":
+        out = out.astype(data.dtype)  # max pooling is exact on the int grid
+    else:
+        out = jnp.round(out).astype(data.dtype)
+    return out, min_data, max_data
+
+
+@register("_contrib_quantized_flatten", aliases=["quantized_flatten"],
+          num_outputs=3, differentiable=False)
+def _quantized_flatten(data, min_data, max_data):
+    return data.reshape(data.shape[0], -1), min_data, max_data

@@ -64,6 +64,23 @@ def main():
         kv.pull("g", out=out)
         np.testing.assert_allclose(out.asnumpy(), np.full(shape, 11.0 * n))
 
+    # --- 2-bit compressed fused collective: packed uint8 over the wire,
+    # exact sum of the ±threshold codes with error feedback
+    if mode == "dist_sync":
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.init("c", nd.zeros(shape))
+        kv.push("c", nd.array(np.full(shape, 1.0, np.float32)))
+        kv.barrier()
+        kv.pull("c", out=out)
+        # each worker's residual 1.0 quantizes to +0.5; aggregate = 0.5*n
+        np.testing.assert_allclose(out.asnumpy(), np.full(shape, 0.5 * n))
+        # residual 0.5 left on every worker: a zero push still drains it
+        kv.push("c", nd.array(np.zeros(shape, np.float32)))
+        kv.barrier()
+        kv.pull("c", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.full(shape, 0.5 * n))
+        kv.set_gradient_compression({"type": "none"})
+
     # --- optimizer-on-store: w -= lr * sum(grads), identically on all ranks
     kv2_key = "opt_w"
     kv.init(kv2_key, nd.array(np.ones(shape, np.float32)))

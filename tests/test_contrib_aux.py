@@ -154,3 +154,17 @@ def test_profiler_api(tmp_path):
     import os
 
     assert d and os.path.isdir(d)
+
+
+def test_runtime_env_registry():
+    """Systematic MXNET_*/DMLC_* env surface (SURVEY §5.6; r2 partial)."""
+    evs = mx.runtime.env_list()
+    names = {e.name for e in evs}
+    # every env var the code reads must be declared in the registry
+    for expected in ("MXNET_SEED", "MXNET_ENGINE_TYPE", "MX_SYNC",
+                     "MXNET_MATMUL_PRECISION", "MXNET_ATTENTION_IMPL",
+                     "DMLC_PS_ROOT_URI", "DMLC_NUM_WORKER", "MXNET_PS_ADDR"):
+        assert expected in names, expected
+    for e in evs:
+        assert e.description
+    assert "RNG seed" in mx.runtime.env_doc("MXNET_SEED")

@@ -163,3 +163,74 @@ def test_unary_and_clip_misc():
     cp = mnp.copy(x)
     assert cp is not x
     onp.testing.assert_allclose(cp.asnumpy(), x.asnumpy())
+
+
+# ----------------------------------------------------------------- mx.npx
+
+def test_npx_explicit_surface():
+    """npx defines the reference signatures explicitly (r2: alias delegate)."""
+    import mxnet_tpu.numpy_extension as npx
+
+    x = mnp.array([[-1.0, 2.0], [3.0, -4.0]])
+    onp.testing.assert_allclose(npx.relu(x).asnumpy(), [[0, 2], [3, 0]])
+    s = npx.softmax(x, axis=-1)
+    onp.testing.assert_allclose(s.asnumpy().sum(-1), [1, 1], rtol=1e-6)
+    onp.testing.assert_allclose(npx.log_softmax(x).asnumpy(),
+                                onp.log(s.asnumpy()), rtol=1e-5)
+    g = npx.gelu(x)
+    assert g.shape == x.shape
+    oh = npx.one_hot(mnp.array([0, 1], dtype="int32"), depth=3)
+    onp.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 1, 0]])
+    tk = npx.topk(mnp.array([[1.0, 3.0, 2.0]]), k=2, ret_typ="indices")
+    onp.testing.assert_allclose(tk.asnumpy(), [[1, 2]])
+    pk = npx.pick(mnp.array([[1.0, 2.0], [3.0, 4.0]]),
+                  mnp.array([1.0, 0.0]))
+    onp.testing.assert_allclose(pk.asnumpy(), [2, 3])
+    sh = npx.shape_array(x)
+    onp.testing.assert_array_equal(sh.asnumpy(), [2, 2])
+
+
+def test_npx_masked_softmax():
+    import mxnet_tpu.numpy_extension as npx
+
+    x = mnp.array([[1.0, 2.0, 3.0]])
+    m = mnp.array([[1, 1, 0]], dtype="int32")
+    s = npx.masked_softmax(x, m).asnumpy()
+    assert s[0, 2] == 0.0
+    onp.testing.assert_allclose(s[0, :2].sum(), 1.0, rtol=1e-6)
+    ls = npx.masked_log_softmax(x, m).asnumpy()
+    onp.testing.assert_allclose(onp.exp(ls[0, :2]).sum(), 1.0, rtol=1e-5)
+    assert ls[0, 2] < -1e29
+
+
+def test_npx_nn_layers():
+    import mxnet_tpu.numpy_extension as npx
+
+    rng = onp.random.RandomState(0)
+    x = mnp.array(rng.rand(2, 3).astype(onp.float32))
+    w = mnp.array(rng.rand(4, 3).astype(onp.float32))
+    b = mnp.array(onp.zeros(4, onp.float32))
+    out = npx.fully_connected(x, w, b, num_hidden=4)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                x.asnumpy() @ w.asnumpy().T, rtol=1e-5)
+    img = mnp.array(rng.rand(1, 2, 6, 6).astype(onp.float32))
+    cw = mnp.array(rng.rand(3, 2, 3, 3).astype(onp.float32))
+    conv = npx.convolution(img, cw, kernel=(3, 3), num_filter=3, pad=(1, 1))
+    assert conv.shape == (1, 3, 6, 6)
+    pool = npx.pooling(img, kernel=(2, 2), stride=(2, 2))
+    assert pool.shape == (1, 2, 3, 3)
+    gamma = mnp.array(onp.ones(2, onp.float32))
+    beta = mnp.array(onp.zeros(2, onp.float32))
+    ln = npx.layer_norm(mnp.array(rng.rand(2, 2).astype(onp.float32)),
+                        gamma, beta)
+    assert ln.shape == (2, 2)
+
+
+def test_npx_set_np_roundtrip():
+    import mxnet_tpu.numpy_extension as npx
+
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array() and npx.is_np_shape()
+    npx.reset_np()
+    assert not npx.is_np_array()
