@@ -16,6 +16,10 @@ import time
 
 import numpy as np
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
 import mxnet_tpu as mx
 from mxnet_tpu import parallel as par
 from mxnet_tpu.models import bert_base, bert_large, bert_tiny, bert_sharding_rules

@@ -5,6 +5,10 @@ import argparse
 
 import numpy as np
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..", "..")))
 import mxnet_tpu as mx
 
 

@@ -87,6 +87,8 @@ class _QuantizedDense:
                       if getattr(dense, "bias", None) is not None
                       and dense.bias._data is not None else None)
         self._in_range = in_range
+        self._flatten = getattr(dense, "_flatten", True)
+        self._act = getattr(dense, "_act", None)
         self.name = dense.name
 
     def __call__(self, x):
@@ -106,10 +108,13 @@ class _QuantizedDense:
             mn_w = jnp.float32(-self._w_max).reshape(1)
             mx_w = jnp.float32(self._w_max).reshape(1)
             acc, mn_o, mx_o = get_op("_contrib_quantized_fully_connected").fn(
-                qx, wq, None, mn_d, mx_d, mn_w, mx_w, no_bias=True)
+                qx, wq, None, mn_d, mx_d, mn_w, mx_w, no_bias=True,
+                flatten=self._flatten)
             out = get_op("_contrib_dequantize").fn(acc, mn_o, mx_o)
             if maybe_bias:
                 out = out + maybe_bias[0]
+            if self._act is not None:
+                out = get_op("Activation").fn(out, act_type=self._act)
             return out
 
         ins = [x, self._wq] + ([self._bias] if self._bias is not None else [])
@@ -184,10 +189,13 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
 
     online = calib_mode == "none"
 
+    def quantizable(c):
+        return (isinstance(c, nn.Dense) and c.name not in exclude
+                and (online or c.name in ranges))
+
     def walk(b):
         for attr, c in list(b._children.items()):
-            if (isinstance(c, nn.Dense) and c.name not in exclude
-                    and (online or c.name in ranges)):
+            if quantizable(c):
                 rng = None if online else tuple(ranges[c.name])
                 shim = _CallableBlockShim(_QuantizedDense(c, rng), c)
                 replaced.append(c.name)
@@ -195,7 +203,20 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
             else:
                 walk(c)
 
+    if quantizable(network):  # the net IS a single Dense: return its shim
+        rng = None if online else tuple(ranges[network.name])
+        shim = _CallableBlockShim(_QuantizedDense(network, rng), network)
+        shim._quantized_layers = [network.name]
+        return shim
     walk(network)
+    if not replaced:
+        import warnings
+
+        warnings.warn(
+            "quantize_net: no Dense layer was quantized — with "
+            "calib_mode='naive' this usually means calibration saw no "
+            "eager forwards (a hybridized net replays its compiled trace; "
+            "call quantize_net BEFORE hybridize, or use calib_mode='none')")
     network._quantized_layers = sorted(replaced)
     return network
 

@@ -79,7 +79,11 @@ def test_im2rec_multilabel_and_passthrough(tmp_path):
 
 
 def test_native_decode_throughput(tmp_path):
-    """The C++ pipeline must beat a conservative CPU floor (≥100 img/s)."""
+    """Pin the TRAINING-shape decode rate (224x224 from 256px sources, the
+    bench configuration) — round 2's 96px/100-img/s floor would have passed
+    on pure-PIL and pinned nothing. The uint8 wire path must clear a floor
+    that PIL decode demonstrably cannot reach on this hardware (~1 core:
+    PIL ≈ 120 img/s at this shape, native ≈ 900+)."""
     import im2rec
 
     import mxnet_tpu as mx
@@ -89,22 +93,27 @@ def test_native_decode_throughput(tmp_path):
         pytest.skip("native io library not built")
     root = str(tmp_path / "images")
     os.makedirs(root)
-    _make_tree(root, classes=2, per_class=32, size=128)
+    _make_tree(root, classes=2, per_class=48, size=256)
     prefix = str(tmp_path / "tp")
     assert im2rec.main([prefix, root, "--list", "--recursive"]) == 0
     assert im2rec.main([prefix, root]) == 0
 
     it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
-                               data_shape=(3, 96, 96), batch_size=16,
-                               resize=112, rand_crop=True, rand_mirror=True,
-                               preprocess_threads=4)
+                               data_shape=(3, 224, 224), batch_size=32,
+                               resize=256, rand_crop=True, rand_mirror=True,
+                               preprocess_threads=2, dtype="uint8")
+    assert it._native is not None, "native decoder must engage for u8 path"
+    for batch in it:  # warm (first batch pays file open etc.)
+        break
     n = 0
     t0 = time.perf_counter()
-    for _ in range(2):
-        for batch in it:
+    try:
+        while True:
+            batch = next(it)
             n += batch.data[0].shape[0]
-        it.reset()
+    except StopIteration:
+        pass
     dt = time.perf_counter() - t0
-    assert n >= 128
+    assert n >= 32
     rate = n / dt
-    assert rate > 100, f"native decode too slow: {rate:.0f} img/s"
+    assert rate > 250, f"native u8 decode too slow: {rate:.0f} img/s"

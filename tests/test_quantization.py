@@ -156,3 +156,56 @@ def test_quantize_net_calib_none_and_checkpoint():
         fresh.add(nn.Dense(2, in_units=8))
     fresh.load_parameters(f)
     np.testing.assert_allclose(fresh(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_quantize_net_activation_flatten_and_root():
+    """r3 review findings: activation preserved, flatten=False supported,
+    root-Dense quantizable, silent-no-op warns."""
+    rng = np.random.RandomState(6)
+    # activation preserved
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4, activation="relu"))
+    net.initialize()
+    x = nd.array((rng.rand(4, 4) - 0.5).astype(np.float32))
+    ref = net(x).asnumpy()
+    assert (ref >= 0).all()
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    out = quantize_net(net, calib_mode="none")(x).asnumpy()
+    assert (out >= 0).all(), "activation dropped by quantization"
+
+    # flatten=False on 3D input
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4, flatten=False))
+    net2.initialize()
+    x3 = nd.array((rng.rand(2, 3, 4) - 0.5).astype(np.float32))
+    ref2 = net2(x3).asnumpy()
+    out2 = quantize_net(net2, calib_mode="none")(x3).asnumpy()
+    assert out2.shape == ref2.shape == (2, 3, 8)
+    assert np.corrcoef(out2.ravel(), ref2.ravel())[0, 1] > 0.99
+
+    # root Dense
+    root = nn.Dense(2, in_units=3)
+    root.initialize()
+    xr = nd.array(rng.rand(2, 3).astype(np.float32))
+    refr = root(xr).asnumpy()
+    q = quantize_net(root, calib_mode="none")
+    assert q._quantized_layers
+    outr = q(xr).asnumpy()
+    assert np.corrcoef(outr.ravel(), refr.ravel())[0, 1] > 0.99
+
+    # silent no-op warns (hybridized net, naive calibration)
+    import warnings as w
+
+    net3 = nn.HybridSequential()
+    with net3.name_scope():
+        net3.add(nn.Dense(4, in_units=4))
+    net3.initialize()
+    net3.hybridize()
+    net3(x)
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        quantize_net(net3, calib_data=[x], calib_mode="naive")
+    assert any("no Dense layer was quantized" in str(r.message) for r in rec)

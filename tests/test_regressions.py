@@ -86,3 +86,25 @@ def test_import_does_not_init_backend():
                        cwd=__import__('os').path.dirname(
                            __import__('os').path.dirname(__file__)))
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_example_scripts_parse():
+    """Every baseline example script must run standalone (path bootstrap:
+    the package is not installed; round-3 regression guard)."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    scripts = [
+        "example/bert/pretrain.py",
+        "example/rnn/word_lm/train.py",
+        "example/transformer/train.py",
+        "example/ssd/train.py",
+        "example/image-classification/train_imagenet.py",
+    ]
+    for s in scripts:
+        r = subprocess.run([sys.executable, os.path.join(root, s), "--help"],
+                           capture_output=True, text=True, timeout=120,
+                           cwd="/")  # cwd independence is the point
+        assert r.returncode == 0, f"{s}: {r.stderr[-500:]}"
