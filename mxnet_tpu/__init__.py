@@ -70,6 +70,11 @@ def __getattr__(name):
         "monitor": ".monitor",
         "mon": ".monitor",
         "native": ".native",
+        "viz": ".visualization",
+        "visualization": ".visualization",
+        "engine": ".engine",
+        "attribute": ".attribute",
+        "name": ".name",
     }
     if name in lazy:
         mod = importlib.import_module(lazy[name], __name__)

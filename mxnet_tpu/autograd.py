@@ -20,7 +20,7 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+__all__ = ["Function", "record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "backward", "grad", "mark_variables", "set_recording",
            "set_training"]
 
@@ -324,3 +324,62 @@ def NDArrayCls():
     from .ndarray.ndarray import NDArray
 
     return NDArray
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.Function:
+    custom forward with a hand-written backward, recorded as ONE tape node).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` (returning one gradient per NDArray
+    input, in input order). ``save_for_backward(*arrays)`` stashes tensors
+    on ``self.saved_tensors`` for the backward.
+    """
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        rec = is_recording()
+        with _Scope(False, None):  # user forward runs unrecorded
+            out = self.forward(*inputs)
+        if not rec:
+            return out
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        nd_pos = [i for i, x in enumerate(inputs) if isinstance(x, NDArray)]
+        avals = [(o.shape, o.dtype) for o in outs]
+
+        def vjp_fn(arg):
+            cts = arg if multi else (arg,)
+            with _Scope(False, None):
+                grads = self.backward(*[NDArrayCls()(jnp.asarray(c))
+                                        for c in cts])
+            grads = (list(grads) if isinstance(grads, (tuple, list))
+                     else [grads])
+            if len(grads) != len(nd_pos):
+                raise ValueError(
+                    f"{type(self).__name__}.backward returned {len(grads)} "
+                    f"gradients for {len(nd_pos)} array inputs")
+            return tuple(g._data if isinstance(g, NDArray) else g
+                         for g in grads)
+
+        node = _Node(vjp_fn, [(inputs[i]._ag_node, i) for i in nd_pos],
+                     avals, type(self).__name__, out_is_tuple=multi)
+        wrapped = []
+        for i, o in enumerate(outs):
+            w = NDArrayCls()(o._data if isinstance(o, NDArray) else o)
+            w._ag_node = (node, i)
+            wrapped.append(w)
+        return tuple(wrapped) if multi else wrapped[0]

@@ -5,7 +5,7 @@ import logging
 import time
 from collections import namedtuple
 
-__all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "module_checkpoint",
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "module_checkpoint", "ProgressBar",
            "BatchEndParam"]
 
 BatchEndParam = namedtuple("BatchEndParams",
@@ -74,3 +74,22 @@ def log_train_metric(period, auto_reset=False):
                 param.eval_metric.reset()
 
     return _callback
+
+
+class ProgressBar:
+    """Console progress bar callback (reference callback.ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.total = max(1, int(total))
+        self.length = int(length)
+
+    def __call__(self, param):
+        count = getattr(param, "nbatch", 0)
+        filled = int(round(self.length * min(count, self.total) / self.total))
+        bar = "=" * filled + "-" * (self.length - filled)
+        import sys
+
+        sys.stdout.write(f"\r[{bar}] {count}/{self.total}")
+        sys.stdout.flush()
+        if count >= self.total:
+            sys.stdout.write("\n")

@@ -1,0 +1,36 @@
+"""``mx.engine`` — execution-engine controls.
+
+Reference: ``python/mxnet/engine.py`` (``bulk`` scope batching engine pushes,
+``set_bulk_size`` — TBV, SURVEY.md §2.1 Engine). TPU mapping: XLA's async
+dispatch already pipelines eager ops and ``hybridize`` is the real bulking
+mechanism, so ``bulk`` is a compatibility scope — it suspends the MX_SYNC
+debug-sync behavior for its duration (the closest analog of batching engine
+pushes) and restores it after.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = 15
+
+
+def set_bulk_size(size):
+    """Returns the previous bulk size (reference contract)."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size=None):
+    """Scope under which eager ops dispatch without per-op sync."""
+    from .ndarray import ndarray as _nd
+
+    prev = _nd._MX_SYNC
+    _nd._MX_SYNC = False
+    try:
+        yield
+    finally:
+        _nd._MX_SYNC = prev

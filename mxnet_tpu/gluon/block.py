@@ -496,23 +496,102 @@ def _new_rng():
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a block from a Symbol graph + inputs (reference SymbolBlock).
-
-    Implemented after the symbolic frontend (mx.sym) — see mxnet_tpu/symbol.
+    """Run a Symbol graph as a Gluon block (reference SymbolBlock): free
+    graph variables that aren't inputs become Parameters, so an exported
+    ``symbol.json + .params`` pair reloads as a trainable/hybridizable
+    block — the deployment-reload path (reference ``SymbolBlock.imports``).
     """
 
     def __init__(self, outputs, inputs, params=None):
-        super().__init__()
-        self._outputs = outputs
-        self._inputs = inputs
+        super().__init__(prefix="", params=params)
+        if isinstance(outputs, (list, tuple)):
+            if len(outputs) == 1:
+                outputs = outputs[0]
+            else:
+                from ..symbol import Group
+
+                outputs = Group(outputs)
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._outputs_sym = outputs
+        self._input_names = [i.name if hasattr(i, "name") else str(i)
+                             for i in inputs]
+        self._arg_names = [n for n in outputs.list_arguments()
+                           if n not in self._input_names]
+        self._aux_names = outputs.list_auxiliary_states()
+        for n in self._arg_names:
+            p = self.params.get(n, shape=(0,), allow_deferred_init=True)
+            self._reg_params[n] = p
+        for n in self._aux_names:
+            p = self.params.get(n, shape=(0,), allow_deferred_init=True,
+                                grad_req="null")
+            self._reg_params[n] = p
+        self._graph_fns = {}  # train flag -> (arg_names, aux_names, fn, _)
+
+    def _direct_param_kwargs(self):
+        return {}  # graph params are resolved by name in hybrid_forward
 
     def hybrid_forward(self, F, *args, **kwargs):
-        from ..symbol import Symbol
+        from .. import autograd as ag
+        from ..executor import _build_graph_fn
+        from ..ndarray import NDArray
+        from ..ndarray.ndarray import invoke_fn
 
-        sym = self._outputs
-        arg_map = {i.name if hasattr(i, "name") else str(i): a
-                   for i, a in zip(self._inputs, args)}
-        return sym.eval_with(arg_map)
+        train = ag.is_training()
+        entry = self._graph_fns.get(train)
+        if entry is None:
+            entry = self._graph_fns[train] = _build_graph_fn(
+                self._outputs_sym, train=train)
+        arg_names, aux_names, fn, _has_aux = entry
+        by_name = dict(zip(self._input_names, args))
+        ins = []
+        for n in arg_names:
+            v = by_name[n] if n in by_name else self.params.get(n).data()
+            ins.append(v if isinstance(v, NDArray) else NDArray(v))
+        aux_nds = [self.params.get(n).data() for n in aux_names]
+        n_args = len(ins)
+
+        # route through invoke_fn so eager calls land on the autograd tape
+        # (fine-tuning an imported checkpoint with record()/backward works)
+        def pure(*vals):
+            outs, new_aux = fn(list(vals[:n_args]), list(vals[n_args:]))
+            return tuple(outs) + tuple(new_aux[n] for n in aux_names)
+
+        result = invoke_fn(pure, ins + aux_nds)
+        result = result if isinstance(result, tuple) else (result,)
+        n_out = len(result) - len(aux_names)
+        outs, aux_new = result[:n_out], result[n_out:]
+        with ag.pause():
+            for nd_, new in zip(aux_nds, aux_new):
+                nd_._set_data(new._data)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Load an exported ``-symbol.json`` (+ ``.params``) into a block
+        (reference SymbolBlock.imports; serving analog of MXPredCreate)."""
+        from ..symbol import Variable, load as sym_load
+
+        sym = sym_load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [Variable(n) for n in input_names]
+        blk = SymbolBlock(sym, inputs)
+        if param_file:
+            from ..ndarray import load as nd_load
+
+            loaded = nd_load(param_file)
+            flat = {}
+            for k, v in loaded.items():  # accept arg:/aux: checkpoint keys
+                flat[k.split(":", 1)[1] if ":" in k else k] = v
+            for n, p in blk._reg_params.items():
+                if n in flat:
+                    p.shape = flat[n].shape
+                    p.initialize(ctx=ctx)
+                    p.set_data(flat[n])
+                else:
+                    raise KeyError(f"parameter {n} missing in {param_file}")
+        return blk
 
 
 def load_stablehlo(path):

@@ -11,7 +11,7 @@ from typing import Dict, Tuple
 
 from .ndarray import NDArray, load as nd_load, save as nd_save
 
-__all__ = ["save_checkpoint", "load_checkpoint", "load_params"]
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params", "FeedForward"]
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params: Dict[str, NDArray],
@@ -42,3 +42,74 @@ def load_checkpoint(prefix, epoch):
     symbol = sym_mod.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Legacy pre-Module training API (reference mx.model.FeedForward —
+    deprecated upstream in favor of Module; kept as a thin adapter over
+    Module for script parity)."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, optimizer="sgd",
+                 initializer=None, arg_params=None, aux_params=None,
+                 learning_rate=0.01, **kwargs):
+        from .module import Module
+
+        self.symbol = symbol
+        self._ctx = ctx
+        self._num_epoch = num_epoch
+        self._optimizer = optimizer
+        self._opt_kwargs = dict(kwargs)
+        self._opt_kwargs["learning_rate"] = learning_rate
+        self._initializer = initializer
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self._module = Module(symbol, context=ctx)
+        self._fitted = False
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            batch_end_callback=None, epoch_end_callback=None, logger=None,
+            **kwargs):
+        from .io import NDArrayIter
+
+        del logger  # accepted for signature parity; Module logs via logging
+        train = X if hasattr(X, "provide_data") else NDArrayIter(X, y, batch_size=128)
+        self._module.fit(
+            train, eval_data=eval_data, eval_metric=eval_metric,
+            optimizer=self._optimizer, optimizer_params=self._opt_kwargs,
+            initializer=self._initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            num_epoch=self._num_epoch or 1,
+            batch_end_callback=batch_end_callback,
+            epoch_end_callback=epoch_end_callback, **kwargs)
+        self.arg_params, self.aux_params = self._module.get_params()
+        self._fitted = True
+        return self
+
+    def predict(self, X, num_batch=None):
+        from .io import NDArrayIter
+
+        it = X if hasattr(X, "provide_data") else NDArrayIter(X, batch_size=128)
+        outs = self._module.predict(it, num_batch=num_batch)
+        return outs.asnumpy() if hasattr(outs, "asnumpy") else outs
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None):
+        from .io import NDArrayIter
+
+        it = X if hasattr(X, "provide_data") else NDArrayIter(X, y, batch_size=128)
+        return self._module.score(it, eval_metric, num_batch=num_batch)
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch if epoch is not None else 0,
+                        self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        return FeedForward(sym, ctx=ctx, arg_params=arg, aux_params=aux,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=1, **kwargs):
+        m = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+        return m.fit(X, y)

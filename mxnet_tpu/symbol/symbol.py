@@ -88,7 +88,10 @@ class Symbol:
         return self._name
 
     def attr(self, key):
-        return self._attrs.get(key)
+        v = self._attrs.get(key)
+        if v is None:  # AttrScope-injected attrs are dunder-keyed
+            v = self._attrs.get(f"__{key}__")
+        return v
 
     def list_attr(self):
         return {k: str(v) for k, v in self._attrs.items()}
@@ -294,10 +297,36 @@ class Symbol:
 # ---------------------------------------------------------------------------
 
 
+def _scoped_name(name: Optional[str], hint: str) -> str:
+    """Resolve a node name through mx.name scopes (NameManager/Prefix),
+    falling back to the module-global counter."""
+    if name:
+        return name
+    from .. import name as name_mod
+
+    mgr = name_mod._STATE.current
+    if mgr is not None:
+        return mgr.get(None, hint)
+    return _NAMES.get(hint)
+
+
+def _scope_attrs(attrs: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge mx.attribute.AttrScope attrs in, dunder-keyed so the executor
+    never passes them as op kwargs (read back via Symbol.attr)."""
+    from .. import attribute
+
+    cur = attribute.current()
+    if not cur:
+        return attrs
+    merged = {f"__{k}__": v for k, v in cur.items()}
+    merged.update(attrs)
+    return merged
+
+
 def _apply_op(op_name: str, sym_inputs: List[Symbol], attrs: Dict[str, Any],
               name: Optional[str] = None) -> Symbol:
-    node = Symbol(op_name, name or _NAMES.get(op_name.lower().lstrip("_")),
-                  sym_inputs, attrs)
+    node = Symbol(op_name, _scoped_name(name, op_name.lower().lstrip("_")),
+                  sym_inputs, _scope_attrs(attrs))
     return node
 
 
@@ -318,7 +347,7 @@ def _make_symbol_op(op_name: str):
         for k in list(kwargs):
             if isinstance(kwargs[k], Symbol):
                 by_name[k] = kwargs.pop(k)
-        node_name = name or _NAMES.get(op_name.lower().lstrip("_"))
+        node_name = _scoped_name(name, op_name.lower().lstrip("_"))
         full_inputs: List[Symbol] = list(inputs)
         no_bias = str(kwargs.get("no_bias", False)).lower() == "true"
         if len(inputs) < len(input_names) and (inputs or by_name):
@@ -350,7 +379,7 @@ def Variable(name: str, shape=None, dtype=None, init=None, **attrs) -> Symbol:
         a["__dtype__"] = str(dtype)
     if init is not None:
         a["__init__"] = init
-    return Symbol(None, name, [], a)
+    return Symbol(None, name, [], _scope_attrs(a))
 
 
 var = Variable

@@ -13,7 +13,8 @@ from .context import Context, cpu, current_context
 from .ndarray import NDArray, array
 from .base import get_env
 
-__all__ = ["default_context", "assert_almost_equal", "almost_equal", "same",
+__all__ = ["list_gpus", "list_tpus",
+           "default_context", "assert_almost_equal", "almost_equal", "same",
            "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
            "check_consistency"]
 
@@ -134,3 +135,18 @@ def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",), rtol=None,
                 at = atol if atol is not None else (1e-2 if dt in ("float16", "bfloat16") else 1e-5)
                 assert_almost_equal(out.astype(np.float32), ref.astype(np.float32),
                                     rtol=rt, atol=at, names=(f"{ctx}/{dt}", "ref"))
+
+
+def list_gpus():
+    """Reference helper: visible GPU ordinals (always [] on the TPU build)."""
+    return []
+
+
+def list_tpus():
+    import jax
+
+    try:
+        return [d.id for d in jax.devices()
+                if d.platform in ("tpu", "axon")]
+    except RuntimeError:
+        return []

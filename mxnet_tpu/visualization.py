@@ -1,0 +1,87 @@
+"""``mx.viz`` — network visualization (reference
+``python/mxnet/visualization.py`` — TBV: print_summary + plot_network).
+
+``print_summary`` is fully supported (text). ``plot_network`` returns a
+graphviz Digraph when the optional ``graphviz`` package exists, else raises
+ImportError with guidance (graphviz is not in the TPU image).
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _sym_nodes(symbol):
+    conf = json.loads(symbol.tojson())
+    return conf["nodes"], conf.get("heads", [])
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Layer-table summary of a Symbol graph (reference mx.viz.print_summary)."""
+    nodes, _ = _sym_nodes(symbol)
+    shapes = {}
+    if shape is not None:
+        arg_shapes, out_shapes, _aux = symbol.infer_shape(**shape)
+        arg_names = symbol.list_arguments()
+        shapes = dict(zip(arg_names, arg_shapes or []))
+    positions = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def line(fields):
+        row = ""
+        for f, p in zip(fields, positions):
+            row = (row + str(f))[:p].ljust(p)
+        return row
+
+    out = ["_" * line_length, line(header), "=" * line_length]
+    total = 0
+    for n in nodes:
+        if n["op"] == "null":
+            name = n["name"]
+            cnt = 0
+            shp = shapes.get(name, "")
+            if name in shapes:
+                cnt = 1
+                for s in shapes[name]:
+                    cnt *= s
+            if any(name.endswith(sfx) for sfx in
+                   ("weight", "bias", "gamma", "beta", "mean", "var")):
+                total += cnt
+                out.append(line([f"{name} (Parameter)", shp, cnt, ""]))
+            continue
+        prevs = ",".join(nodes[i[0]]["name"] for i in n["inputs"][:2])
+        out.append(line([f"{n['name']} ({n['op']})", "", 0, prevs]))
+    out.append("=" * line_length)
+    out.append(f"Total params: {total}")
+    out.append("_" * line_length)
+    print("\n".join(out))
+    return "\n".join(out)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz digraph of the Symbol graph (reference plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network needs the optional 'graphviz' package (not in the "
+            "TPU image); use mx.viz.print_summary for a text summary") from e
+    nodes, _ = _sym_nodes(symbol)
+    dot = Digraph(name=title, format=save_format)
+    for i, n in enumerate(nodes):
+        if n["op"] == "null" and hide_weights and n["name"].rsplit("_", 1)[-1] in (
+                "weight", "bias", "gamma", "beta", "mean", "var"):
+            continue
+        dot.node(str(i), f"{n['name']}\n{n['op']}" if n["op"] != "null"
+                 else n["name"])
+    for i, n in enumerate(nodes):
+        for (src, _o, _v) in n.get("inputs", []):
+            s = nodes[src]
+            if s["op"] == "null" and hide_weights and \
+                    s["name"].rsplit("_", 1)[-1] in (
+                        "weight", "bias", "gamma", "beta", "mean", "var"):
+                continue
+            dot.edge(str(src), str(i))
+    return dot

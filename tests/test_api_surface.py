@@ -1,0 +1,223 @@
+"""Round-3 API-surface closure: autograd.Function, SymbolBlock(+imports),
+mx.viz, mx.engine, mx.attribute, mx.name, FeedForward, ProgressBar
+(reference python/mxnet package surface — SURVEY.md §2.3)."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_autograd_function_custom_vjp():
+    class Double(mx.autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * 2
+
+        def backward(self, dy):
+            return dy * 2
+
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = Double()(x)
+        z = (y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0, 16.0])
+
+
+def test_autograd_function_multi_io():
+    class AddMul(mx.autograd.Function):
+        def forward(self, a, b):
+            return a + b, a * b
+
+        def backward(self, ds, dp):
+            # d(a+b)=ds ; d(a*b): need saved a,b — use saved_tensors
+            a, b = self.saved_tensors
+            return ds + dp * b, ds + dp * a
+
+        def __call__(self, a, b):
+            self.save_for_backward(a, b)
+            return super().__call__(a, b)
+
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([3.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with mx.autograd.record():
+        s, p = AddMul()(a, b)
+        out = s + 2 * p
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [1 + 2 * 3.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [1 + 2 * 2.0])
+
+
+def test_symbolblock_imports_roundtrip(tmp_path):
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("fc_weight")
+    b = mx.sym.Variable("fc_bias")
+    out = mx.sym.FullyConnected(data, w, b, num_hidden=4, name="fc")
+    out = mx.sym.Activation(out, act_type="relu", name="act")
+    rng = np.random.RandomState(0)
+    arg = {"fc_weight": nd.array(rng.rand(4, 3).astype(np.float32)),
+           "fc_bias": nd.array(np.zeros(4, np.float32))}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 3, out, arg, {})
+
+    blk = mx.gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                       prefix + "-0003.params")
+    x = nd.array(rng.rand(2, 3).astype(np.float32))
+    ref = np.maximum(x.asnumpy() @ arg["fc_weight"].asnumpy().T, 0)
+    np.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=1e-5)
+    blk.hybridize()
+    np.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=1e-5)
+    # trainable: params registered
+    assert set(blk._reg_params) == {"fc_weight", "fc_bias"}
+    # a params file missing one graph parameter must be rejected
+    mx.model.save_checkpoint(str(tmp_path / "bad"), 0, out,
+                             {"fc_weight": arg["fc_weight"]}, {})
+    with pytest.raises(KeyError):
+        mx.gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                     str(tmp_path / "bad-0000.params"))
+
+
+def test_viz_print_summary(capsys):
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, mx.sym.Variable("fc1_weight"),
+                                mx.sym.Variable("fc1_bias"), num_hidden=8,
+                                name="fc1")
+    s = mx.viz.print_summary(out, shape={"data": (1, 16)})
+    assert "fc1" in s and "Total params" in s
+    assert "136" in s  # 16*8 + 8
+
+
+def test_engine_bulk_scope():
+    from mxnet_tpu.ndarray import ndarray as nd_mod
+
+    prev = nd_mod._MX_SYNC
+    nd_mod._MX_SYNC = True
+    try:
+        with mx.engine.bulk(16):
+            assert nd_mod._MX_SYNC is False
+            x = nd.ones((2,)) + 1
+        assert nd_mod._MX_SYNC is True
+        np.testing.assert_allclose(x.asnumpy(), [2, 2])
+    finally:
+        nd_mod._MX_SYNC = prev
+    assert mx.engine.set_bulk_size(10) >= 0
+
+
+def test_attribute_and_name_scopes():
+    with mx.attribute.AttrScope(ctx_group="dev1", lr_mult="2"):
+        assert mx.attribute.current()["ctx_group"] == "dev1"
+        with mx.attribute.AttrScope(lr_mult="3"):
+            merged = mx.attribute.current()
+            assert merged == {"ctx_group": "dev1", "lr_mult": "3"}
+    assert mx.attribute.current() == {}
+    with pytest.raises(ValueError):
+        mx.attribute.AttrScope(bad=1)
+
+    nm = mx.name.NameManager()
+    assert nm.get(None, "conv") == "conv0"
+    assert nm.get(None, "conv") == "conv1"
+    assert nm.get("explicit", "conv") == "explicit"
+    with mx.name.Prefix("net_") as p:
+        assert p.get(None, "fc") == "net_fc0"
+
+
+def test_feedforward_legacy_api(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype(np.float32)
+    yv = (x.sum(1) > 4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    from mxnet_tpu.io import NDArrayIter
+
+    it = NDArrayIter(x, yv, batch_size=16, label_name="softmax_label")
+    ff = mx.model.FeedForward(net, num_epoch=2, learning_rate=0.5)
+    ff.fit(it)
+    assert ff.arg_params and "fc_weight" in ff.arg_params
+    preds = ff.predict(NDArrayIter(x, yv, batch_size=16,
+                                   label_name="softmax_label"))
+    assert preds.shape[0] == 64
+    prefix = str(tmp_path / "ff")
+    ff.save(prefix, 1)
+    again = mx.model.FeedForward.load(prefix, 1)
+    np.testing.assert_allclose(
+        again.arg_params["fc_weight"].asnumpy(),
+        ff.arg_params["fc_weight"].asnumpy())
+
+
+def test_progress_bar():
+    import sys
+
+    pb = mx.callback.ProgressBar(total=4, length=8)
+
+    class P:
+        nbatch = 2
+
+    saved = sys.stdout
+    sys.stdout = io.StringIO()
+    try:
+        pb(P())
+        out = sys.stdout.getvalue()
+    finally:
+        sys.stdout = saved
+    assert "2/4" in out
+
+
+def test_list_gpus_tpus():
+    assert mx.test_utils.list_gpus() == []
+    assert isinstance(mx.test_utils.list_tpus(), list)
+
+
+def test_symbolblock_eval_mode_and_training():
+    """r3 review: imported graphs must respect train/predict mode (Dropout
+    off, BN stats frozen at inference) and be trainable eagerly."""
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, mx.sym.Variable("w"), num_hidden=4,
+                                no_bias=True, name="fc")
+    out = mx.sym.Dropout(out, p=0.5, name="drop")
+    rng = np.random.RandomState(0)
+    w = nd.array(rng.rand(4, 3).astype(np.float32))
+    blk = mx.gluon.SymbolBlock(out, [mx.sym.Variable("data")])
+    blk._reg_params["w"].shape = (4, 3)
+    blk._reg_params["w"].initialize()
+    blk._reg_params["w"].set_data(w)
+    x = nd.array(rng.rand(2, 3).astype(np.float32))
+    # inference: dropout must be identity (deterministic)
+    y1 = blk(x).asnumpy()
+    y2 = blk(x).asnumpy()
+    np.testing.assert_allclose(y1, y2)
+    np.testing.assert_allclose(y1, x.asnumpy() @ w.asnumpy().T, rtol=1e-5)
+    # eager training: gradients flow to the imported parameter
+    p = blk._reg_params["w"]
+    p.grad_req = "write"
+    p.data().attach_grad()
+    with mx.autograd.record():
+        loss = (blk(x) ** 2).sum()
+    loss.backward()
+    g = p.data().grad.asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_attr_scope_reaches_symbols():
+    with mx.attribute.AttrScope(ctx_group="dev1", lr_mult="2"):
+        s = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=2,
+                                  name="fca")
+    assert s.attr("ctx_group") == "dev1"
+    assert s.attr("lr_mult") == "2"
+    # scope attrs must NOT leak into op kwargs at execution
+    exe = s.simple_bind(d=(1, 3))
+    outs = exe.forward(d=nd.ones((1, 3)))
+
+
+def test_name_scope_reaches_symbols():
+    with mx.name.Prefix("net_"):
+        s = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
+    assert s.name.startswith("net_")
