@@ -104,8 +104,8 @@ def _make_rec_dataset(path, n=256, size=256):
     rec.close()
 
 
-def bench_resnet_piped(platform):
-    """fp32 ResNet step fed by the real pipeline, assembled the TPU-first way:
+def bench_resnet_piped(platform, compute_dtype=None):
+    """ResNet step fed by the real pipeline, assembled the TPU-first way:
     native JPEG decode → raw uint8 over the host→device link (4x smaller) →
     normalize fused into the jitted step → PrefetchingIter overlaps the whole
     host side with device compute. Returns ips + a time breakdown."""
@@ -139,7 +139,8 @@ def bench_resnet_piped(platform):
             return (x.astype(jnp.float32) - mean) / std
         return x
 
-    net, loss_fn, trainer = _resnet_trainer(mesh, preprocess=preprocess)
+    net, loss_fn, trainer = _resnet_trainer(mesh, compute_dtype=compute_dtype,
+                                            preprocess=preprocess)
     native = raw._native is not None
     it = mx.io.PrefetchingIter(raw, prefetch=3)
 
@@ -339,6 +340,11 @@ def main():
         extra["resnet50_piped_breakdown"] = piped
     except Exception as e:
         extra["resnet50_piped_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        extra["resnet50_piped_bf16_ips"] = bench_resnet_piped(
+            platform, compute_dtype="bfloat16")["ips"]
+    except Exception as e:
+        extra["resnet50_piped_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         peak = _measure_matmul_peak()
         bert = bench_bert(platform)

@@ -74,6 +74,7 @@ def __getattr__(name):
         "visualization": ".visualization",
         "engine": ".engine",
         "attribute": ".attribute",
+        "subgraph": ".subgraph",
         "name": ".name",
     }
     if name in lazy:

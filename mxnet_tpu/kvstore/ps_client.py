@@ -8,6 +8,7 @@ ps-lite resend path has.
 """
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
@@ -16,9 +17,9 @@ import numpy as np
 
 from ..base import MXNetError
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
-                        OP_PUSH, OP_PUSH_SPARSE, OP_SET_OPT, OP_SHUTDOWN,
-                        _pack_array, _pack_sparse, _recv_msg, _send_msg,
-                        _unpack_array)
+                        OP_PUSH, OP_PUSH_SEQ, OP_PUSH_SPARSE, OP_SET_OPT,
+                        OP_SHUTDOWN, _pack_array, _pack_sparse, _recv_msg,
+                        _send_msg, _unpack_array)
 
 
 class PSClient:
@@ -30,6 +31,12 @@ class PSClient:
         self._retry_interval = retry_interval
         self._lock = threading.Lock()
         self._sock = None
+        # exactly-once pushes: (client_id, seq) dedups server-side, so a
+        # retried PUSH whose reply was lost is NOT applied twice (stronger
+        # than the reference ps-lite's at-least-once resend)
+        self._client_id = int.from_bytes(os.urandom(8), "little")
+        self._push_seq = 0
+        self._seq_lock = threading.Lock()  # _lock is held inside _rpc
         self._connect()
 
     def _connect(self):
@@ -73,11 +80,22 @@ class PSClient:
         self._rpc(OP_INIT, key, _pack_array(np.ascontiguousarray(value)))
 
     def push(self, key: str, grad: np.ndarray, compressor=None):
+        import struct
+
         if compressor is not None:
             payload = compressor.pack_wire(key, np.ascontiguousarray(grad))
         else:
             payload = _pack_array(np.ascontiguousarray(grad))
-        self._rpc(OP_PUSH, key, payload)
+        with self._seq_lock:
+            self._push_seq += 1
+            seq = self._push_seq
+        _, _, reply = self._rpc(
+            OP_PUSH_SEQ, key,
+            struct.pack("<QQ", self._client_id, seq) + payload)
+        if bytes(reply[:1]) != b"\x00":
+            raise MXNetError(
+                f"push rejected for key {key!r} (uninitialized key or "
+                "malformed frame)")
 
     def pull(self, key: str) -> np.ndarray:
         _, _, payload = self._rpc(OP_PULL, key)

@@ -32,7 +32,7 @@ import numpy as np
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
 
 (OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
- OP_PUSH_SPARSE, OP_PULL_SPARSE) = range(8)
+ OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ) = range(9)
 
 
 def _pack_array(arr: np.ndarray) -> bytes:
@@ -122,7 +122,12 @@ class PSServer:
         self._locks: Dict[str, threading.Lock] = {}
         self._updater = None
         self._global_lock = threading.Lock()
+        from collections import OrderedDict
+
         self._num_workers = num_workers
+        # (client_id, key) -> last applied seq; LRU-bounded so client churn
+        # (each process draws a fresh id) cannot grow the map forever
+        self._applied_seq: "OrderedDict" = OrderedDict()
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -201,6 +206,27 @@ class PSServer:
                         else:
                             self._weights[key] = self._weights[key] + grad
                     _send_msg(conn, OP_PUSH, key, b"\x00")
+                elif opcode == OP_PUSH_SEQ:
+                    # exactly-once push: payload prefixed with (client_id,
+                    # seq); a retried frame whose seq was already applied is
+                    # acked without re-applying — fixes the at-least-once
+                    # double-apply the plain PUSH retry path has
+                    if key not in self._weights or len(payload) < 16:
+                        _send_msg(conn, OP_PUSH_SEQ, key, b"\x01")
+                        continue
+                    cid, seq = struct.unpack_from("<QQ", payload, 0)
+                    grad = _unpack_array(payload[16:])
+                    with self._locks[key]:
+                        if self._applied_seq.get((cid, key), -1) < seq:
+                            if self._updater is not None:
+                                self._apply(key, grad, self._weights[key])
+                            else:
+                                self._weights[key] = self._weights[key] + grad
+                            self._applied_seq[(cid, key)] = seq
+                            self._applied_seq.move_to_end((cid, key))
+                            while len(self._applied_seq) > 65536:
+                                self._applied_seq.popitem(last=False)
+                    _send_msg(conn, OP_PUSH_SEQ, key, b"\x00")
                 elif opcode == OP_PULL:
                     with self._locks.get(key, self._global_lock):
                         arr = self._weights[key]
@@ -292,6 +318,24 @@ class PSServer:
             name, kwargs = spec["name"], spec["kwargs"]
         opt = opt_create(name, **kwargs)
         self._updater = Updater(opt)
+        # Pre-warm the XLA executables for every known weight shape with a
+        # THROWAWAY updater, in the background (warming inside this RPC
+        # handler would stall SET_OPT past the client timeout): the first
+        # real push must not eat multi-second compiles inside a client's
+        # RPC window (the cause of the retry-double-apply flake this fixes
+        # together with OP_PUSH_SEQ).
+
+        def _warm(shapes=[(k, w.copy()) for k, w in self._weights.items()]):
+            try:
+                from ..ndarray import array
+
+                warm = Updater(opt_create(name, **kwargs))
+                for k, w in shapes:
+                    warm(k, array(np.zeros_like(w)), array(w))
+            except Exception:
+                pass  # warmup is best-effort
+
+        threading.Thread(target=_warm, daemon=True).start()
 
     def _apply(self, key, grad, weight_np):
         """Run the fused optimizer update on host numpy via the framework ops

@@ -386,3 +386,60 @@ def _multi_mp_adamw_update(*arrays, **kwargs):
             clip_gradient=kwargs.get("clip_gradient", -1.0))
         return nw32.astype(w.dtype), m, v, nw32
     return _multi(step, 5, 4, arrays, kwargs)
+
+
+@register("adamax_update", num_outputs=3)
+def _adamax_update(weight, grad, mean, inf_norm, lr=0.002, beta1=0.9,
+                   beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, t=1):
+    """AdaMax (reference optimizer_op.* adamax — infinity-norm Adam)."""
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    mean = beta1 * mean + (1 - beta1) * g
+    inf_norm = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    lr_t = lr / (1 - beta1 ** t)
+    return weight - lr_t * mean / (inf_norm + epsilon), mean, inf_norm
+
+
+@register("nadam_update", num_outputs=3)
+def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, schedule_decay=0.004, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, t=1, m_schedule=1.0):
+    """Nesterov Adam (reference optimizer.Nadam semantics)."""
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    momentum_t = beta1 * (1 - 0.5 * 0.96 ** (t * schedule_decay))
+    momentum_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    m_sched = m_schedule * momentum_t
+    m_sched_next = m_sched * momentum_t1
+    g_prime = g / (1 - m_sched)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_prime = mean / (1 - m_sched_next)
+    v_prime = var / (1 - beta2 ** t)
+    m_bar = (1 - momentum_t) * g_prime + momentum_t1 * m_prime
+    return weight - lr * m_bar / (jnp.sqrt(v_prime) + epsilon), mean, var
+
+
+@register("sgld_update", differentiable=False)
+def _sgld_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """Stochastic Gradient Langevin Dynamics: SGD step + N(0, lr) noise
+    (reference optimizer.SGLD)."""
+    import jax
+
+    from ..random import next_key
+
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    noise = jax.random.normal(next_key(), weight.shape, weight.dtype) \
+        * jnp.sqrt(jnp.asarray(lr, weight.dtype))
+    return weight - 0.5 * lr * g + noise
+
+
+@register("dcasgd_update", num_outputs=3)
+def _dcasgd_update(weight, grad, mom, prev_weight, lr=0.01, momentum=0.0,
+                   lamda=0.04, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Delay-compensated async SGD (reference optimizer.DCASGD): the delayed
+    gradient is corrected with lamda * g² * (w - w_prev)."""
+    g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
+    comp = g + lamda * jnp.square(g) * (weight - prev_weight)
+    mom = momentum * mom - lr * comp
+    return weight + mom, mom, weight

@@ -435,3 +435,89 @@ def _states_nd(s):
 
 def get_updater(optimizer: Optimizer) -> Updater:
     return Updater(optimizer)
+
+
+@register
+class AdaMax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        t = self._index_update_count[index]
+        mean, inf_norm = state
+        invoke("adamax_update", [weight, grad, mean, inf_norm],
+               {"lr": lr, "beta1": self.beta1, "beta2": self.beta2, "wd": wd,
+                "rescale_grad": self.rescale_grad, "t": t,
+                "clip_gradient": self.clip_gradient,
+                "out": (weight, mean, inf_norm)})
+
+
+Adamax = AdaMax  # reference exposes both spellings
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        t = self._index_update_count[index]
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mean, var = state
+        invoke("nadam_update", [weight, grad, mean, var],
+               {"lr": lr, "beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "wd": wd, "t": t,
+                "schedule_decay": self.schedule_decay,
+                "m_schedule": self.m_schedule,
+                "rescale_grad": self.rescale_grad,
+                "clip_gradient": self.clip_gradient,
+                "out": (weight, mean, var)})
+        self.m_schedule *= momentum_t
+
+
+@register
+class SGLD(Optimizer):
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        invoke("sgld_update", [weight, grad],
+               {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                "clip_gradient": self.clip_gradient, "out": weight})
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr, wd = self._common(index)
+        mom, prev = state
+        invoke("dcasgd_update", [weight, grad, mom, prev],
+               {"lr": lr, "momentum": self.momentum, "lamda": self.lamda,
+                "wd": wd, "rescale_grad": self.rescale_grad,
+                "clip_gradient": self.clip_gradient,
+                "out": (weight, mom, prev)})

@@ -253,6 +253,17 @@ class Symbol:
         return Executor(self, ctx=ctx, grad_req=grad_req, args=args,
                         args_grad=args_grad, aux_states=aux_states)
 
+    def optimize_for(self, backend, args=None, aux=None, **kwargs):
+        """Apply a registered graph pass (reference Symbol.optimize_for /
+        subgraph backend API): returns the rewritten Symbol; updated params
+        are available on ._optimized_args/._optimized_aux."""
+        from ..subgraph import optimize_symbol
+
+        new_sym, new_args, new_aux = optimize_symbol(self, backend, args, aux)
+        new_sym._optimized_args = new_args
+        new_sym._optimized_aux = new_aux
+        return new_sym
+
     def eval(self, ctx=None, **kwargs):
         exe = self.simple_bind(ctx=ctx, grad_req="null",
                                **{k: v.shape for k, v in kwargs.items()})

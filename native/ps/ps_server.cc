@@ -33,7 +33,8 @@
 namespace {
 
 enum Op : uint8_t { INIT = 0, PUSH = 1, PULL = 2, SET_OPT = 3, BARRIER = 4,
-                    SHUTDOWN = 5, PUSH_SPARSE = 6, PULL_SPARSE = 7 };
+                    SHUTDOWN = 5, PUSH_SPARSE = 6, PULL_SPARSE = 7,
+                    PUSH_SEQ = 8 };
 
 struct Entry {
   std::vector<uint32_t> shape;
@@ -165,6 +166,36 @@ class Server {
           out = PackArray(*e);
         }
         SendMsg(conn, PULL, key, out);
+      } else if (op == PUSH_SEQ) {
+        // exactly-once push: payload = u64 client_id | u64 seq | array;
+        // a retried frame whose seq was already applied is acked without
+        // re-applying (see python twin)
+        Entry* e = GetEntry(key, false);
+        if (!e || payload_len < 16) {
+          SendMsg(conn, PUSH_SEQ, key, std::string("\x01", 1));
+          continue;
+        }
+        uint64_t cid, seq;
+        memcpy(&cid, payload, 8);
+        memcpy(&seq, payload + 8, 8);
+        {
+          std::lock_guard<std::mutex> lk(e->mu);
+          auto k = std::make_pair(cid, key);
+          bool fresh;
+          {
+            std::lock_guard<std::mutex> sl(seq_mu_);
+            auto it = applied_seq_.find(k);
+            fresh = (it == applied_seq_.end() || it->second < seq);
+            if (fresh) {
+              applied_seq_[k] = seq;
+              // bound against client churn (fresh random ids per process)
+              if (applied_seq_.size() > 65536)
+                applied_seq_.erase(applied_seq_.begin());
+            }
+          }
+          if (fresh) ApplyPush(e, payload + 16, payload_len - 16);
+        }
+        SendMsg(conn, PUSH_SEQ, key, std::string("\x00", 1));
       } else if (op == PUSH_SPARSE) {
         // payload: [int32 indices array][f32 rows array] — only touched
         // rows cross the wire (reference sparse PSKV push)
@@ -472,6 +503,8 @@ class Server {
   std::condition_variable barrier_cv_;
   int barrier_count_ = 0;
   uint64_t barrier_gen_ = 0;
+  std::mutex seq_mu_;
+  std::map<std::pair<uint64_t, std::string>, uint64_t> applied_seq_;
 };
 
 }  // namespace

@@ -221,3 +221,73 @@ def test_name_scope_reaches_symbols():
     with mx.name.Prefix("net_"):
         s = mx.sym.Activation(mx.sym.Variable("x"), act_type="relu")
     assert s.name.startswith("net_")
+
+
+def test_new_optimizers_converge():
+    """AdaMax / Nadam / SGLD / DCASGD minimize a quadratic."""
+    rng = np.random.RandomState(0)
+    target = rng.rand(6).astype(np.float32)
+    mx.random.seed(0)
+    for name, lr, tol in (("adamax", 0.1, 0.25), ("nadam", 0.1, 0.25),
+                          ("dcasgd", 0.1, 0.25)):
+        opt = mx.optimizer.create(name, learning_rate=lr)
+        w = nd.array(np.zeros(6, np.float32))
+        state = opt.create_state(0, w)
+        for _ in range(300):
+            g = nd.array(2 * (w.asnumpy() - target))
+            opt.update(0, w, g, state)
+        err = np.abs(w.asnumpy() - target).max()
+        assert err < tol, (name, err)
+    # SGLD samples the posterior (iterates have O(1) variance by design) —
+    # the TIME-AVERAGE of the chain must concentrate on the optimum
+    opt = mx.optimizer.create("sgld", learning_rate=0.05)
+    w = nd.array(np.zeros(6, np.float32))
+    samples = []
+    for i in range(1200):
+        g = nd.array(2 * (w.asnumpy() - target))
+        opt.update(0, w, g, None)
+        if i >= 200:
+            samples.append(w.asnumpy().copy())
+    err = np.abs(np.mean(samples, axis=0) - target).max()
+    assert err < 0.3, ("sgld time-average", err)
+    # updater state roundtrip with the new optimizers
+    upd = mx.optimizer.Updater(mx.optimizer.create("adamax"))
+    w = nd.array(np.ones(3, np.float32))
+    upd(0, nd.array(np.ones(3, np.float32)), w)
+    blob = upd.get_states()
+    upd2 = mx.optimizer.Updater(mx.optimizer.create("adamax"))
+    upd2.set_states(blob)
+
+
+def test_subgraph_fold_bn_pass():
+    """Subgraph/pass API (reference subgraph_property analog): folding
+    inference BatchNorm into Convolution preserves outputs exactly."""
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                              no_bias=True, name="conv0")
+    bn = mx.sym.BatchNorm(conv, fix_gamma=False, eps=1e-3, name="bn0")
+    out = mx.sym.Activation(bn, act_type="relu", name="relu0")
+
+    args = {"conv0_weight": nd.array(rng.rand(4, 3, 3, 3).astype(np.float32)),
+            "bn0_gamma": nd.array(rng.rand(4).astype(np.float32) + 0.5),
+            "bn0_beta": nd.array(rng.rand(4).astype(np.float32))}
+    aux = {"bn0_moving_mean": nd.array(rng.rand(4).astype(np.float32)),
+           "bn0_moving_var": nd.array(rng.rand(4).astype(np.float32) + 0.5)}
+    x = nd.array(rng.rand(2, 3, 8, 8).astype(np.float32))
+
+    exe = out.bind(args={**args, "data": x}, aux_states=aux)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+
+    folded = out.optimize_for("fold_bn", args, aux)
+    new_args, new_aux = folded._optimized_args, folded._optimized_aux
+    assert folded._folded_bn == ["bn0"]
+    assert "bn0_gamma" not in new_args and not new_aux
+    assert "conv0_bias" in new_args
+    exe2 = folded.bind(args={**new_args, "data": x}, aux_states=new_aux)
+    got = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # backend aliases route to the standard rewrite set
+    assert "fold_bn" in mx.subgraph.list_passes()
+    folded2 = out.optimize_for("MKLDNN", args, aux)
+    assert folded2._folded_bn == ["bn0"]

@@ -249,3 +249,51 @@ def test_python_ps_sparse_rejects_bad_requests():
         np.testing.assert_allclose(cli.pull("w"), np.zeros((4, 2)))
     finally:
         srv.stop()
+
+
+def test_push_exactly_once_dedup():
+    """A retried PUSH (lost reply) must not double-apply: both servers dedup
+    on (client_id, seq) — the round-3 fix for the at-least-once flake."""
+    import struct
+
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import (OP_PUSH_SEQ, PSServer,
+                                             _pack_array, _recv_msg,
+                                             _send_msg)
+
+    def check(cli_factory):
+        cli = cli_factory()
+        cli.init("w", np.zeros((2,), np.float32))
+        g = np.ones((2,), np.float32)
+        # normal pushes apply once each
+        cli.push("w", g)
+        cli.push("w", g)
+        np.testing.assert_allclose(cli.pull("w"), [2, 2])
+        # simulate a retry: resend the LAST frame verbatim (same seq)
+        payload = (struct.pack("<QQ", cli._client_id, cli._push_seq)
+                   + _pack_array(g))
+        with cli._lock:
+            _send_msg(cli._sock, OP_PUSH_SEQ, "w", payload)
+            _recv_msg(cli._sock)
+        np.testing.assert_allclose(cli.pull("w"), [2, 2])  # NOT 3
+        return cli
+
+    srv = PSServer(port=0, num_workers=1)
+    srv.start()
+    try:
+        check(lambda: PSClient("127.0.0.1", srv.port))
+    finally:
+        srv.stop()
+
+    binary = ps_server_binary()
+    if binary is None:
+        return
+    proc = subprocess.Popen([binary, "--port", "0"], stdout=subprocess.PIPE,
+                            text=True)
+    try:
+        port = int(proc.stdout.readline().strip().rsplit(":", 1)[1])
+        cli = check(lambda: PSClient("127.0.0.1", port))
+        cli.shutdown()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
