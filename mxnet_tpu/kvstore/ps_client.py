@@ -35,8 +35,7 @@ class PSClient:
         # retried PUSH whose reply was lost is NOT applied twice (stronger
         # than the reference ps-lite's at-least-once resend)
         self._client_id = int.from_bytes(os.urandom(8), "little")
-        self._push_seq = 0
-        self._seq_lock = threading.Lock()  # _lock is held inside _rpc
+        self._push_seq = 0  # guarded by _lock (allocated with the send)
         self._connect()
 
     def _connect(self):
@@ -49,32 +48,38 @@ class PSClient:
                                               timeout=self._timeout)
 
     def _rpc(self, opcode, key="", payload=b"", timeout=None, retries=None):
-        retries = self._retries if retries is None else retries
         with self._lock:
-            last_err = None
-            for attempt in range(retries):
-                try:
-                    if self._sock is None:
-                        self._connect()
-                    if timeout is not None:
-                        self._sock.settimeout(timeout)
-                    _send_msg(self._sock, opcode, key, payload)
-                    reply = _recv_msg(self._sock)
-                    if timeout is not None:
-                        self._sock.settimeout(self._timeout)
-                    return reply
-                except (ConnectionError, OSError) as e:  # incl. timeouts
-                    last_err = e
-                    if self._sock is not None:  # reconnect itself may fail
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                        self._sock = None
-                    time.sleep(self._retry_interval * (attempt + 1))
-            raise MXNetError(
-                f"PS rpc op={opcode} key={key!r} failed after "
-                f"{retries} attempts: {last_err}")
+            return self._rpc_locked(opcode, key, payload, timeout, retries)
+
+    def _rpc_locked(self, opcode, key="", payload=b"", timeout=None,
+                    retries=None):
+        """Caller must hold self._lock (push() pairs seq allocation with the
+        send inside one critical section)."""
+        retries = self._retries if retries is None else retries
+        last_err = None
+        for attempt in range(retries):
+            try:
+                if self._sock is None:
+                    self._connect()
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
+                _send_msg(self._sock, opcode, key, payload)
+                reply = _recv_msg(self._sock)
+                if timeout is not None:
+                    self._sock.settimeout(self._timeout)
+                return reply
+            except (ConnectionError, OSError) as e:  # incl. timeouts
+                last_err = e
+                if self._sock is not None:  # reconnect itself may fail
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                time.sleep(self._retry_interval * (attempt + 1))
+        raise MXNetError(
+            f"PS rpc op={opcode} key={key!r} failed after "
+            f"{retries} attempts: {last_err}")
 
     def init(self, key: str, value: np.ndarray):
         self._rpc(OP_INIT, key, _pack_array(np.ascontiguousarray(value)))
@@ -86,12 +91,15 @@ class PSClient:
             payload = compressor.pack_wire(key, np.ascontiguousarray(grad))
         else:
             payload = _pack_array(np.ascontiguousarray(grad))
-        with self._seq_lock:
+        # seq allocation and send are one critical section: out-of-order
+        # same-key sends would make the server discard the lower seq as a
+        # "duplicate" (silent gradient loss)
+        with self._lock:
             self._push_seq += 1
             seq = self._push_seq
-        _, _, reply = self._rpc(
-            OP_PUSH_SEQ, key,
-            struct.pack("<QQ", self._client_id, seq) + payload)
+            _, _, reply = self._rpc_locked(
+                OP_PUSH_SEQ, key,
+                struct.pack("<QQ", self._client_id, seq) + payload)
         if bytes(reply[:1]) != b"\x00":
             raise MXNetError(
                 f"push rejected for key {key!r} (uninitialized key or "

@@ -126,8 +126,11 @@ class PSServer:
 
         self._num_workers = num_workers
         # (client_id, key) -> last applied seq; LRU-bounded so client churn
-        # (each process draws a fresh id) cannot grow the map forever
+        # (each process draws a fresh id) cannot grow the map forever.
+        # Own lock: handlers for DIFFERENT keys share this dict, so the
+        # per-key weight locks are not enough (mirrors the C++ seq_mu_).
         self._applied_seq: "OrderedDict" = OrderedDict()
+        self._seq_lock = threading.Lock()
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -217,15 +220,20 @@ class PSServer:
                     cid, seq = struct.unpack_from("<QQ", payload, 0)
                     grad = _unpack_array(payload[16:])
                     with self._locks[key]:
-                        if self._applied_seq.get((cid, key), -1) < seq:
+                        with self._seq_lock:
+                            fresh = self._applied_seq.get((cid, key), -1) < seq
+                        if fresh:
                             if self._updater is not None:
                                 self._apply(key, grad, self._weights[key])
                             else:
                                 self._weights[key] = self._weights[key] + grad
-                            self._applied_seq[(cid, key)] = seq
-                            self._applied_seq.move_to_end((cid, key))
-                            while len(self._applied_seq) > 65536:
-                                self._applied_seq.popitem(last=False)
+                            # record only AFTER a successful apply, so a
+                            # failed apply doesn't burn the seq
+                            with self._seq_lock:
+                                self._applied_seq[(cid, key)] = seq
+                                self._applied_seq.move_to_end((cid, key))
+                                while len(self._applied_seq) > 65536:
+                                    self._applied_seq.popitem(last=False)
                     _send_msg(conn, OP_PUSH_SEQ, key, b"\x00")
                 elif opcode == OP_PULL:
                     with self._locks.get(key, self._global_lock):
@@ -325,7 +333,10 @@ class PSServer:
         # RPC window (the cause of the retry-double-apply flake this fixes
         # together with OP_PUSH_SEQ).
 
-        def _warm(shapes=[(k, w.copy()) for k, w in self._weights.items()]):
+        with self._global_lock:  # OP_INIT mutates _weights concurrently
+            snapshot = [(k, w.copy()) for k, w in self._weights.items()]
+
+        def _warm(shapes=snapshot):
             try:
                 from ..ndarray import array
 

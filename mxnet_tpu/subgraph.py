@@ -141,8 +141,6 @@ def fold_bn(symbol, arg_params, aux_params):
         memo[id(node)] = result
         return result
 
-    new_sym = rebuild(symbol._base() if symbol._index is None else symbol)
-    if symbol._index is not None:
-        new_sym = rebuild(symbol)
+    new_sym = rebuild(symbol)  # rebuild() dispatches on _index itself
     new_sym._folded_bn = folded
     return new_sym, new_args, new_aux

@@ -10,6 +10,12 @@ from .symbol import _make_symbol_op
 
 
 def __getattr__(name):
+    if name in ("contrib", "image", "random"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
     if has_op(name):
         fn = _make_symbol_op(name)
         globals()[name] = fn

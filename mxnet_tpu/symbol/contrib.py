@@ -1,0 +1,16 @@
+"""``mx.sym.contrib`` — contrib ops in the symbolic frontend (reference
+python/mxnet/symbol/contrib.py; SSD symbol code calls
+``sym.contrib.MultiBoxPrior`` etc.)."""
+from __future__ import annotations
+
+from ..ops import has_op
+from .symbol import _make_symbol_op
+
+
+def __getattr__(name: str):
+    for cand in (f"_contrib_{name}", name):
+        if has_op(cand):
+            fn = _make_symbol_op(cand)
+            globals()[name] = fn
+            return fn
+    raise AttributeError(f"no contrib symbol operator {name!r}")

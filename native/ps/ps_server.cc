@@ -23,6 +23,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <list>
 #include <map>
 #include <mutex>
 #include <sstream>
@@ -178,24 +179,26 @@ class Server {
         uint64_t cid, seq;
         memcpy(&cid, payload, 8);
         memcpy(&seq, payload + 8, 8);
+        bool ok = true;
         {
           std::lock_guard<std::mutex> lk(e->mu);
           auto k = std::make_pair(cid, key);
           bool fresh;
           {
             std::lock_guard<std::mutex> sl(seq_mu_);
-            auto it = applied_seq_.find(k);
-            fresh = (it == applied_seq_.end() || it->second < seq);
-            if (fresh) {
-              applied_seq_[k] = seq;
-              // bound against client churn (fresh random ids per process)
-              if (applied_seq_.size() > 65536)
-                applied_seq_.erase(applied_seq_.begin());
+            fresh = SeqIsFresh(k, seq);
+          }
+          if (fresh) {
+            // record only AFTER a successful apply: a rejected frame must
+            // neither burn the seq nor ack success
+            ok = ApplyPush(e, payload + 16, payload_len - 16);
+            if (ok) {
+              std::lock_guard<std::mutex> sl(seq_mu_);
+              SeqRecord(k, seq);
             }
           }
-          if (fresh) ApplyPush(e, payload + 16, payload_len - 16);
         }
-        SendMsg(conn, PUSH_SEQ, key, std::string("\x00", 1));
+        SendMsg(conn, PUSH_SEQ, key, std::string(ok ? "\x00" : "\x01", 1));
       } else if (op == PUSH_SPARSE) {
         // payload: [int32 indices array][f32 rows array] — only touched
         // rows cross the wire (reference sparse PSKV push)
@@ -309,24 +312,26 @@ class Server {
     return true;
   }
 
-  void ApplyPush(Entry* e, const uint8_t* p, size_t n) {
+  bool ApplyPush(Entry* e, const uint8_t* p, size_t n) {
     std::vector<uint32_t> shape;
     uint8_t dtype_code = 0;
     size_t off = ParseHeader(p, n, &shape, &dtype_code);
-    if (off == 0) return;
+    if (off == 0) return false;
     std::vector<float> expanded;
     const float* g;
     size_t count;
     if (dtype_code == 16) {  // 2-bit compressed gradient
-      if (!Decode2Bit(p + off, n - off, e->weight.size(), &expanded)) return;
+      if (!Decode2Bit(p + off, n - off, e->weight.size(), &expanded))
+        return false;
       g = expanded.data();
       count = expanded.size();
     } else {
       g = reinterpret_cast<const float*>(p + off);
       count = (n - off) / 4;
     }
-    if (count != e->weight.size()) return;
+    if (count != e->weight.size()) return false;
     ApplyGrad(e, g, count);
+    return true;
   }
 
   // Optimizer application on a full-size dense gradient (shared by the
@@ -503,8 +508,34 @@ class Server {
   std::condition_variable barrier_cv_;
   int barrier_count_ = 0;
   uint64_t barrier_gen_ = 0;
+  // exactly-once dedup state, LRU-bounded (seq_mu_ guards all of it).
+  // A plain ordered-map eviction would remove the smallest client_id —
+  // possibly the entry just inserted — so recency order is kept explicitly.
+  using SeqKey = std::pair<uint64_t, std::string>;
   std::mutex seq_mu_;
-  std::map<std::pair<uint64_t, std::string>, uint64_t> applied_seq_;
+  std::list<SeqKey> seq_lru_;  // front = oldest
+  std::map<SeqKey, std::pair<uint64_t, std::list<SeqKey>::iterator>>
+      applied_seq_;
+
+  bool SeqIsFresh(const SeqKey& k, uint64_t seq) {
+    auto it = applied_seq_.find(k);
+    return it == applied_seq_.end() || it->second.first < seq;
+  }
+
+  void SeqRecord(const SeqKey& k, uint64_t seq) {
+    auto it = applied_seq_.find(k);
+    if (it != applied_seq_.end()) {
+      it->second.first = seq;
+      seq_lru_.splice(seq_lru_.end(), seq_lru_, it->second.second);
+      return;
+    }
+    seq_lru_.push_back(k);
+    applied_seq_[k] = {seq, std::prev(seq_lru_.end())};
+    if (applied_seq_.size() > 65536) {
+      applied_seq_.erase(seq_lru_.front());
+      seq_lru_.pop_front();
+    }
+  }
 };
 
 }  // namespace

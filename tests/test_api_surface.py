@@ -291,3 +291,21 @@ def test_subgraph_fold_bn_pass():
     assert "fold_bn" in mx.subgraph.list_passes()
     folded2 = out.optimize_for("MKLDNN", args, aux)
     assert folded2._folded_bn == ["bn0"]
+
+
+def test_symbol_contrib_image_random_namespaces():
+    """mx.sym.contrib / .image / .random mirror the nd namespaces
+    (reference symbol/contrib.py etc.; SSD symbol code needs contrib)."""
+    data = mx.sym.Variable("data")
+    anchors = mx.sym.contrib.MultiBoxPrior(data, sizes=(0.5,), ratios=(1.0,))
+    x = nd.array(np.random.RandomState(0).rand(1, 3, 4, 4).astype(np.float32))
+    out = anchors.eval(data=x)[0]
+    assert out.shape[-1] == 4
+    flipped_sym = mx.sym.image.flip_left_right(mx.sym.Variable("img"))
+    img = nd.array(np.arange(12, dtype=np.uint8).reshape(2, 2, 3))
+    got = flipped_sym.eval(img=img)[0]
+    np.testing.assert_array_equal(got.asnumpy(), img.asnumpy()[:, ::-1])
+    u = mx.sym.random.uniform(low=0.0, high=1.0, shape=(8,))
+    vals = u.eval()[0]
+    assert vals.shape == (8,)
+    assert 0.0 <= float(vals.asnumpy().min())

@@ -1,0 +1,116 @@
+"""Cross-backend op consistency sweep: TPU vs CPU.
+
+The reference's GPU test tier reruns the CPU op suite on gpu(0) and
+cross-compares (tests/python/gpu/test_operator_gpu.py check_consistency —
+TBV, SURVEY.md §4 calls this "the single most important idea to copy").
+pytest runs force the CPU backend (tests/conftest.py), so the TPU leg runs
+here as a standalone sweep on the real chip:
+
+    python tools/check_tpu_consistency.py            # all groups
+    python tools/check_tpu_consistency.py --ops nn   # one group
+
+Exit code 0 = every op matched CPU within tolerance.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _cases(rng):
+    """(group, name, fn(nd, *arrays), input arrays) — representative ops
+    from every §2.2 family."""
+    x = rng.rand(4, 8).astype(np.float32)
+    img = rng.rand(2, 3, 8, 8).astype(np.float32)
+    w = rng.rand(4, 3, 3, 3).astype(np.float32)
+    fc_w = rng.rand(16, 8).astype(np.float32)
+    seq = rng.rand(6, 2, 4).astype(np.float32)
+    idx = np.array([1, 0, 2, 1], np.float32)
+    return [
+        ("elemwise", "exp+mul", lambda nd, a: nd.exp(a) * 0.5 + a, [x]),
+        ("elemwise", "erf", lambda nd, a: nd.erf(a), [x]),
+        ("reduce", "sum_axis", lambda nd, a: nd.sum(a, axis=1), [x]),
+        ("reduce", "norm", lambda nd, a: nd.norm(a), [x]),
+        ("matrix", "dot", lambda nd, a, b: nd.dot(a, b.T), [x, x]),
+        ("matrix", "batch_dot",
+         lambda nd, a, b: nd.batch_dot(a.reshape((2, 2, 8)),
+                                       b.reshape((2, 8, 2))), [x, x]),
+        ("nn", "FullyConnected",
+         lambda nd, a, w_: nd.FullyConnected(a, w_, num_hidden=16,
+                                             no_bias=True), [x, fc_w]),
+        ("nn", "Convolution",
+         lambda nd, a, w_: nd.Convolution(a, w_, kernel=(3, 3), num_filter=4,
+                                          pad=(1, 1), no_bias=True),
+         [img, w]),
+        ("nn", "Pooling",
+         lambda nd, a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="max"), [img]),
+        ("nn", "softmax", lambda nd, a: nd.softmax(a, axis=-1), [x]),
+        ("nn", "LayerNorm",
+         lambda nd, a, g, b: nd.LayerNorm(a, g, b, axis=-1),
+         [x, np.ones(8, np.float32), np.zeros(8, np.float32)]),
+        ("indexing", "take", lambda nd, a, i: nd.take(a, i), [x, idx]),
+        ("indexing", "one_hot",
+         lambda nd, i: nd.one_hot(i, depth=4), [idx]),
+        ("ordering", "topk",
+         lambda nd, a: nd.topk(a, k=3, ret_typ="value"), [x]),
+        ("ordering", "sort", lambda nd, a: nd.sort(a, axis=-1), [x]),
+        ("sequence", "SequenceReverse",
+         lambda nd, s: nd.SequenceReverse(s), [seq]),
+        ("contrib", "box_nms",
+         lambda nd, d: nd.contrib.box_nms(d.reshape((1, 4, 6)),
+                                          overlap_thresh=0.5),
+         [np.abs(rng.rand(24).astype(np.float32))]),
+        ("optimizer", "adam_update",
+         lambda nd, w_, g, m, v: nd.adam_update(w_, g, m, v, lr=0.01)[0],
+         [x, x * 0.1, np.zeros_like(x), np.zeros_like(x)]),
+        ("image", "to_tensor",
+         lambda nd, a: nd.image.to_tensor((a * 255).astype("uint8")
+                                          if hasattr(a, "astype") else a),
+         [rng.rand(8, 8, 3).astype(np.float32)]),
+        ("quant", "quantize_v2",
+         lambda nd, a: nd.contrib.quantize_v2(a)[0].astype("float32"), [x]),
+    ]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--ops", default=None, help="only this group")
+    args = p.parse_args(argv)
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.test_utils import check_consistency
+
+    platforms = {d.platform for d in jax.devices()}
+    if not platforms & {"tpu", "axon"}:
+        print("no TPU visible — nothing to cross-check")
+        return 0
+
+    rng = np.random.RandomState(0)
+    failures = []
+    n = 0
+    for group, name, fn, inputs in _cases(rng):
+        if args.ops and group != args.ops:
+            continue
+        n += 1
+        try:
+            check_consistency(
+                lambda *arrs, _f=fn: _f(mx.nd, *arrs), inputs,
+                ctx_list=[mx.cpu(), mx.tpu(0)])
+            print(f"OK   {group:<10} {name}")
+        except Exception as e:  # noqa: BLE001 - report and continue
+            failures.append((group, name, str(e)[:200]))
+            print(f"FAIL {group:<10} {name}: {str(e)[:120]}")
+    print(f"\n{n - len(failures)}/{n} ops consistent TPU vs CPU")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
