@@ -348,8 +348,15 @@ def main():
     try:
         peak = _measure_matmul_peak()
         bert = bench_bert(platform)
-        bert["measured_matmul_peak_tflops"] = round(peak, 2)
-        bert["mfu_vs_measured_peak"] = round(bert["model_tflops"] / peak, 4)
+        # chip throughput drifts run-to-run (~±20% observed); a sustained
+        # model rate is itself a lower bound on peak, so the MFU denominator
+        # is max(probe, model math) — the ratio can never self-contradict
+        # (>1). The probe stays reported under its own (honest) name.
+        peak_eff = max(peak, bert["model_tflops"])
+        bert["matmul_probe_tflops"] = round(peak, 2)
+        bert["effective_peak_tflops"] = round(peak_eff, 2)
+        bert["mfu_vs_measured_peak"] = round(
+            bert["model_tflops"] / peak_eff, 4)
         bert["mfu_vs_nominal_v5e"] = round(
             bert["model_tflops"] / NOMINAL_V5E_BF16_TFLOPS, 4)
         extra["bert_base_bf16"] = bert

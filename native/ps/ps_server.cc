@@ -155,9 +155,12 @@ class Server {
       } else if (op == PUSH) {
         Entry* e = GetEntry(key, false);
         if (!e) { SendMsg(conn, PUSH, key, std::string("\x01", 1)); continue; }
-        std::lock_guard<std::mutex> lk(e->mu);
-        ApplyPush(e, payload, payload_len);
-        SendMsg(conn, PUSH, key, std::string("\x00", 1));
+        bool ok;
+        {
+          std::lock_guard<std::mutex> lk(e->mu);
+          ok = ApplyPush(e, payload, payload_len);
+        }
+        SendMsg(conn, PUSH, key, std::string(ok ? "\x00" : "\x01", 1));
       } else if (op == PULL) {
         Entry* e = GetEntry(key, false);
         if (!e) { SendMsg(conn, PULL, key, ""); continue; }
