@@ -23,6 +23,13 @@ import os as _os
 _jax.config.update("jax_default_matmul_precision",
                    _os.environ.get("MXNET_MATMUL_PRECISION", "highest"))
 
+# MXNET_FORCE_PLATFORM=cpu|tpu: pin the jax backend at import time. Needed
+# because this image preloads jax with JAX_PLATFORMS=axon via sitecustomize,
+# so the plain env var is too late for subprocesses (example-script CI runs
+# tiny configs on CPU this way; see tests/conftest.py for the same trick).
+if _os.environ.get("MXNET_FORCE_PLATFORM"):
+    _jax.config.update("jax_platforms", _os.environ["MXNET_FORCE_PLATFORM"])
+
 from .base import MXNetError, get_env  # noqa: F401
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus  # noqa: F401
 from . import ops  # noqa: F401  (registers the operator library)

@@ -326,3 +326,463 @@ def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
     if retstep:
         return NDArray(out[0]), float(out[1])
     return NDArray(out)
+
+
+# ---------------------------------------------------------------------------
+# Round-4 expansion (VERDICT r3 item 7): the next tier of most-used numpy
+# ops, explicit instead of silently delegated. Same semantics contract as
+# above: out=/where= honored, float32 (never float64) default promotion,
+# int32 index dtypes, NDArray returns on the tape.
+# ---------------------------------------------------------------------------
+
+# comparisons (bool results; where= via the ufunc factory)
+_binary("equal", jnp.equal)
+_binary("not_equal", jnp.not_equal)
+_binary("less", jnp.less)
+_binary("less_equal", jnp.less_equal)
+_binary("greater", jnp.greater)
+_binary("greater_equal", jnp.greater_equal)
+
+# logical / bitwise
+_binary("logical_and", jnp.logical_and)
+_binary("logical_or", jnp.logical_or)
+_binary("logical_xor", jnp.logical_xor)
+_unary("logical_not", jnp.logical_not)
+_binary("bitwise_and", jnp.bitwise_and)
+_binary("bitwise_or", jnp.bitwise_or)
+_binary("bitwise_xor", jnp.bitwise_xor)
+_unary("bitwise_not", jnp.bitwise_not)
+_unary("invert", jnp.invert)
+_binary("left_shift", jnp.left_shift)
+_binary("right_shift", jnp.right_shift)
+
+# more binary ufuncs
+_binary("floor_divide", jnp.floor_divide)     # int//int stays int
+_binary("fmod", jnp.fmod)
+_binary("gcd", jnp.gcd)
+_binary("lcm", jnp.lcm)
+_binary("heaviside", jnp.heaviside)
+_binary("logaddexp", jnp.logaddexp)
+_binary("fmax", jnp.fmax)
+_binary("fmin", jnp.fmin)
+
+# more unary ufuncs
+_unary("expm1", jnp.expm1)
+_unary("log1p", jnp.log1p)
+_unary("exp2", jnp.exp2)
+_unary("cbrt", jnp.cbrt)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("deg2rad", jnp.deg2rad)
+_unary("rad2deg", jnp.rad2deg)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("isnan", jnp.isnan)
+_unary("isinf", jnp.isinf)
+_unary("isfinite", jnp.isfinite)
+_unary("isposinf", jnp.isposinf)
+_unary("isneginf", jnp.isneginf)
+_unary("fix", jnp.fix)
+_unary("positive", jnp.positive)
+_unary("conj", jnp.conj)
+_unary("conjugate", jnp.conjugate)
+
+
+@_np_op("round")
+def round(x, decimals=0, out=None, **kw):  # noqa: A001 - numpy name
+    return _invoke(lambda a: jnp.round(a, decimals=decimals), [x], out)
+
+
+_EXPLICIT["around"] = round
+_EXPLICIT["round_"] = round
+globals()["around"] = round
+globals()["round_"] = round
+__all__ += ["around", "round_"]
+
+
+@_np_op("nan_to_num")
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    return _invoke(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                            neginf=neginf), [x])
+
+# reductions
+_reduction("all", jnp.all)
+_reduction("any", jnp.any)
+_reduction("nansum", jnp.nansum)
+_reduction("nanmax", jnp.nanmax)
+_reduction("nanmin", jnp.nanmin)
+_reduction("nanmean", jnp.nanmean, float_result=True)
+_reduction("nanprod", jnp.nanprod)
+
+
+@_np_op("ptp")
+def ptp(a, axis=None, out=None, keepdims=False):
+    return _invoke(lambda x: jnp.ptp(x, axis=_axis_tuple(axis),
+                                     keepdims=keepdims), [a], out)
+
+
+@_np_op("median")
+def median(a, axis=None, out=None, keepdims=False, **kw):
+    return _invoke(lambda x: jnp.median(_to_float(x), axis=_axis_tuple(axis),
+                                        keepdims=keepdims), [a], out)
+
+
+@_np_op("quantile")
+def quantile(a, q, axis=None, out=None, keepdims=False, **kw):
+    return _invoke(lambda x: jnp.quantile(
+        _to_float(x), jnp.asarray(_unwrap(q), jnp.float32),
+        axis=_axis_tuple(axis), keepdims=keepdims), [a], out)
+
+
+@_np_op("percentile")
+def percentile(a, q, axis=None, out=None, keepdims=False, **kw):
+    return _invoke(lambda x: jnp.percentile(
+        _to_float(x), jnp.asarray(_unwrap(q), jnp.float32),
+        axis=_axis_tuple(axis), keepdims=keepdims), [a], out)
+
+
+@_np_op("average")
+def average(a, axis=None, weights=None, returned=False):
+    if weights is None:
+        res = _invoke(lambda x: jnp.mean(_to_float(x),
+                                         axis=_axis_tuple(axis)), [a])
+        if returned:
+            cnt = _onp.prod([_unwrap(a).shape[ax] for ax in (
+                range(_unwrap(a).ndim) if axis is None
+                else ([axis] if isinstance(axis, int) else axis))])
+            return res, full_like_scalar(res, float(cnt))
+        return res
+    res = _invoke(
+        lambda x, w: jnp.average(_to_float(x), axis=_axis_tuple(axis),
+                                 weights=_to_float(w)), [a, weights])
+    if returned:
+        wsum = _invoke(lambda w: jnp.sum(_to_float(w),
+                                         axis=_axis_tuple(axis)), [weights])
+        return res, wsum
+    return res
+
+
+def full_like_scalar(like, value):
+    return NDArray(jnp.full(_unwrap(like).shape, value, jnp.float32))
+
+
+@_np_op("cumprod")
+def cumprod(a, axis=None, dtype=None, out=None):
+    def pure(x):
+        r = jnp.cumprod(x.reshape(-1) if axis is None else x,
+                        axis=0 if axis is None else axis)
+        return r.astype(dtype) if dtype else r
+    return _invoke(pure, [a], out)
+
+
+# sorting / searching (index dtypes int32 — TPU-native, x64 disabled)
+@_np_op("sort")
+def sort(a, axis=-1, kind=None, order=None):
+    return _invoke(lambda x: jnp.sort(x, axis=axis), [a])
+
+
+@_np_op("argsort")
+def argsort(a, axis=-1, kind=None, order=None):
+    return _invoke(lambda x: jnp.argsort(x, axis=axis).astype(jnp.int32), [a])
+
+
+@_np_op("searchsorted")
+def searchsorted(a, v, side="left", sorter=None):
+    arrays = [a, v] if sorter is None else [a, v, sorter]
+    return _invoke(lambda *ts: jnp.searchsorted(
+        ts[0], ts[1], side=side,
+        sorter=ts[2] if len(ts) > 2 else None).astype(jnp.int32), arrays)
+
+
+@_np_op("nonzero")
+def nonzero(a):
+    cond = _unwrap(a)
+    return tuple(NDArray(i.astype(jnp.int32)) for i in jnp.nonzero(cond))
+
+
+@_np_op("count_nonzero")
+def count_nonzero(a, axis=None):
+    return _invoke(lambda x: jnp.count_nonzero(x, axis=_axis_tuple(axis))
+                   .astype(jnp.int32), [a])
+
+
+@_np_op("unique")
+def unique(ar, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    res = jnp.unique(_unwrap(ar), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        out = [NDArray(res[0])]
+        for extra in res[1:]:
+            out.append(NDArray(extra.astype(jnp.int32)))
+        return tuple(out)
+    return NDArray(res)
+
+
+@_np_op("bincount")
+def bincount(x, weights=None, minlength=0):
+    xs = _unwrap(x)
+    if weights is None:
+        return NDArray(jnp.bincount(xs, minlength=minlength)
+                       .astype(jnp.int32))
+    return NDArray(jnp.bincount(xs, weights=_unwrap(weights),
+                                minlength=minlength))
+
+
+# shape / manipulation
+@_np_op("ravel")
+def ravel(a, order="C"):
+    return _invoke(lambda x: jnp.ravel(x), [a])
+
+
+@_np_op("flip")
+def flip(m, axis=None):
+    return _invoke(lambda x: jnp.flip(x, axis=_axis_tuple(axis)), [m])
+
+
+@_np_op("flipud")
+def flipud(m):
+    return _invoke(jnp.flipud, [m])
+
+
+@_np_op("fliplr")
+def fliplr(m):
+    return _invoke(jnp.fliplr, [m])
+
+
+@_np_op("roll")
+def roll(a, shift, axis=None):
+    return _invoke(lambda x: jnp.roll(x, shift, axis=_axis_tuple(axis)), [a])
+
+
+@_np_op("rot90")
+def rot90(m, k=1, axes=(0, 1)):
+    return _invoke(lambda x: jnp.rot90(x, k=k, axes=tuple(axes)), [m])
+
+
+@_np_op("moveaxis")
+def moveaxis(a, source, destination):
+    return _invoke(lambda x: jnp.moveaxis(x, source, destination), [a])
+
+
+@_np_op("vstack")
+def vstack(tup):
+    return _invoke(lambda *ts: jnp.vstack(ts), list(tup))
+
+
+@_np_op("hstack")
+def hstack(tup):
+    return _invoke(lambda *ts: jnp.hstack(ts), list(tup))
+
+
+@_np_op("dstack")
+def dstack(tup):
+    return _invoke(lambda *ts: jnp.dstack(ts), list(tup))
+
+
+@_np_op("column_stack")
+def column_stack(tup):
+    return _invoke(lambda *ts: jnp.column_stack(ts), list(tup))
+
+
+@_np_op("array_split")
+def array_split(ary, indices_or_sections, axis=0):
+    ios = indices_or_sections
+    if isinstance(ios, (list, tuple)):
+        ios = tuple(int(i) for i in ios)
+    outs = _invoke(lambda x: tuple(jnp.array_split(x, ios, axis=axis)), [ary])
+    return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+
+
+@_np_op("atleast_1d")
+def atleast_1d(*arys):
+    res = [_invoke(jnp.atleast_1d, [a]) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+@_np_op("atleast_2d")
+def atleast_2d(*arys):
+    res = [_invoke(jnp.atleast_2d, [a]) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+@_np_op("atleast_3d")
+def atleast_3d(*arys):
+    res = [_invoke(jnp.atleast_3d, [a]) for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+@_np_op("pad")
+def pad(array, pad_width, mode="constant", **kwargs):
+    return _invoke(lambda x: jnp.pad(x, pad_width, mode=mode, **kwargs),
+                   [array])
+
+
+@_np_op("take")
+def take(a, indices, axis=None, mode="raise", out=None):
+    # device arrays can't raise on bad indices; 'raise' behaves as 'clip'
+    # (documented deviation, matches mxnet-numpy's default on GPU)
+    jmode = "clip" if mode == "raise" else mode
+    return _invoke(
+        lambda x, idx: jnp.take(x, idx.astype(jnp.int32), axis=axis,
+                                mode=jmode), [a, indices], out)
+
+
+@_np_op("take_along_axis")
+def take_along_axis(arr, indices, axis):
+    return _invoke(lambda x, idx: jnp.take_along_axis(
+        x, idx.astype(jnp.int32), axis=axis), [arr, indices])
+
+
+@_np_op("meshgrid")
+def meshgrid(*xi, indexing="xy", **kw):
+    outs = jnp.meshgrid(*[_unwrap(x) for x in xi], indexing=indexing)
+    return [NDArray(o) for o in outs]
+
+
+@_np_op("diff")
+def diff(a, n=1, axis=-1):
+    return _invoke(lambda x: jnp.diff(x, n=n, axis=axis), [a])
+
+
+@_np_op("ediff1d")
+def ediff1d(ary, to_end=None, to_begin=None):
+    return _invoke(lambda x: jnp.ediff1d(
+        x, to_end=None if to_end is None else _unwrap(to_end),
+        to_begin=None if to_begin is None else _unwrap(to_begin)), [ary])
+
+
+@_np_op("interp")
+def interp(x, xp, fp, left=None, right=None, period=None):
+    return _invoke(lambda a, b, c: jnp.interp(
+        _to_float(a), _to_float(b), _to_float(c), left=left, right=right,
+        period=period), [x, xp, fp])
+
+
+# linear-algebra-adjacent
+@_np_op("outer")
+def outer(a, b, out=None):
+    return _invoke(lambda x, y: jnp.outer(x, y), [a, b], out)
+
+
+@_np_op("inner")
+def inner(a, b):
+    return _invoke(lambda x, y: jnp.inner(x, y), [a, b])
+
+
+@_np_op("vdot")
+def vdot(a, b):
+    return _invoke(lambda x, y: jnp.vdot(x, y), [a, b])
+
+
+@_np_op("kron")
+def kron(a, b):
+    return _invoke(lambda x, y: jnp.kron(x, y), [a, b])
+
+
+@_np_op("cross")
+def cross(a, b, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    return _invoke(lambda x, y: jnp.cross(x, y, axisa=axisa, axisb=axisb,
+                                          axisc=axisc, axis=axis), [a, b])
+
+
+@_np_op("trace")
+def trace(a, offset=0, axis1=0, axis2=1, dtype=None, out=None):
+    def pure(x):
+        r = jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+        return r.astype(dtype) if dtype else r
+    return _invoke(pure, [a], out)
+
+
+@_np_op("diag")
+def diag(v, k=0):
+    return _invoke(lambda x: jnp.diag(x, k=k), [v])
+
+
+@_np_op("diagonal")
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return _invoke(lambda x: jnp.diagonal(x, offset=offset, axis1=axis1,
+                                          axis2=axis2), [a])
+
+
+@_np_op("tril")
+def tril(m, k=0):
+    return _invoke(lambda x: jnp.tril(x, k=k), [m])
+
+
+@_np_op("triu")
+def triu(m, k=0):
+    return _invoke(lambda x: jnp.triu(x, k=k), [m])
+
+
+@_np_op("einsum")
+def einsum(subscripts, *operands, out=None, **kwargs):
+    return _invoke(lambda *ts: jnp.einsum(subscripts, *ts), list(operands),
+                   out)
+
+
+@_np_op("maximum_reduce")  # internal helper kept explicit for npx users
+def maximum_reduce(a, axis=None, keepdims=False):
+    return _invoke(lambda x: jnp.max(x, axis=_axis_tuple(axis),
+                                     keepdims=keepdims), [a])
+
+
+# creation (float32 default, never float64)
+@_np_op("eye")
+def eye(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    return NDArray(jnp.eye(int(N), None if M is None else int(M), k=k,
+                           dtype=dtype or jnp.float32))
+
+
+@_np_op("identity")
+def identity(n, dtype=None, ctx=None, device=None):
+    return NDArray(jnp.identity(int(n), dtype=dtype or jnp.float32))
+
+
+@_np_op("logspace")
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None, device=None):
+    return NDArray(jnp.logspace(start, stop, int(num), endpoint=endpoint,
+                                base=base, dtype=dtype or jnp.float32,
+                                axis=axis))
+
+
+@_np_op("tri")
+def tri(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    return NDArray(jnp.tri(int(N), None if M is None else int(M), k=k,
+                           dtype=dtype or jnp.float32))
+
+
+@_np_op("zeros_like")
+def zeros_like(a, dtype=None, order="C", ctx=None, device=None):
+    return _invoke(lambda x: jnp.zeros_like(x, dtype=dtype), [a])
+
+
+@_np_op("ones_like")
+def ones_like(a, dtype=None, order="C", ctx=None, device=None):
+    return _invoke(lambda x: jnp.ones_like(x, dtype=dtype), [a])
+
+
+@_np_op("full_like")
+def full_like(a, fill_value, dtype=None, order="C", ctx=None, device=None):
+    return _invoke(lambda x: jnp.full_like(x, fill_value, dtype=dtype), [a])
+
+
+@_np_op("isclose")
+def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _invoke(lambda x, y: jnp.isclose(x, y, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), [a, b])
+
+
+@_np_op("allclose")
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return bool(jnp.allclose(_unwrap(a), _unwrap(b), rtol=rtol, atol=atol,
+                             equal_nan=equal_nan))
+
+
+@_np_op("array_equal")
+def array_equal(a1, a2, equal_nan=False):
+    return bool(jnp.array_equal(_unwrap(a1), _unwrap(a2),
+                                equal_nan=equal_nan))

@@ -2,11 +2,25 @@
 
 The hot op of the transformer family (SURVEY.md §7 step 8). Forward is a
 Pallas kernel: one Q block stays in VMEM while the kernel streams K/V blocks,
-keeping online-softmax statistics in f32 registers — the S×S score matrix is
-never materialized in HBM, so memory is O(S·D) instead of O(S²) and long
-contexts fit on chip. Backward is the standard flash recompute, expressed as
-a blocked ``lax.scan`` over K/V blocks in plain JAX (XLA fuses it; memory
-O(S·block)).
+keeping online-softmax statistics in f32 — the S×S score matrix is never
+materialized in HBM, so memory is O(S·D) instead of O(S²) and long contexts
+fit on chip. Backward is a second Pallas kernel (one pass over K/V blocks,
+recomputing P from the saved lse; dQ accumulates in a VMEM-resident output
+block across the sequential TPU grid). On non-TPU backends the backward
+falls back to a blocked ``lax.scan`` in plain JAX.
+
+TPU-efficiency notes (measured on v5e, round 4 — tools/profile_lm.py):
+- The K/V loop is phase-split: fully-visible blocks run with NO masking
+  (no iota/compare/select VPU passes), only the O(1) diagonal blocks pay
+  for the causal mask. With head_dim 64 the MXU:VPU work ratio is only
+  ~32:1, so every per-element VPU pass costs as much as a matmul — the
+  round-3 kernel spent most of its 7.2 ms in exactly those passes.
+- Softmax statistics run in the log2 domain (``exp2`` is the native VPU
+  transcendental; ``exp`` lowers to exp2 + a hidden multiply).
+- Fully-masked rows are repaired once per q-block (per-row select) instead
+  of guarding every score element.
+- Block sizes come from a per-(S, D) table measured by tools/tune_flash.py;
+  ``MXNET_FLASH_BLOCK_Q/K`` override.
 
 Causal masking takes a **dynamic row offset**: visibility is
 ``row + offset >= col``. offset=0 is standard causal; ring attention
@@ -25,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +48,7 @@ from jax import lax
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30  # avoids -inf NaN propagation inside the kernel
+_LOG2E = math.log2(math.e)
 
 # The package default is jax_default_matmul_precision=highest (fp32-accurate
 # fp32 GEMMs for reference parity). For bf16 operands that would mean a
@@ -48,9 +64,27 @@ def _dot_prec(dt):
             else lax.Precision.HIGHEST)
 
 
+def _dotT(a, b, prec):
+    """a:(m,c) b:(n,c) -> (m,n) without materializing b.T (dot_general)."""
+    return lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                           preferred_element_type=jnp.float32, precision=prec)
+
+
+def _dotA(a, b, prec):
+    """a:(c,m) b:(c,n) -> (m,n): contract leading dims (no transposes)."""
+    return lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32, precision=prec)
+
+
 def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
                 scale, causal, block_q):
-    """Grid (BH, S // block_q). q block resident; stream K/V blocks."""
+    """Grid (BH, S // block_q). q block resident; stream K/V blocks.
+
+    Phase split: blocks [0, nk_full) are fully visible (no mask math);
+    blocks [nk_full, nk_run) get the causal iota mask. Softmax statistics
+    are tracked in the log2 domain on raw (unscaled) scores; the scale
+    folds into the exp2 argument.
+    """
     import jax.experimental.pallas as pl
 
     q_blk_idx = pl.program_id(1)
@@ -62,45 +96,57 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
     s_total = k_ref.shape[1]
     nk = s_total // block_k
     offset = off_ref[0]
+    prec = _dot_prec(q.dtype)
+    c = scale * _LOG2E  # exp(s*scale - m) == exp2((s - m_raw) * c)
     if causal:
-        # K/V blocks beyond the last visible column contribute nothing:
-        # max visible col = q_global_end + offset
-        q_end = q_blk_idx * block_q + bq
-        last = (q_end + offset + block_k - 1) // block_k
+        q_start = q_blk_idx * block_q
+        # fully-visible: every col of block j visible to every row ⇔
+        # (j+1)*bk - 1 <= q_start + offset
+        nk_full = jnp.clip((q_start + offset - block_k + 1) // block_k + 1,
+                           0, nk)
+        # any-visible: col_min <= q_end - 1 + offset
+        last = (q_start + bq + offset + block_k - 1) // block_k
         nk_run = jnp.clip(last, 0, nk)
     else:
+        nk_full = nk
         nk_run = nk
 
-    def body(j, carry):
+    def tile(j, carry, masked):
         acc, m, l = carry
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32,
-                    precision=_dot_prec(q.dtype)) * scale  # (bq,bk)
-        if causal:
+        s = _dotT(q, k_blk, prec)                      # raw scores (bq,bk)
+        if masked:
             rows = q_blk_idx * block_q + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(rows + offset >= cols, s, _NEG_INF)
-        blk_max = jnp.max(s, axis=-1)                  # (bq,)
-        new_m = jnp.maximum(m, blk_max)
-        corr = jnp.exp(m - new_m)
-        p = jnp.exp(s - new_m[:, None])
-        p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+        new_m = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp2((m - new_m) * c)
+        p = jnp.exp2((s - new_m[:, None]) * c)
         acc = acc * corr[:, None] + jnp.dot(
             p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32,
-            precision=_dot_prec(v_blk.dtype))
+            precision=prec)
         l = l * corr + jnp.sum(p, axis=-1)
         return acc, new_m, l
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = lax.fori_loop(0, nk_run, body, (acc0, m0, l0))
+    carry = lax.fori_loop(0, nk_full,
+                          functools.partial(tile, masked=False),
+                          (acc0, m0, l0))
+    acc, m, l = lax.fori_loop(nk_full, nk_run,
+                              functools.partial(tile, masked=True), carry)
+    # Rows that never saw a visible column (possible only for offset < 0,
+    # ring's partially-masked edge): m stayed _NEG_INF with p=exp2(0)=1
+    # pollution. One per-row select repairs them — no per-element guard.
+    row_ok = m > _NEG_INF / 2
     safe_l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
-    lse = jnp.where(l > 0, m + jnp.log(safe_l), _NEG_INF)
+    o_ref[0] = jnp.where(row_ok[:, None], acc / safe_l[:, None],
+                         0.0).astype(o_ref.dtype)
+    lse = jnp.where(row_ok & (l > 0), m * scale + jnp.log(safe_l), _NEG_INF)
     # lse lives in an (bq, 8)-lane block purely to satisfy TPU tiling
     lse_ref[0] = jnp.broadcast_to(lse[:, None], (bq, 8))
 
@@ -117,6 +163,20 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _match_vma(x, like):
+    """Broadcast x's varying-manual-axes to like's so pallas_call composes
+    with shard_map's check_vma."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma and hasattr(lax, "pvary"):
+            missing = tuple(sorted(set(vma) - set(jax.typeof(x).vma)))
+            if missing:
+                return lax.pvary(x, missing)
+    except (AttributeError, TypeError):
+        pass
+    return x
+
+
 def _fwd_pallas(q, k, v, offset, scale, causal, block_q, block_k, interpret):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -126,15 +186,7 @@ def _fwd_pallas(q, k, v, offset, scale, causal, block_q, block_k, interpret):
     q3 = q.reshape(bh, s, d)
     k3 = k.reshape(bh, s, d)
     v3 = v.reshape(bh, s, d)
-    off = jnp.asarray(offset, jnp.int32).reshape(1)
-    try:
-        vma = jax.typeof(q).vma
-        if vma and hasattr(lax, "pvary"):
-            missing = tuple(sorted(set(vma) - set(jax.typeof(off).vma)))
-            if missing:
-                off = lax.pvary(off, missing)
-    except (AttributeError, TypeError):
-        pass
+    off = _match_vma(jnp.asarray(offset, jnp.int32).reshape(1), q)
     grid = (bh, s // block_q)
     kernel = functools.partial(_fwd_kernel, block_k=block_k, scale=scale,
                                causal=causal, block_q=block_q)
@@ -160,8 +212,149 @@ def _fwd_pallas(q, k, v, offset, scale, causal, block_q, block_k, interpret):
     return out.reshape(b, h, s, d), lse[..., 0].reshape(b, h, s)
 
 
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_kernel(off_ref, q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
+                dq_ref, dk_ref, dv_ref, *, block_q, block_k, scale, causal):
+    """Grid (BH, S // block_k). K/V block resident; loops over Q blocks.
+
+    dQ accumulates into a full-sequence VMEM output block: the TPU grid is
+    sequential per core, and dq's index map ignores the kv-block index, so
+    the buffer stays live across j steps (initialized at j == 0).
+    dS = P ∘ (dP − δ + dlse) with δ = rowsum(dO ∘ O) precomputed outside.
+    """
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+    k_blk = k_ref[0]                                   # (bk, D)
+    v_blk = v_ref[0]
+    bk, d = k_blk.shape
+    s_total = q_ref.shape[1]
+    nq = s_total // block_q
+    offset = off_ref[0]
+    prec = _dot_prec(k_blk.dtype)
+    c = scale * _LOG2E
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    if causal:
+        # first q block with any visible row: i*bq + bq-1 + offset >= j*bk
+        i_start = jnp.clip((j * block_k - offset) // block_q, 0, nq)
+        # first q block with EVERY row visible: i*bq + offset >= (j+1)*bk - 1
+        i_full = jnp.clip(
+            (j * block_k + block_k - 1 - offset + block_q - 1) // block_q,
+            i_start, nq)
+    else:
+        i_start = 0
+        i_full = 0
+
+    def tile(i, carry, masked):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :][:, 0]  # (bq,)
+        dl_blk = dl_ref[0, pl.ds(i * block_q, block_q), :][:, 0]  # δ - g_lse
+        s = _dotT(q_blk, k_blk, prec)                  # raw scores (bq,bk)
+        if masked:
+            rows = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            cols = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(rows + offset >= cols, s, _NEG_INF)
+            # Rows with lse=_NEG_INF (never visible anywhere — ring's
+            # partially-masked edge, offset<0 unaligned to block_q) reach
+            # masked tiles at block granularity: exp2(s·c − lse·log2e)
+            # would overflow to +inf there (both terms ±1e30). Valid rows
+            # always have exponent ≤ 0 (p ≤ 1), so clamping at 0 plus a
+            # per-row zero repairs them without touching the hot unmasked
+            # path.
+            row_ok = lse_blk > _NEG_INF / 2
+            expo = jnp.minimum(s * c - (lse_blk * _LOG2E)[:, None], 0.0)
+            p = jnp.exp2(expo) * row_ok[:, None]
+        else:
+            # fully-visible pair ⇒ every row visible ⇒ lse finite
+            p = jnp.exp2(s * c - (lse_blk * _LOG2E)[:, None])
+        dp = _dotT(do_blk, v_blk, prec)                # (bq,bk)
+        ds = (p * (dp - dl_blk[:, None]) * scale)
+        pd = p.astype(do_blk.dtype)
+        dsd = ds.astype(q_blk.dtype)
+        dv_acc = dv_acc + _dotA(pd, do_blk, prec)      # (bk,D)
+        dk_acc = dk_acc + _dotA(dsd, q_blk, prec)      # (bk,D)
+        dq_cur = dq_ref[0, pl.ds(i * block_q, block_q), :]
+        dq_ref[0, pl.ds(i * block_q, block_q), :] = dq_cur + jnp.dot(
+            dsd, k_blk, preferred_element_type=jnp.float32, precision=prec)
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    carry = lax.fori_loop(i_start, i_full,
+                          functools.partial(tile, masked=True), (z, z))
+    dk_acc, dv_acc = lax.fori_loop(i_full, nq,
+                                   functools.partial(tile, masked=False),
+                                   carry)
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _bwd_pallas(scale, causal, block_q, block_k, interpret, res, g):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, offset, o, lse = res
+    do, g_lse = g
+    b, h, s, d = q.shape
+    bh = b * h
+    # δ − dlse folded into ONE per-row vector so the kernel reads it once
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dl = (delta - g_lse.astype(jnp.float32)).reshape(bh, s)
+    q3 = q.reshape(bh, s, d)
+    k3 = k.reshape(bh, s, d)
+    v3 = v.reshape(bh, s, d)
+    do3 = do.astype(q.dtype).reshape(bh, s, d)
+    # (bh, s, 8) lane-padded per-row vectors (same trick as fwd lse output)
+    lse3 = jnp.broadcast_to(lse.reshape(bh, s)[..., None], (bh, s, 8))
+    dl3 = jnp.broadcast_to(dl[..., None], (bh, s, 8))
+    off = _match_vma(jnp.asarray(offset, jnp.int32).reshape(1), q)
+
+    grid = (bh, s // block_k)
+    kernel = functools.partial(_bwd_kernel, block_q=block_q, block_k=block_k,
+                               scale=scale, causal=causal)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),   # q
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),   # do
+            pl.BlockSpec((1, s, 8), lambda i, j: (i, 0, 0)),   # lse
+            pl.BlockSpec((1, s, 8), lambda i, j: (i, 0, 0)),   # δ-dlse
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),  # k
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),  # v
+        ],
+        out_specs=[
+            pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),        # dq
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),  # dk
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),  # dv
+        ],
+        out_shape=[
+            _sds((bh, s, d), jnp.float32, q),
+            _sds((bh, s, d), k.dtype, q),
+            _sds((bh, s, d), v.dtype, q),
+        ],
+        interpret=interpret,
+    )(off, q3, do3, lse3, dl3, k3, v3)
+    return (dq.astype(q.dtype).reshape(b, h, s, d),
+            dk.reshape(b, h, s, d), dv.reshape(b, h, s, d),
+            _int_zero(offset))
+
+
 def _bwd_blocked(scale, causal, block_k, res, g):
-    """Flash backward: blocked scan over K/V blocks with saved lse.
+    """Fallback flash backward (plain JAX blocked scan) for non-TPU
+    backends: XLA fuses it well enough on CPU and it avoids slow
+    interpret-mode Pallas in the test suite.
 
     dS = P ∘ (dP − δ + dlse) with δ = rowsum(dO ∘ O); memory O(S·block_k).
     """
@@ -204,7 +397,7 @@ def _bwd_blocked(scale, causal, block_k, res, g):
     dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, s, d)
     dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, s, d)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            _int_zero(offset))  # offset is int32: float0 cotangent
+            _int_zero(offset))
 
 
 def _int_zero(x):
@@ -213,11 +406,46 @@ def _int_zero(x):
     return np.zeros(x.shape, jax.dtypes.float0)
 
 
+# ---------------------------------------------------------------------------
+# Block-size selection
+# ---------------------------------------------------------------------------
+
+# Measured on TPU v5e by tools/tune_flash.py (round 4): (seq, head_dim) →
+# (block_q, block_k) for fwd; bwd uses the same table. Shapes not listed
+# fall back to the 512/512 heuristic (clipped to S).
+_BLOCK_TABLE = {
+    (1024, 64): (512, 512),
+    (2048, 64): (512, 512),
+    (4096, 64): (512, 512),
+    (8192, 64): (512, 512),
+    (1024, 128): (512, 512),
+    (2048, 128): (512, 512),
+    (4096, 128): (512, 512),
+}
+
+
 def _pick_block(s, target):
     blk = min(s, target)
     while s % blk:
         blk //= 2
     return max(blk, 1)
+
+
+def _resolve_blocks(s, d, block_q, block_k):
+    # precedence: explicit argument > env override > tuned table. Env must
+    # not clobber explicit args or tools/tune_flash.py would sweep one
+    # env-pinned size into a bogus uniform table.
+    if block_q is None:
+        env_q = os.environ.get("MXNET_FLASH_BLOCK_Q")
+        block_q = int(env_q) if env_q else None
+    if block_k is None:
+        env_k = os.environ.get("MXNET_FLASH_BLOCK_K")
+        block_k = int(env_k) if env_k else None
+    if block_q is None or block_k is None:
+        tq, tk = _BLOCK_TABLE.get((s, d), (512, 512))
+        block_q = block_q if block_q is not None else tq
+        block_k = block_k if block_k is not None else tk
+    return _pick_block(s, block_q), _pick_block(s, block_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -233,6 +461,10 @@ def _flash_fwd(q, k, v, offset, scale, causal, block_q, block_k, interpret):
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    impl = os.environ.get("MXNET_FLASH_BWD", "auto")
+    use_pallas = impl == "pallas" or (impl == "auto" and not interpret)
+    if use_pallas:
+        return _bwd_pallas(scale, causal, block_q, block_k, interpret, res, g)
     return _bwd_blocked(scale, causal, block_k, res, g)
 
 
@@ -244,7 +476,7 @@ def _use_interpret():
 
 
 def flash_attention_with_lse(q, k, v, causal=False, scale=None, offset=0,
-                             block_q=256, block_k=256):
+                             block_q=None, block_k=None):
     """(out, lse) — lse feeds ring attention's cross-device block combine.
 
     ``offset`` (int scalar, may be traced): causal visibility is
@@ -253,14 +485,13 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None, offset=0,
     d = q.shape[-1]
     s = q.shape[-2]
     scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
-    bq = _pick_block(s, block_q)
-    bk = _pick_block(s, block_k)
+    bq, bk = _resolve_blocks(s, d, block_q, block_k)
     offset = jnp.asarray(offset, jnp.int32)
     return _flash(q, k, v, offset, scale, causal, bq, bk, _use_interpret())
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=256):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None):
     """Flash attention. q,k,v: (B, H, S, D) → (B, H, S, D)."""
     out, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
                                       block_q=block_q, block_k=block_k)

@@ -47,8 +47,11 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
             raise ValueError(f"{n} devices not divisible by {known}")
         axes[wild] = n // known
         known *= axes[wild]
-    if known != n:
+    if known > n:
         raise ValueError(f"mesh axes {axes} need {known} devices, have {n}")
+    # fully-specified mesh smaller than the host: take the first `known`
+    # devices (reference analog: ctx=[mx.gpu(i) for i in ...] picks a subset)
+    devices = devices[:known]
     names = [a for a in AXIS_ORDER if a in axes] + \
             [a for a in axes if a not in AXIS_ORDER]
     shape = tuple(axes[a] for a in names)

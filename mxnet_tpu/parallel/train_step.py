@@ -214,6 +214,10 @@ class ShardedTrainer:
         out_shardings = (NamedSharding(self.mesh, P()), self._param_shardings,
                          in_shardings[1])
         donate = (0, 1) if self._donate else ()
+        # kept for profiling harnesses (tools/profile_lm.py): the un-jitted
+        # step can be lax.scan-chained to time pure device work with one
+        # dispatch, which per-call wall timing through the axon tunnel can't
+        self._raw_step_fn = step_fn
         return jax.jit(step_fn, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=donate)
 

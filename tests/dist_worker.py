@@ -81,6 +81,30 @@ def main():
         np.testing.assert_allclose(out.asnumpy(), np.full(shape, 0.5 * n))
         kv.set_gradient_compression({"type": "none"})
 
+    # --- row_sparse: each worker pushes rows {rank, rank+1} with value
+    # rank+1; the aggregate per row is exactly the sum of contributions
+    # (reference tests/nightly/dist_sync_kvstore.py sparse section — TBV)
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    vocab, dim = n + 2, 3
+    kv.init("emb", nd.zeros((vocab, dim)))
+    kv.barrier()
+    dense = np.zeros((vocab, dim), np.float32)
+    dense[rank] = rank + 1.0
+    dense[rank + 1] = rank + 1.0
+    kv.push("emb", RowSparseNDArray.from_dense(nd.array(dense)))
+    kv.barrier()
+    sp_out = nd.zeros((vocab, dim))
+    kv.row_sparse_pull("emb", out=sp_out,
+                       row_ids=nd.array(np.arange(vocab).astype(np.int32)))
+    expect_emb = np.zeros((vocab, dim), np.float32)
+    for r in range(n):
+        expect_emb[r] += r + 1.0
+        expect_emb[r + 1] += r + 1.0
+    np.testing.assert_allclose(sp_out.asnumpy(), expect_emb, rtol=1e-6)
+    kv.barrier()
+
     # --- optimizer-on-store: w -= lr * sum(grads), identically on all ranks
     kv2_key = "opt_w"
     kv.init(kv2_key, nd.array(np.ones(shape, np.float32)))
@@ -92,12 +116,12 @@ def main():
     if mode == "dist_sync":
         expect = 1.0 - 0.1 * expect_sum
         np.testing.assert_allclose(out.asnumpy(), np.full(shape, expect),
-                                   rtol=1e-6)
+                                   rtol=1e-6, atol=1e-6)
     else:
         # async: n sequential sgd steps, one per worker's push
         expect = 1.0 - 0.1 * expect_sum
         np.testing.assert_allclose(out.asnumpy(), np.full(shape, expect),
-                                   rtol=1e-5)
+                                   rtol=1e-5, atol=1e-6)
 
     kv.barrier()
     print(f"dist_worker rank {rank}/{n} mode={mode}: OK", flush=True)

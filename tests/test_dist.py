@@ -31,6 +31,22 @@ def test_dist_sync_three_workers():
     assert out.count("OK") == 3, out[-2000:]
 
 
+def test_dist_sync_four_workers():
+    """n=4 known-value run (VERDICT r3 item 6: dist testing stopped at 3
+    processes; the reference nightly runs more — dist_sync_kvstore.py TBV).
+    Covers dense sum, row_sparse, 2-bit compression, optimizer-on-store."""
+    out = _run_launcher(["-n", "4"], "dist_sync", timeout=360)
+    assert out.count("OK") == 4, out[-2000:]
+
+
+def test_dist_async_four_workers_native_ps():
+    ps_bin = os.path.join(REPO, "native", "build", "mxtpu_ps_server")
+    if not os.path.exists(ps_bin):
+        pytest.skip("native PS server not built")
+    out = _run_launcher(["-n", "4", "-s", "1"], "dist_async", timeout=360)
+    assert out.count("OK") == 4, out[-2000:]
+
+
 def test_dist_async_three_workers_native_ps():
     ps_bin = os.path.join(REPO, "native", "build", "mxtpu_ps_server")
     if not os.path.exists(ps_bin):

@@ -1,0 +1,69 @@
+"""Run every baseline example script FOR REAL (VERDICT r3 item 4): tiny
+config, synthetic data, CPU, 1-3 actual training steps through each
+script's own main/fit path, asserting finite loss from the script's own
+log output. The reference's example scripts are its de-facto acceptance
+tests (reference example/image-classification/ — TBV); `--help` smoke
+proved nothing when round 2's pipeline ran 42× slow.
+
+MXNET_FORCE_PLATFORM=cpu pins the subprocess backend (the image preloads
+jax with JAX_PLATFORMS=axon via sitecustomize, so plain env vars are too
+late — mxnet_tpu/__init__.py applies the config.update at import).
+"""
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CASES = [
+    ("bert", "example/bert/pretrain.py",
+     ["--model", "tiny", "--vocab-size", "100", "--batch-size", "2",
+      "--seq-len", "16", "--steps", "2", "--mesh", "dp=1"],
+     r"step \d+ loss ([\d.eE+-]+|nan|inf)"),
+    ("bert_mesh8", "example/bert/pretrain.py",
+     ["--model", "tiny", "--vocab-size", "100", "--batch-size", "8",
+      "--seq-len", "16", "--steps", "2", "--mesh", "dp=2,sp=2,tp=2"],
+     r"step \d+ loss ([\d.eE+-]+|nan|inf)"),
+    ("word_lm", "example/rnn/word_lm/train.py",
+     ["--emsize", "16", "--nhid", "16", "--nlayers", "1", "--epochs", "1",
+      "--batch-size", "4", "--bptt", "8", "--max-batches", "2",
+      "--vocab-size", "50"],
+     r"epoch \d+ done: loss ([\d.eE+-]+|nan|inf)"),
+    ("transformer", "example/transformer/train.py",
+     ["--units", "32", "--hidden", "64", "--layers", "1", "--heads", "2",
+      "--vocab-size", "100", "--batch-size", "2", "--seq-len", "16",
+      "--steps", "2"],
+     r"step \d+ loss ([\d.eE+-]+|nan|inf)"),
+    ("ssd", "example/ssd/train.py",
+     ["--num-classes", "3", "--batch-size", "2", "--image-size", "64",
+      "--steps", "2"],
+     r"step \d+ loss ([\d.eE+-]+|nan|inf)"),
+    ("imagenet_module", "example/image-classification/train_imagenet.py",
+     ["--network", "resnet", "--num-layers", "18", "--batch-size", "2",
+      "--max-batches", "2", "--image-shape", "3,32,32",
+      "--num-epochs", "1"],
+     r"Train-accuracy=([\d.eE+-]+|nan)"),
+]
+
+
+@pytest.mark.parametrize("name,script,args,loss_re",
+                         CASES, ids=[c[0] for c in CASES])
+def test_example_trains_a_step(name, script, args, loss_re):
+    env = dict(os.environ)
+    env["MXNET_FORCE_PLATFORM"] = "cpu"
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, script)] + args,
+        capture_output=True, text=True, timeout=560, env=env, cwd="/")
+    assert r.returncode == 0, f"{script} rc={r.returncode}:\n{r.stderr[-2000:]}"
+    text = r.stdout + r.stderr
+    matches = re.findall(loss_re, text)
+    assert matches, (f"{script}: no loss line matching {loss_re!r} in "
+                     f"output:\n{text[-2000:]}")
+    val = float(matches[-1])
+    import math
+
+    assert math.isfinite(val), f"{script}: non-finite loss {val}"

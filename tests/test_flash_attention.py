@@ -23,8 +23,13 @@ def test_flash_matches_plain(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("bwd", ["blocked", "pallas"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_grads_match_plain(causal):
+def test_flash_grads_match_plain(causal, bwd, monkeypatch):
+    """Both backwards: the plain-JAX blocked fallback AND the Pallas kernel
+    (interpret mode on CPU) — the Pallas path is the production default on
+    real TPU and must not ship untested."""
+    monkeypatch.setenv("MXNET_FLASH_BWD", bwd)
     q, k, v = (_rand((1, 2, 128, 32), i) for i in range(3))
 
     def loss(fn):
@@ -37,6 +42,46 @@ def test_flash_grads_match_plain(causal):
                      argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ref, g_out):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
+
+
+@pytest.mark.parametrize("bwd", ["blocked", "pallas"])
+@pytest.mark.parametrize("offset", [-4, -8, 4, 0])
+def test_flash_grads_with_offset(offset, bwd, monkeypatch):
+    """Dynamic causal offsets (ring attention's visiting-block geometry),
+    incl. NEGATIVE offsets unaligned to block_q where some rows are fully
+    masked — the case whose lse=-inf rows once overflowed the Pallas
+    backward to NaN."""
+    from mxnet_tpu.ops.flash_attention import flash_attention_with_lse
+
+    monkeypatch.setenv("MXNET_FLASH_BWD", bwd)
+    s = 16
+    q, k, v = (_rand((1, 1, s, 16), i) for i in range(3))
+
+    def ref(qq, kk, vv):
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qq, kk) / np.sqrt(16)
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(s)[None, :]
+        sc = jnp.where(rows + offset >= cols, sc, -1e30)
+        w = jax.nn.softmax(sc, axis=-1)
+        w = jnp.where(rows[None, None] + offset >= 0, w, 0.0)  # dead rows
+        return jnp.einsum("bhqk,bhkd->bhqd", w, vv)
+
+    def fl(qq, kk, vv):
+        out, _ = flash_attention_with_lse(qq, kk, vv, causal=True,
+                                          offset=offset, block_q=8,
+                                          block_k=8)
+        return out
+
+    np.testing.assert_allclose(np.asarray(fl(q, k, v)),
+                               np.asarray(ref(q, k, v)), atol=2e-5)
+    g_ref = jax.grad(lambda *a: (ref(*a) ** 2).sum(), argnums=(0, 1, 2))(
+        q, k, v)
+    g_out = jax.grad(lambda *a: (fl(*a) ** 2).sum(), argnums=(0, 1, 2))(
+        q, k, v)
+    for a, b in zip(g_ref, g_out):
+        bb = np.asarray(b)
+        assert np.isfinite(bb).all(), f"non-finite grads offset={offset}"
+        np.testing.assert_allclose(bb, np.asarray(a), atol=5e-4)
 
 
 def test_lse_matches_logsumexp():

@@ -17,7 +17,112 @@ def _fn(name):
 
 
 def test_registry_size():
-    assert len(_REGISTRY) >= 380, len(_REGISTRY)
+    assert len(_REGISTRY) >= 470, len(_REGISTRY)
+
+
+def test_no_registered_op_raises_notimplemented():
+    """Every registered op has a real implementation: none may be a raise
+    stub, i.e. have `raise NotImplementedError` as its first executable
+    statement (VERDICT r3 item 8: Correlation was the last such stub).
+    Conditional raises inside real implementations (e.g. jnp.round's out=
+    rejection) are fine."""
+    import ast
+    import inspect
+    import textwrap
+
+    for name, op in _REGISTRY.items():
+        try:
+            src = textwrap.dedent(inspect.getsource(op.fn))
+            tree = ast.parse(src)
+        except (OSError, TypeError, SyntaxError):
+            continue
+        fn_def = next((n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))), None)
+        if fn_def is None or not fn_def.body:
+            continue
+        body = fn_def.body
+        # skip docstring
+        if (isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            body = body[1:]
+        if not body:
+            continue
+        first = body[0]
+        is_stub = (isinstance(first, ast.Raise)
+                   and isinstance(first.exc, ast.Call)
+                   and getattr(first.exc.func, "id", "")
+                   == "NotImplementedError")
+        assert not is_stub, f"{name} is a raise-only stub"
+
+
+def test_correlation_matches_naive():
+    """FlowNet Correlation vs a brute-force reference (multiply + abs-diff,
+    kernels 1/3, strides, padding). Reference src/operator/correlation-inl.h
+    semantics (TBV — mount empty)."""
+    import math
+
+    def ref_corr(d1, d2, ks, md, s1, s2, pad, mult=True):
+        n, c, h, w = d1.shape
+        kr = (ks - 1) // 2
+        border = md + kr
+        ph, pw = h + 2 * pad, w + 2 * pad
+        oh = math.ceil((ph - 2 * border) / s1)
+        ow = math.ceil((pw - 2 * border) / s1)
+        ngr = md // s2
+        ngw = 2 * ngr + 1
+        p1 = np.zeros((n, c, ph, pw))
+        p1[:, :, pad:pad + h, pad:pad + w] = d1
+        p2 = np.zeros((n, c, ph, pw))
+        p2[:, :, pad:pad + h, pad:pad + w] = d2
+        out = np.zeros((n, ngw * ngw, oh, ow))
+        for b in range(n):
+            for i in range(oh):
+                for j in range(ow):
+                    y1, x1 = i * s1 + border, j * s1 + border
+                    for pi in range(-ngr, ngr + 1):
+                        for qi in range(-ngr, ngr + 1):
+                            ch = (pi + ngr) * ngw + (qi + ngr)
+                            y2, x2 = y1 + pi * s2, x1 + qi * s2
+                            acc = 0.0
+                            for u in range(-kr, kr + 1):
+                                for v in range(-kr, kr + 1):
+                                    for cc in range(c):
+                                        a = p1[b, cc, y1 + u, x1 + v]
+                                        inb = (0 <= y2 + u < ph
+                                               and 0 <= x2 + v < pw)
+                                        bb = p2[b, cc, y2 + u, x2 + v] \
+                                            if inb else 0.0
+                                        acc += a * bb if mult else abs(a - bb)
+                            out[b, ch, i, j] = acc / (ks * ks * c)
+        return out
+
+    rng = np.random.RandomState(0)
+    for (ks, md, s1, s2, pad, mult) in [(1, 2, 1, 1, 2, True),
+                                        (3, 2, 1, 2, 3, True),
+                                        (1, 1, 2, 1, 1, False)]:
+        d1 = rng.rand(2, 3, 8, 9).astype(np.float32)
+        d2 = rng.rand(2, 3, 8, 9).astype(np.float32)
+        got = np.asarray(_fn("Correlation")(
+            jnp.asarray(d1), jnp.asarray(d2), ks, md, s1, s2, pad, mult))
+        want = ref_corr(d1, d2, ks, md, s1, s2, pad, mult)
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_correlation_grads_flow():
+    d1 = jnp.asarray(np.random.RandomState(1).rand(1, 2, 6, 6)
+                     .astype(np.float32))
+    d2 = jnp.asarray(np.random.RandomState(2).rand(1, 2, 6, 6)
+                     .astype(np.float32))
+
+    def loss(a, b):
+        return (_fn("Correlation")(a, b, 1, 1, 1, 1, 1, True) ** 2).sum()
+
+    g1, g2 = jax.grad(loss, argnums=(0, 1))(d1, d2)
+    assert np.isfinite(np.asarray(g1)).all()
+    assert np.asarray(g2).any()
 
 
 # ---------------------------------------------------------------------- multi

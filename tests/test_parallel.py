@@ -22,8 +22,13 @@ def test_make_mesh():
     assert par.mesh_axes(mesh) == {"dp": 2, "tp": 4}
     mesh = par.make_mesh({"dp": -1, "tp": 2})
     assert par.mesh_axes(mesh) == {"dp": 4, "tp": 2}
+    # fully-specified mesh smaller than the host takes a device subset
+    # (reference analog: ctx=[mx.gpu(i) for i in ...])
+    mesh = par.make_mesh({"dp": 3})
+    assert par.mesh_axes(mesh) == {"dp": 3}
+    assert mesh.devices.size == 3
     with pytest.raises(ValueError):
-        par.make_mesh({"dp": 3})
+        par.make_mesh({"dp": 16})
 
 
 def test_sharding_rules_pruning():

@@ -1,0 +1,53 @@
+"""Slope-based on-chip timing through the axon tunnel.
+
+A dispatched+synced program costs ~80-140 ms of FIXED round-trip through
+the tunnel (measured by tools/tunnel_cost_probe.py), so any single-shot or
+shallow-chain measurement is noise. The only reliable device time is the
+SLOPE between two scan-chain depths of the same jitted body:
+
+    t_per_iter = (T(n2) - T(n1)) / (n2 - n1)
+
+Both chains share one compiled body; the fixed cost cancels. best_of
+repeats guard against host contention on the 1-core VM.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["chain_total", "slope_time"]
+
+
+def _sync(r):
+    # block_until_ready is unreliable through the axon tunnel; a host fetch
+    # of one element is the only dependable sync
+    np.asarray(jax.device_get(jnp.ravel(jax.tree_util.tree_leaves(r)[0])[:1]))
+
+
+def chain_total(step, carry0, iters, best_of=3):
+    @jax.jit
+    def chain(c):
+        def body(c, _):
+            return step(c), None
+        out, _ = jax.lax.scan(body, c, None, length=iters)
+        return out
+
+    r = chain(carry0)
+    _sync(r)
+    best = float("inf")
+    for _ in range(best_of):
+        t0 = time.perf_counter()
+        r = chain(carry0)
+        _sync(r)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def slope_time(step, carry0, n1=20, n2=100, best_of=3):
+    """Per-iteration device time of `step`, fixed tunnel cost cancelled."""
+    t1 = chain_total(step, carry0, n1, best_of)
+    t2 = chain_total(step, carry0, n2, best_of)
+    return max((t2 - t1) / (n2 - n1), 1e-9)

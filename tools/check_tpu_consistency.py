@@ -6,14 +6,19 @@ TBV, SURVEY.md §4 calls this "the single most important idea to copy").
 pytest runs force the CPU backend (tests/conftest.py), so the TPU leg runs
 here as a standalone sweep on the real chip:
 
-    python tools/check_tpu_consistency.py            # all groups
-    python tools/check_tpu_consistency.py --ops nn   # one group
+    python tools/check_tpu_consistency.py                 # all groups
+    python tools/check_tpu_consistency.py --ops nn        # one group
+    python tools/check_tpu_consistency.py --json OUT.json # artifact
 
-Exit code 0 = every op matched CPU within tolerance.
+Round 4 (VERDICT r3 item 5): ≥100 cases spanning every §2.2 family, plus
+bf16 tolerance-band variants of the MXU-critical ops and seeded random ops
+(jax PRNG streams are platform-invariant, so same-seed equality is exact).
+Exit code 0 = every case matched CPU within tolerance.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -23,63 +28,346 @@ import numpy as np  # noqa: E402
 
 
 def _cases(rng):
-    """(group, name, fn(nd, *arrays), input arrays) — representative ops
-    from every §2.2 family."""
-    x = rng.rand(4, 8).astype(np.float32)
+    """(group, name, fn(nd, *arrays), inputs, kwargs-for-check) covering
+    every §2.2 family."""
+    x = rng.rand(4, 8).astype(np.float32) + 0.1
+    xs = rng.randn(4, 8).astype(np.float32)
+    pos = np.abs(rng.rand(4, 8).astype(np.float32)) + 0.1
     img = rng.rand(2, 3, 8, 8).astype(np.float32)
     w = rng.rand(4, 3, 3, 3).astype(np.float32)
     fc_w = rng.rand(16, 8).astype(np.float32)
     seq = rng.rand(6, 2, 4).astype(np.float32)
     idx = np.array([1, 0, 2, 1], np.float32)
-    return [
-        ("elemwise", "exp+mul", lambda nd, a: nd.exp(a) * 0.5 + a, [x]),
-        ("elemwise", "erf", lambda nd, a: nd.erf(a), [x]),
-        ("reduce", "sum_axis", lambda nd, a: nd.sum(a, axis=1), [x]),
-        ("reduce", "norm", lambda nd, a: nd.norm(a), [x]),
-        ("matrix", "dot", lambda nd, a, b: nd.dot(a, b.T), [x, x]),
-        ("matrix", "batch_dot",
-         lambda nd, a, b: nd.batch_dot(a.reshape((2, 2, 8)),
-                                       b.reshape((2, 8, 2))), [x, x]),
-        ("nn", "FullyConnected",
-         lambda nd, a, w_: nd.FullyConnected(a, w_, num_hidden=16,
-                                             no_bias=True), [x, fc_w]),
-        ("nn", "Convolution",
-         lambda nd, a, w_: nd.Convolution(a, w_, kernel=(3, 3), num_filter=4,
-                                          pad=(1, 1), no_bias=True),
-         [img, w]),
-        ("nn", "Pooling",
-         lambda nd, a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
-                                  pool_type="max"), [img]),
-        ("nn", "softmax", lambda nd, a: nd.softmax(a, axis=-1), [x]),
-        ("nn", "LayerNorm",
-         lambda nd, a, g, b: nd.LayerNorm(a, g, b, axis=-1),
-         [x, np.ones(8, np.float32), np.zeros(8, np.float32)]),
-        ("indexing", "take", lambda nd, a, i: nd.take(a, i), [x, idx]),
-        ("indexing", "one_hot",
-         lambda nd, i: nd.one_hot(i, depth=4), [idx]),
-        ("ordering", "topk",
-         lambda nd, a: nd.topk(a, k=3, ret_typ="value"), [x]),
-        ("ordering", "sort", lambda nd, a: nd.sort(a, axis=-1), [x]),
-        ("sequence", "SequenceReverse",
-         lambda nd, s: nd.SequenceReverse(s), [seq]),
-        ("contrib", "box_nms",
-         lambda nd, d: nd.contrib.box_nms(d.reshape((1, 4, 6)),
-                                          overlap_thresh=0.5),
-         [np.abs(rng.rand(24).astype(np.float32))]),
-        ("optimizer", "adam_update",
-         lambda nd, w_, g, m, v: nd.adam_update(w_, g, m, v, lr=0.01)[0],
-         [x, x * 0.1, np.zeros_like(x), np.zeros_like(x)]),
-        ("image", "to_tensor",
-         lambda nd, a: nd.image.to_tensor((a * 255).astype("uint8")),
-         [rng.rand(8, 8, 3).astype(np.float32)]),
-        ("quant", "quantize_v2",
-         lambda nd, a: nd.contrib.quantize_v2(a)[0].astype("float32"), [x]),
+    cases = []
+
+    def add(group, name, fn, inputs, **kw):
+        cases.append((group, name, fn, inputs, kw))
+
+    # ---------------- elemwise unary (the long tail) ----------------
+    # TPU transcendental units approximate log/log1p/gammaln-family ops to
+    # ~2-4e-4 relative vs the CPU libm path (measured on v5e, round 4) —
+    # the same reason the reference gives fp16 its own band. Ops built on
+    # log get rtol=1e-3; everything else holds the tight 1e-4 default.
+    LOG_BAND = dict(rtol=1e-3, atol=1e-5)
+    unary_simple = [
+        "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt", "rsqrt",
+        "cbrt", "square", "abs", "sign", "floor", "ceil", "round", "trunc",
+        "rint", "fix", "sigmoid", "erf", "relu", "softsign", "gamma",
+        "gammaln", "reciprocal",
     ]
+    log_family = {"log", "log2", "log10", "log1p", "gammaln"}
+    for name in unary_simple:
+        add("elemwise", name,
+            (lambda nd, a, _n=name: getattr(nd, _n)(a)), [pos],
+            **(LOG_BAND if name in log_family else {}))
+    trig = ["sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh",
+            "cosh", "tanh", "arcsinh", "arctanh", "degrees", "radians"]
+    for name in trig:
+        add("elemwise", name,
+            (lambda nd, a, _n=name: getattr(nd, _n)(a * 0.5)), [x - 0.5],
+            **(LOG_BAND if name in ("arcsinh", "arctanh") else {}))
+    add("elemwise", "arccosh", lambda nd, a: nd.arccosh(a + 1.0), [pos],
+        **LOG_BAND)
+    add("elemwise", "clip", lambda nd, a: nd.clip(a, a_min=0.2, a_max=0.8), [x])
+    add("elemwise", "gelu_tanh", lambda nd, a: nd.gelu(a), [xs])
+    add("elemwise", "hard_sigmoid", lambda nd, a: nd.hard_sigmoid(a), [xs])
+    add("elemwise", "softrelu", lambda nd, a: nd.Activation(
+        a, act_type="softrelu"), [xs], **LOG_BAND)
+
+    # ---------------- elemwise binary / broadcast ----------------
+    binary = ["broadcast_add", "broadcast_sub", "broadcast_mul",
+              "broadcast_div", "broadcast_maximum", "broadcast_minimum",
+              "broadcast_power", "broadcast_hypot"]
+    for name in binary:
+        add("broadcast", name,
+            (lambda nd, a, b, _n=name: getattr(nd, _n)(a, b[:1] + 0.5)),
+            [pos, pos])
+    cmp_ops = ["broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+               "broadcast_lesser", "broadcast_greater_equal",
+               "broadcast_lesser_equal"]
+    for name in cmp_ops:
+        add("broadcast", name,
+            (lambda nd, a, b, _n=name: getattr(nd, _n)(
+                nd.round(a * 4), nd.round(b[:1] * 4))), [x, x])
+    add("broadcast", "where",
+        lambda nd, c, a, b: nd.where(c > 0.5, a, b), [x, x, pos])
+    add("elemwise", "maximum_scalar",
+        lambda nd, a: nd._maximum_scalar(a, scalar=0.4), [x])
+    add("elemwise", "power_scalar", lambda nd, a: a ** 2.5, [pos])
+    add("elemwise", "rminus_scalar", lambda nd, a: 1.0 - a, [x])
+    add("elemwise", "rdiv_scalar", lambda nd, a: 2.0 / a, [pos])
+    add("elemwise", "mod", lambda nd, a, b: nd.broadcast_mod(
+        nd.round(a * 10) + 1, nd.round(b[:1] * 3) + 1), [pos, pos])
+
+    # ---------------- reductions ----------------
+    for name in ["sum", "mean", "prod", "max", "min"]:
+        add("reduce", f"{name}_axis1",
+            (lambda nd, a, _n=name: getattr(nd, _n)(a, axis=1)), [x])
+        add("reduce", f"{name}_all",
+            (lambda nd, a, _n=name: getattr(nd, _n)(a)), [x])
+    add("reduce", "nansum", lambda nd, a: nd.nansum(a, axis=0), [x])
+    add("reduce", "norm_ord2", lambda nd, a: nd.norm(a, ord=2, axis=1), [x])
+    add("reduce", "argmax", lambda nd, a: nd.argmax(a, axis=1), [x])
+    add("reduce", "argmin", lambda nd, a: nd.argmin(a, axis=1), [x])
+    add("reduce", "logsumexp",
+        lambda nd, a: nd.log(nd.sum(nd.exp(a), axis=1)), [x])
+
+    # ---------------- matrix / linalg ----------------
+    add("matrix", "dot", lambda nd, a, b: nd.dot(a, b.T), [x, x])
+    add("matrix", "dot_T", lambda nd, a, b: nd.dot(a.T, b), [x, x])
+    add("matrix", "batch_dot",
+        lambda nd, a, b: nd.batch_dot(a.reshape((2, 2, 8)),
+                                      b.reshape((2, 8, 2))), [x, x])
+    add("matrix", "transpose", lambda nd, a: nd.transpose(a), [x])
+    add("matrix", "reshape_slice",
+        lambda nd, a: nd.slice(a.reshape((8, 4)), begin=(2, 1),
+                               end=(6, 3)), [x])
+    add("matrix", "diag", lambda nd, a: nd.diag(a), [x])
+    add("matrix", "linalg_gemm2",
+        lambda nd, a, b: nd.linalg_gemm2(a, b, transpose_b=True), [x, x])
+    add("matrix", "linalg_syrk",
+        lambda nd, a: nd.linalg_syrk(a, transpose=False), [x])
+    add("matrix", "linalg_potrf",
+        lambda nd, a: nd.linalg_potrf(
+            nd.dot(a, a.T) + 8.0 * nd.one_hot(
+                nd.arange(4), depth=4)), [x], rtol=1e-3, atol=1e-4)
+    add("matrix", "histogram",
+        lambda nd, a: nd.histogram(a, bin_cnt=5, range=(0.0, 1.0))[0]
+        .astype("float32"), [x])
+
+    # ---------------- nn core ----------------
+    add("nn", "FullyConnected",
+        lambda nd, a, w_: nd.FullyConnected(a, w_, num_hidden=16,
+                                            no_bias=True), [x, fc_w])
+    add("nn", "Convolution_3x3",
+        lambda nd, a, w_: nd.Convolution(a, w_, kernel=(3, 3), num_filter=4,
+                                         pad=(1, 1), no_bias=True), [img, w])
+    add("nn", "Convolution_stride2",
+        lambda nd, a, w_: nd.Convolution(a, w_, kernel=(3, 3), num_filter=4,
+                                         stride=(2, 2), no_bias=True),
+        [img, w])
+    add("nn", "Convolution_grouped",
+        lambda nd, a, w_: nd.Convolution(
+            a, w_, kernel=(3, 3), num_filter=3,
+            num_group=3, pad=(1, 1), no_bias=True),
+        [img, rng.rand(3, 1, 3, 3).astype(np.float32)])
+    add("nn", "Deconvolution",
+        lambda nd, a, w_: nd.Deconvolution(
+            a, w_, kernel=(3, 3), num_filter=4, no_bias=True),
+        [img, rng.rand(3, 4, 3, 3).astype(np.float32)])
+    add("nn", "Pooling_max",
+        lambda nd, a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                 pool_type="max"), [img])
+    add("nn", "Pooling_avg",
+        lambda nd, a: nd.Pooling(a, kernel=(3, 3), stride=(2, 2),
+                                 pad=(1, 1), pool_type="avg"), [img])
+    add("nn", "Pooling_global",
+        lambda nd, a: nd.Pooling(a, global_pool=True, pool_type="avg"),
+        [img])
+    add("nn", "softmax", lambda nd, a: nd.softmax(a, axis=-1), [x])
+    add("nn", "log_softmax", lambda nd, a: nd.log_softmax(a, axis=-1), [x])
+    add("nn", "softmax_temp",
+        lambda nd, a: nd.softmax(a, axis=-1, temperature=2.0), [x])
+    add("nn", "LayerNorm",
+        lambda nd, a, g, b: nd.LayerNorm(a, g, b, axis=-1),
+        [x, np.ones(8, np.float32), np.zeros(8, np.float32)])
+    add("nn", "BatchNorm_inference",
+        lambda nd, a, g, b, m, v: nd.BatchNorm(
+            a, g, b, m, v, use_global_stats=True),
+        [img, np.ones(3, np.float32), np.zeros(3, np.float32),
+         np.zeros(3, np.float32), np.ones(3, np.float32)])
+    add("nn", "InstanceNorm",
+        lambda nd, a, g, b: nd.InstanceNorm(a, g, b),
+        [img, np.ones(3, np.float32), np.zeros(3, np.float32)])
+    add("nn", "L2Normalization",
+        lambda nd, a: nd.L2Normalization(a, mode="instance"), [x])
+    add("nn", "LRN", lambda nd, a: nd.LRN(a, nsize=3), [img])
+    add("nn", "UpSampling",
+        lambda nd, a: nd.UpSampling(a, scale=2, sample_type="nearest"),
+        [img])
+    for act in ["relu", "sigmoid", "tanh"]:
+        add("nn", f"Activation_{act}",
+            (lambda nd, a, _t=act: nd.Activation(a, act_type=_t)), [xs])
+    add("nn", "LeakyReLU",
+        lambda nd, a: nd.LeakyReLU(a, act_type="leaky", slope=0.1), [xs])
+    add("nn", "PReLU",
+        lambda nd, a, g: nd.LeakyReLU(a, g, act_type="prelu"),
+        [xs, np.full((8,), 0.2, np.float32)])
+    add("nn", "Embedding",
+        lambda nd, i, w_: nd.Embedding(i, w_, input_dim=16, output_dim=8),
+        [idx, fc_w])
+    add("nn", "SoftmaxOutput",
+        lambda nd, a, l: nd.SoftmaxOutput(a, l), [x, idx])
+    add("nn", "Correlation",
+        lambda nd, a, b: nd.Correlation(a, b, kernel_size=1,
+                                        max_displacement=1, pad_size=1),
+        [img, img * 0.5])
+
+    # ---------------- indexing / ordering ----------------
+    add("indexing", "take", lambda nd, a, i: nd.take(a, i), [x, idx])
+    add("indexing", "one_hot", lambda nd, i: nd.one_hot(i, depth=4), [idx])
+    add("indexing", "gather_nd",
+        lambda nd, a, i: nd.gather_nd(a, i.reshape((1, 4)).astype("int32")),
+        [x, idx])
+    add("indexing", "slice_axis",
+        lambda nd, a: nd.slice_axis(a, axis=1, begin=2, end=6), [x])
+    add("indexing", "reverse", lambda nd, a: nd.reverse(a, axis=1), [x])
+    add("indexing", "tile", lambda nd, a: nd.tile(a, reps=(2, 1)), [x])
+    add("indexing", "pick",
+        lambda nd, a, i: nd.pick(a, i, axis=1), [x, idx])
+    add("ordering", "topk_value",
+        lambda nd, a: nd.topk(a, k=3, ret_typ="value"), [x])
+    add("ordering", "topk_indices",
+        lambda nd, a: nd.topk(a, k=3).astype("float32"), [x])
+    add("ordering", "sort", lambda nd, a: nd.sort(a, axis=-1), [x])
+    add("ordering", "argsort",
+        lambda nd, a: nd.argsort(a, axis=-1).astype("float32"), [x])
+
+    # ---------------- sequence / rnn ----------------
+    add("sequence", "SequenceReverse",
+        lambda nd, s: nd.SequenceReverse(s), [seq])
+    add("sequence", "SequenceMask",
+        lambda nd, s, l: nd.SequenceMask(s, l, use_sequence_length=True,
+                                         value=-1.0),
+        [seq, np.array([3, 5], np.float32)])
+    add("sequence", "SequenceLast",
+        lambda nd, s, l: nd.SequenceLast(s, l, use_sequence_length=True),
+        [seq, np.array([3, 5], np.float32)])
+    rnn_x = rng.rand(5, 2, 4).astype(np.float32)
+
+    def _rnn(nd, xx, mode, state_size, ngates):
+        h = 3
+        n_params = ngates * h * (4 + h + 2)
+        if mode == "lstm":
+            n_params = 4 * h * (4 + h + 2)
+        params = np.linspace(-0.1, 0.1, n_params).astype(np.float32)
+        init_h = nd.zeros((1, 2, h))
+        args = [xx, nd.array(params), init_h]
+        if mode == "lstm":
+            args.append(nd.zeros((1, 2, h)))
+        return nd.RNN(*args, state_size=h, num_layers=1, mode=mode)
+
+    add("rnn", "RNN_lstm", lambda nd, xx: _rnn(nd, xx, "lstm", 3, 4),
+        [rnn_x], rtol=1e-3, atol=1e-4)
+    add("rnn", "RNN_gru", lambda nd, xx: _rnn(nd, xx, "gru", 3, 3),
+        [rnn_x], rtol=1e-3, atol=1e-4)
+
+    # ---------------- loss / output ----------------
+    add("loss", "MakeLoss", lambda nd, a: nd.MakeLoss(nd.square(a)), [x])
+    add("loss", "smooth_l1", lambda nd, a: nd.smooth_l1(a, scalar=1.0), [xs])
+    add("loss", "CTCLoss",
+        lambda nd, a, l: nd.CTCLoss(a, l)[0]
+        if isinstance(nd.CTCLoss(a, l), (tuple, list)) else nd.CTCLoss(a, l),
+        [rng.rand(6, 2, 5).astype(np.float32),
+         np.array([[1, 2], [2, 3]], np.float32)], rtol=1e-3, atol=1e-4)
+
+    # ---------------- contrib ----------------
+    add("contrib", "box_nms",
+        lambda nd, d: nd.contrib.box_nms(d.reshape((1, 4, 6)),
+                                         overlap_thresh=0.5),
+        [np.abs(rng.rand(24).astype(np.float32))])
+    add("contrib", "boolean_mask",
+        lambda nd, a, m: nd.contrib.boolean_mask(a, nd.round(m[:, 0])),
+        [x, np.array([[1], [0], [1], [1]], np.float32)])
+    add("contrib", "multibox_prior",
+        lambda nd, a: nd.contrib.MultiBoxPrior(a, sizes=(0.5, 0.25),
+                                               ratios=(1, 2)), [img])
+    add("contrib", "roi_align",
+        lambda nd, a, r: nd.contrib.ROIAlign(a, r, pooled_size=(2, 2),
+                                             spatial_scale=1.0),
+        [img, np.array([[0, 1, 1, 6, 6]], np.float32)])
+    add("contrib", "deformable_conv_zero_offset",
+        lambda nd, a, w_, o: nd.contrib.DeformableConvolution(
+            a, o, w_, kernel=(3, 3), num_filter=4, pad=(1, 1),
+            no_bias=True),
+        [img, w, np.zeros((2, 18, 8, 8), np.float32)],
+        rtol=1e-3, atol=1e-4)
+    add("contrib", "index_copy",
+        lambda nd, a, i, t: nd.contrib.index_copy(
+            a, i.astype("int32"), t),
+        [x, np.array([0, 2], np.float32), rng.rand(2, 8).astype(np.float32)])
+
+    # ---------------- image / quantization ----------------
+    add("image", "to_tensor",
+        lambda nd, a: nd.image.to_tensor((a * 255).astype("uint8")),
+        [rng.rand(8, 8, 3).astype(np.float32)])
+    add("image", "normalize",
+        lambda nd, a: nd.image.normalize(a, mean=(0.5, 0.5, 0.5),
+                                         std=(0.25, 0.25, 0.25)), [img[0]])
+    add("image", "resize",
+        lambda nd, a: nd.image.resize(a.transpose((1, 2, 0)), size=4),
+        [img[0]])
+    add("image", "flip_lr",
+        lambda nd, a: nd.image.flip_left_right(a.transpose((1, 2, 0))),
+        [img[0]])
+    add("quant", "quantize_v2",
+        lambda nd, a: nd.contrib.quantize_v2(a)[0].astype("float32"), [x])
+    add("quant", "quantize_dequantize",
+        lambda nd, a: nd.contrib.dequantize(
+            *nd.contrib.quantize_v2(a, min_calib_range=0.0,
+                                    max_calib_range=1.0)), [x])
+
+    # ---------------- optimizer updates ----------------
+    add("optimizer", "sgd_mom_update",
+        lambda nd, w_, g, m: nd.sgd_mom_update(w_, g, m, lr=0.01,
+                                               momentum=0.9)[0],
+        [x, x * 0.1, np.zeros_like(x)])
+    add("optimizer", "adam_update",
+        lambda nd, w_, g, m, v: nd.adam_update(w_, g, m, v, lr=0.01)[0],
+        [x, x * 0.1, np.zeros_like(x), np.zeros_like(x)])
+    add("optimizer", "ftrl_update",
+        lambda nd, w_, g, z, n_: nd.ftrl_update(w_, g, z, n_, lr=0.01)[0],
+        [x, x * 0.1, np.zeros_like(x), np.zeros_like(x)])
+    add("optimizer", "lamb_phase1",
+        lambda nd, w_, g, m, v: nd.lamb_update_phase1(
+            w_, g, m, v, t=1, wd=0.01)[0],
+        [x, x * 0.1, np.zeros_like(x), np.zeros_like(x)])
+
+    # ---------------- control flow ----------------
+    add("control", "foreach_cumsum",
+        lambda nd, s: nd.contrib.foreach(
+            lambda d, st: (d + st[0], [d + st[0]]), s,
+            [nd.zeros((2, 4))])[0], [seq])
+
+    # ---------------- bf16 tolerance-band variants (MXU-critical ops) ----
+    bf16 = dict(dtypes=("bfloat16",), rtol=2e-2, atol=2e-2)
+    add("bf16", "dot", lambda nd, a, b: nd.dot(a, b.T), [x, x], **bf16)
+    add("bf16", "FullyConnected",
+        lambda nd, a, w_: nd.FullyConnected(a, w_, num_hidden=16,
+                                            no_bias=True), [x, fc_w], **bf16)
+    add("bf16", "Convolution",
+        lambda nd, a, w_: nd.Convolution(a, w_, kernel=(3, 3), num_filter=4,
+                                         pad=(1, 1), no_bias=True),
+        [img, w], **bf16)
+    add("bf16", "softmax", lambda nd, a: nd.softmax(a, axis=-1), [x], **bf16)
+    add("bf16", "exp", lambda nd, a: nd.exp(a), [x], **bf16)
+    add("bf16", "LayerNorm",
+        lambda nd, a, g, b: nd.LayerNorm(a, g, b, axis=-1),
+        [x, np.ones(8, np.float32), np.zeros(8, np.float32)], **bf16)
+    add("bf16", "batch_dot",
+        lambda nd, a, b: nd.batch_dot(a.reshape((2, 2, 8)),
+                                      b.reshape((2, 8, 2))), [x, x], **bf16)
+    add("bf16", "Pooling_avg",
+        lambda nd, a: nd.Pooling(a, kernel=(2, 2), stride=(2, 2),
+                                 pool_type="avg"), [img], **bf16)
+
+    return cases
+
+
+def _random_cases():
+    """Seeded random ops: jax PRNG streams are platform-invariant, so the
+    same MXNET_SEED must produce IDENTICAL samples on CPU and TPU."""
+    return [("random", name, name) for name in
+            ["uniform", "normal", "gamma", "exponential"]]
 
 
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--ops", default=None, help="only this group")
+    p.add_argument("--json", default=None, help="write artifact JSON here")
+    p.add_argument("--self-check", action="store_true",
+                   help="cpu-vs-cpu dry run (validates the case table "
+                        "without a chip; used by the test suite)")
     args = p.parse_args(argv)
 
     import jax
@@ -88,26 +376,68 @@ def main(argv=None):
     from mxnet_tpu.test_utils import check_consistency
 
     platforms = {d.platform for d in jax.devices()}
-    if not platforms & {"tpu", "axon"}:
+    if args.self_check:
+        pass  # case-table validation runs anywhere
+    elif not platforms & {"tpu", "axon"}:
         print("no TPU visible — nothing to cross-check")
         return 0
 
     rng = np.random.RandomState(0)
+    results = []
     failures = []
     n = 0
-    for group, name, fn, inputs in _cases(rng):
+    for group, name, fn, inputs, kw in _cases(rng):
         if args.ops and group != args.ops:
             continue
         n += 1
         try:
+            second = mx.cpu() if args.self_check else mx.tpu(0)
             check_consistency(
                 lambda *arrs, _f=fn: _f(mx.nd, *arrs), inputs,
-                ctx_list=[mx.cpu(), mx.tpu(0)])
+                ctx_list=[mx.cpu(), second], **kw)
             print(f"OK   {group:<10} {name}")
+            results.append({"group": group, "op": name, "ok": True})
         except Exception as e:  # noqa: BLE001 - report and continue
             failures.append((group, name, str(e)[:200]))
             print(f"FAIL {group:<10} {name}: {str(e)[:120]}")
+            results.append({"group": group, "op": name, "ok": False,
+                            "error": str(e)[:300]})
+
+    # seeded random ops: exact equality CPU vs TPU under one seed
+    for group, name, dist in _random_cases():
+        if args.ops and group != args.ops:
+            continue
+        n += 1
+        try:
+            draws = []
+            ctxs = ((mx.cpu(), mx.cpu()) if args.self_check
+                    else (mx.cpu(), mx.tpu(0)))
+            for ctx in ctxs:
+                mx.random.seed(1234, ctx=ctx)
+                kw2 = {"shape": (3, 4), "ctx": ctx}
+                out = getattr(mx.nd.random, dist)(**kw2)
+                draws.append(np.asarray(out.asnumpy(), np.float32))
+            vals = draws
+            np.testing.assert_allclose(vals[0], vals[1], rtol=1e-6, atol=1e-6)
+            print(f"OK   {group:<10} {name} (same-seed exact)")
+            results.append({"group": group, "op": name, "ok": True})
+        except Exception as e:  # noqa: BLE001
+            failures.append((group, name, str(e)[:200]))
+            print(f"FAIL {group:<10} {name}: {str(e)[:120]}")
+            results.append({"group": group, "op": name, "ok": False,
+                            "error": str(e)[:300]})
+
     print(f"\n{n - len(failures)}/{n} ops consistent TPU vs CPU")
+    if args.json:
+        payload = {
+            "n_cases": n,
+            "n_ok": n - len(failures),
+            "device": jax.devices()[0].device_kind,
+            "results": results,
+        }
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.json}")
     if n == 0:
         print(f"no cases matched --ops {args.ops!r}")
         return 2  # an empty sweep must not read as a pass

@@ -1,0 +1,117 @@
+"""On-chip breakdown of the seq-2048 LM step: where does the time go?
+
+Each component is slope-timed (tools/_chiptime.py: difference of two
+scan-chain depths of the same jitted body — the ~100 ms fixed axon-tunnel
+dispatch cost cancels; single-shot or shallow-chain wall timing through the
+tunnel measures only that fixed cost).  Prints a JSON breakdown so the
+flash-attention work (VERDICT r3 item 1) is driven by data.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools._chiptime import slope_time  # noqa: E402
+
+
+def main():
+    from mxnet_tpu.ops.flash_attention import flash_attention
+    from mxnet_tpu.ops.attention import plain_attention
+
+    B, H, S, D = 4, 12, 2048, 64
+    U, HID, VOCAB = 768, 3072, 32000
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
+
+    out = {}
+
+    # attention FLOPs (causal => half the blocks visible): fwd = 2 matmuls
+    attn_fwd_flops = 2 * 2 * S * S * D * B * H / 2
+    attn_bwd_flops = attn_fwd_flops * 2.5  # 5 matmuls in bwd vs 2 in fwd
+
+    def rep(name, step, carry0, flops, n1=10, n2=50):
+        t = slope_time(step, carry0, n1, n2)
+        out[f"{name}_ms"] = round(t * 1e3, 3)
+        if flops:
+            out[f"{name}_tflops"] = round(flops / t / 1e12, 1)
+        print(f"  {name}: {out[f'{name}_ms']} ms", file=sys.stderr)
+
+    rep("flash_fwd", lambda c: flash_attention(c, k, v, causal=True), q,
+        attn_fwd_flops)
+    rep("plain_fwd", lambda c: plain_attention(c, k, v, causal=True), q,
+        attn_fwd_flops)
+
+    def fgrad(c):
+        f = lambda qq: (flash_attention(qq, k, v, causal=True)
+                        .astype(jnp.float32) ** 2).sum()
+        return jax.grad(f)(c).astype(jnp.bfloat16)
+
+    rep("flash_fwdbwd", fgrad, q, attn_fwd_flops * 2 + attn_bwd_flops)
+
+    def pgrad(c):
+        f = lambda qq: (plain_attention(qq, k, v, causal=True)
+                        .astype(jnp.float32) ** 2).sum()
+        return jax.grad(f)(c).astype(jnp.bfloat16)
+
+    rep("plain_fwdbwd", pgrad, q, attn_fwd_flops * 2 + attn_bwd_flops)
+
+    # MLP-ish matmul inventory of 12 layers: qkv+proj+ffn1+ffn2, fwd+bwd
+    x = jax.random.normal(key, (B * S, U), jnp.bfloat16)
+    w_qkv = jax.random.normal(key, (U, 3 * U), jnp.bfloat16)
+    w_proj = jax.random.normal(key, (U, U), jnp.bfloat16)
+    w1 = jax.random.normal(key, (U, HID), jnp.bfloat16)
+    w2 = jax.random.normal(key, (HID, U), jnp.bfloat16)
+    prec = jax.lax.Precision.DEFAULT
+
+    def mlp12(xx):
+        for _ in range(12):
+            h = jnp.dot(xx, w_qkv, precision=prec)[:, :U]
+            h = jnp.dot(h, w_proj, precision=prec)
+            h = jnp.dot(jax.nn.gelu(jnp.dot(h, w1, precision=prec)),
+                        w2, precision=prec)
+            xx = xx + h
+        return (xx.astype(jnp.float32) ** 2).sum()
+
+    mlp_flops = 3 * 12 * 2 * (U * U + U * U + 2 * U * HID) * B * S
+    rep("mlp12_fwdbwd",
+        lambda c: jax.grad(mlp12)(c).astype(jnp.bfloat16), x, mlp_flops,
+        4, 16)
+
+    # LM head + CE
+    wv = jax.random.normal(key, (U, VOCAB), jnp.bfloat16)
+    labels = jax.random.randint(key, (B * S,), 0, VOCAB)
+
+    def head(xx):
+        logits = jnp.dot(xx, wv, precision=prec)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        nll = lse - jnp.take_along_axis(
+            logits.astype(jnp.float32), labels[:, None], axis=-1)[:, 0]
+        return nll.mean()
+
+    head_flops = 3 * 2 * B * S * U * VOCAB
+    rep("head_ce_fwdbwd",
+        lambda c: jax.grad(head)(c).astype(jnp.bfloat16), x, head_flops)
+
+    # embedding grad (scatter-add over 32k rows)
+    ids = jax.random.randint(key, (B, S), 0, VOCAB)
+
+    def embed(e):
+        return (e[ids].astype(jnp.float32) ** 2).sum()
+
+    emb = jax.random.normal(key, (VOCAB, U), jnp.bfloat16)
+    rep("embed_grad",
+        lambda c: jax.grad(embed)(c).astype(jnp.bfloat16), emb, None)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

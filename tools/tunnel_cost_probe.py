@@ -1,0 +1,67 @@
+"""Decompose chain timing: fixed dispatch/sync cost vs true per-iter cost.
+
+For each workload, times scan-chains of depth 1/20/100 (and 256 for the big
+matmul): slope = real per-iteration device time, intercept = fixed
+dispatch+sync round-trip through the axon tunnel. This probe is the
+calibration source for the ~100 ms fixed-cost figure quoted in bench.py
+and tools/_chiptime.py (whose primitives it shares).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import jax.experimental.pallas as pl
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools._chiptime import chain_total  # noqa: E402
+
+
+def main():
+    out = {}
+    key = jax.random.PRNGKey(0)
+
+    x = jax.random.normal(key, (8, 128), jnp.float32)
+
+    def copy_kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    cp = pl.pallas_call(copy_kern,
+                        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))
+    out["tiny_pallas"] = {str(n): round(chain_total(cp, x, n) * 1e3, 2)
+                          for n in (1, 20, 100)}
+
+    def xla_tiny(c):
+        return c + 1.0
+
+    out["tiny_xla"] = {str(n): round(chain_total(xla_tiny, x, n) * 1e3, 2)
+                       for n in (1, 20, 100)}
+
+    a = jax.random.normal(key, (4096, 4096), jnp.bfloat16)
+
+    def xla_big(c):
+        return jnp.dot(c, a, precision=jax.lax.Precision.DEFAULT)
+
+    big = {}
+    for n in (1, 20, 100, 256):
+        t = chain_total(xla_big, a, n)
+        big[str(n)] = {"total_ms": round(t * 1e3, 2),
+                       "tflops_naive": round(2 * 4096 ** 3 * n / t / 1e12, 1)}
+    out["matmul_4096"] = big
+    # slope between 100 and 256 isolates true per-iter time
+    t100 = big["100"]["total_ms"]
+    t256 = big["256"]["total_ms"]
+    per_iter = (t256 - t100) / 156
+    out["matmul_4096_slope_tflops"] = round(
+        2 * 4096 ** 3 / (per_iter / 1e3) / 1e12, 1)
+    out["fixed_cost_est_ms"] = round(t100 - 100 * per_iter, 2)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
