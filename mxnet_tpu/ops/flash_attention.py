@@ -76,30 +76,21 @@ def _dotA(a, b, prec):
                            preferred_element_type=jnp.float32, precision=prec)
 
 
-def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
-                scale, causal, block_q):
-    """Grid (BH, S // block_q). q block resident; stream K/V blocks.
+def _fwd_core(q, load_kv, offset, q_start, s_total, block_k, scale, causal):
+    """Shared fwd tile loop: one resident q block vs streamed K/V blocks.
 
     Phase split: blocks [0, nk_full) are fully visible (no mask math);
     blocks [nk_full, nk_run) get the causal iota mask. Softmax statistics
     are tracked in the log2 domain on raw (unscaled) scores; the scale
-    folds into the exp2 argument.
+    folds into the exp2 argument. ``load_kv(j) -> (k_blk, v_blk)`` hides
+    the ref slicing.
+    Returns (normalized out f32, lse).
     """
-    import jax.experimental.pallas as pl
-
-    q_blk_idx = pl.program_id(1)
-    # Keep q/k/v in their storage dtype for the MXU dots (bf16×bf16 with f32
-    # accumulation runs at full MXU rate; pre-casting to f32 would quarter
-    # it) — only the softmax statistics live in f32.
-    q = q_ref[0]                                      # (bq, D)
     bq, d = q.shape
-    s_total = k_ref.shape[1]
     nk = s_total // block_k
-    offset = off_ref[0]
     prec = _dot_prec(q.dtype)
     c = scale * _LOG2E  # exp(s*scale - m) == exp2((s - m_raw) * c)
     if causal:
-        q_start = q_blk_idx * block_q
         # fully-visible: every col of block j visible to every row ⇔
         # (j+1)*bk - 1 <= q_start + offset
         nk_full = jnp.clip((q_start + offset - block_k + 1) // block_k + 1,
@@ -113,11 +104,10 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
 
     def tile(j, carry, masked):
         acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        k_blk, v_blk = load_kv(j)
         s = _dotT(q, k_blk, prec)                      # raw scores (bq,bk)
         if masked:
-            rows = q_blk_idx * block_q + lax.broadcasted_iota(
+            rows = q_start + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             cols = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
@@ -144,11 +134,31 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
     # pollution. One per-row select repairs them — no per-element guard.
     row_ok = m > _NEG_INF / 2
     safe_l = jnp.maximum(l, 1e-30)
-    o_ref[0] = jnp.where(row_ok[:, None], acc / safe_l[:, None],
-                         0.0).astype(o_ref.dtype)
+    out = jnp.where(row_ok[:, None], acc / safe_l[:, None], 0.0)
     lse = jnp.where(row_ok & (l > 0), m * scale + jnp.log(safe_l), _NEG_INF)
+    return out, lse
+
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                scale, causal, block_q):
+    """Grid (BH, S // block_q) over split (BH, S, D) tensors."""
+    import jax.experimental.pallas as pl
+
+    q_blk_idx = pl.program_id(1)
+    # Keep q/k/v in their storage dtype for the MXU dots (bf16×bf16 with f32
+    # accumulation runs at full MXU rate; pre-casting to f32 would quarter
+    # it) — only the softmax statistics live in f32.
+    q = q_ref[0]                                      # (bq, D)
+
+    def load_kv(j):
+        return (k_ref[0, pl.ds(j * block_k, block_k), :],
+                v_ref[0, pl.ds(j * block_k, block_k), :])
+
+    out, lse = _fwd_core(q, load_kv, off_ref[0], q_blk_idx * block_q,
+                         k_ref.shape[1], block_k, scale, causal)
+    o_ref[0] = out.astype(o_ref.dtype)
     # lse lives in an (bq, 8)-lane block purely to satisfy TPU tiling
-    lse_ref[0] = jnp.broadcast_to(lse[:, None], (bq, 8))
+    lse_ref[0] = jnp.broadcast_to(lse[:, None], (lse.shape[0], 8))
 
 
 def _sds(shape, dtype, like):
@@ -216,30 +226,22 @@ def _fwd_pallas(q, k, v, offset, scale, causal, block_q, block_k, interpret):
 # Backward
 # ---------------------------------------------------------------------------
 
-def _bwd_kernel(off_ref, q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
-                dq_ref, dk_ref, dv_ref, *, block_q, block_k, scale, causal):
-    """Grid (BH, S // block_k). K/V block resident; loops over Q blocks.
+def _bwd_core(j, k_blk, v_blk, loads, dq_rw, offset, s_total, block_q,
+              block_k, scale, causal):
+    """Shared bwd tile loop: K/V block resident; loops over Q blocks.
 
-    dQ accumulates into a full-sequence VMEM output block: the TPU grid is
-    sequential per core, and dq's index map ignores the kv-block index, so
-    the buffer stays live across j steps (initialized at j == 0).
     dS = P ∘ (dP − δ + dlse) with δ = rowsum(dO ∘ O) precomputed outside.
+    ``loads(i) -> (q_blk, do_blk, lse_blk, dl_blk)``;
+    ``dq_rw = (read_dq(i), write_dq(i, val))`` accumulates dQ into a
+    VMEM-resident output block (legal: the TPU grid runs sequentially per
+    core and dq's index map ignores the kv-block index).
+    Returns (dk_acc, dv_acc) f32.
     """
-    import jax.experimental.pallas as pl
-
-    j = pl.program_id(1)
-    k_blk = k_ref[0]                                   # (bk, D)
-    v_blk = v_ref[0]
     bk, d = k_blk.shape
-    s_total = q_ref.shape[1]
     nq = s_total // block_q
-    offset = off_ref[0]
     prec = _dot_prec(k_blk.dtype)
     c = scale * _LOG2E
-
-    @pl.when(j == 0)
-    def _init():
-        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+    read_dq, write_dq = dq_rw
 
     if causal:
         # first q block with any visible row: i*bq + bq-1 + offset >= j*bk
@@ -254,10 +256,7 @@ def _bwd_kernel(off_ref, q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
 
     def tile(i, carry, masked):
         dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :][:, 0]  # (bq,)
-        dl_blk = dl_ref[0, pl.ds(i * block_q, block_q), :][:, 0]  # δ - g_lse
+        q_blk, do_blk, lse_blk, dl_blk = loads(i)
         s = _dotT(q_blk, k_blk, prec)                  # raw scores (bq,bk)
         if masked:
             rows = i * block_q + lax.broadcasted_iota(
@@ -284,17 +283,39 @@ def _bwd_kernel(off_ref, q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
         dsd = ds.astype(q_blk.dtype)
         dv_acc = dv_acc + _dotA(pd, do_blk, prec)      # (bk,D)
         dk_acc = dk_acc + _dotA(dsd, q_blk, prec)      # (bk,D)
-        dq_cur = dq_ref[0, pl.ds(i * block_q, block_q), :]
-        dq_ref[0, pl.ds(i * block_q, block_q), :] = dq_cur + jnp.dot(
-            dsd, k_blk, preferred_element_type=jnp.float32, precision=prec)
+        write_dq(i, read_dq(i) + jnp.dot(
+            dsd, k_blk, preferred_element_type=jnp.float32, precision=prec))
         return dk_acc, dv_acc
 
     z = jnp.zeros((bk, d), jnp.float32)
     carry = lax.fori_loop(i_start, i_full,
                           functools.partial(tile, masked=True), (z, z))
-    dk_acc, dv_acc = lax.fori_loop(i_full, nq,
-                                   functools.partial(tile, masked=False),
-                                   carry)
+    return lax.fori_loop(i_full, nq,
+                         functools.partial(tile, masked=False), carry)
+
+
+def _bwd_kernel(off_ref, q_ref, do_ref, lse_ref, dl_ref, k_ref, v_ref,
+                dq_ref, dk_ref, dv_ref, *, block_q, block_k, scale, causal):
+    """Grid (BH, S // block_k) over split (BH, S, D) tensors."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    def loads(i):
+        sl = pl.ds(i * block_q, block_q)
+        return (q_ref[0, sl, :], do_ref[0, sl, :],
+                lse_ref[0, sl, :][:, 0], dl_ref[0, sl, :][:, 0])
+
+    dq_rw = (lambda i: dq_ref[0, pl.ds(i * block_q, block_q), :],
+             lambda i, val: dq_ref.__setitem__(
+                 (0, pl.ds(i * block_q, block_q), slice(None)), val))
+    dk_acc, dv_acc = _bwd_core(j, k_ref[0], v_ref[0], loads, dq_rw,
+                               off_ref[0], q_ref.shape[1], block_q, block_k,
+                               scale, causal)
     dk_ref[0] = dk_acc.astype(dk_ref.dtype)
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 

@@ -169,3 +169,68 @@ def test_image_record_iter_uint8(tmp_path):
     np.testing.assert_array_equal(bu.label[0].asnumpy(), bf.label[0].asnumpy())
     with pytest.raises(ValueError):
         ImageRecordIter(dtype="float16", **kw)
+
+
+def test_prefetching_iter_order_and_full_epoch():
+    """Multi-worker prefetch must deliver every batch of the epoch in the
+    backing iterator's order (offsets reserved at submit time) — round-4
+    regression guard: worker races once dropped trailing batches and
+    scrambled order."""
+    import numpy as onp
+
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+
+    n, bs = 64, 8
+    data = onp.arange(n * 2, dtype=onp.float32).reshape(n, 2)
+    base = NDArrayIter({"data": data}, {"softmax_label": onp.zeros(n)},
+                       batch_size=bs, shuffle=False,
+                       last_batch_handle="discard")
+    it = PrefetchingIter(base, prefetch=3)
+    seen = []
+    for epoch in range(2):
+        while True:
+            try:
+                b = next(it)
+            except StopIteration:
+                it.reset()
+                break
+            seen.append(onp.asarray(b.data[0].asnumpy())[:, 0])
+        assert len(seen) == (epoch + 1) * (n // bs)
+    flat = onp.concatenate(seen)
+    expect = onp.tile(onp.arange(0, n * 2, 2, dtype=onp.float32), 2)
+    onp.testing.assert_array_equal(flat, expect)
+    it.close()
+
+
+def test_image_record_iter_prefetch_deterministic_seeds(tmp_path):
+    """_advance() reserves the augmentation seed under the lock: a 2-worker
+    prefetched epoch must decode the same bytes as a serial epoch when
+    augmentation is off."""
+    import numpy as onp
+
+    from mxnet_tpu.io import ImageRecordIter, PrefetchingIter
+    from mxnet_tpu.io.recordio import MXIndexedRecordIO, pack_img, IRHeader
+
+    path = str(tmp_path / "d")
+    rec = MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rng = onp.random.RandomState(0)
+    for i in range(32):
+        img = rng.randint(0, 255, (40, 40, 3), onp.uint8)
+        rec.write_idx(i, pack_img(IRHeader(0, float(i), i, 0), img,
+                                  quality=90, img_fmt=".jpg"))
+    rec.close()
+
+    def run(workers):
+        it = ImageRecordIter(path_imgrec=path + ".rec",
+                             data_shape=(3, 32, 32), batch_size=8,
+                             shuffle=False, rand_crop=False,
+                             rand_mirror=False, resize=32,
+                             preprocess_threads=1, dtype="uint8")
+        pf = PrefetchingIter(it, prefetch=3, num_threads=workers)
+        out = []
+        for b in pf:
+            out.append(onp.asarray(b.data[0].asnumpy()))
+        pf.close()
+        return onp.concatenate(out)
+
+    onp.testing.assert_array_equal(run(1), run(2))

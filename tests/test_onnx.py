@@ -1,0 +1,181 @@
+"""ONNX interop round-trip (contrib/onnx.py, no onnx package needed):
+export a CNN symbol graph to real ONNX protobuf bytes, re-import it, and
+check executor outputs match. Reference python/mxnet/contrib/onnx tests
+pattern (TBV — mount empty)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as onnx_mx
+
+
+def _small_cnn():
+    data = mx.sym.Variable("data")
+    w1 = mx.sym.Variable("conv1_weight")
+    c1 = mx.sym.Convolution(data, w1, kernel=(3, 3), num_filter=8,
+                            pad=(1, 1), no_bias=True, name="conv1")
+    gamma = mx.sym.Variable("bn1_gamma")
+    beta = mx.sym.Variable("bn1_beta")
+    mean = mx.sym.Variable("bn1_moving_mean")
+    var = mx.sym.Variable("bn1_moving_var")
+    bn = mx.sym.BatchNorm(c1, gamma, beta, mean, var, fix_gamma=False,
+                          name="bn1")
+    act = mx.sym.Activation(bn, act_type="relu", name="relu1")
+    pool = mx.sym.Pooling(act, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max", name="pool1")
+    fcw = mx.sym.Variable("fc1_weight")
+    fcb = mx.sym.Variable("fc1_bias")
+    fc = mx.sym.FullyConnected(pool, fcw, fcb, num_hidden=10, name="fc1")
+    return mx.sym.softmax(fc, axis=-1, name="out")
+
+
+def _params(rng):
+    return {
+        "conv1_weight": mx.nd.array(rng.randn(8, 3, 3, 3).astype(np.float32)
+                                    * 0.1),
+        "bn1_gamma": mx.nd.array(rng.rand(8).astype(np.float32) + 0.5),
+        "bn1_beta": mx.nd.array(rng.randn(8).astype(np.float32) * 0.1),
+        "bn1_moving_mean": mx.nd.array(rng.randn(8).astype(np.float32) * 0.1),
+        "bn1_moving_var": mx.nd.array(rng.rand(8).astype(np.float32) + 0.5),
+        "fc1_weight": mx.nd.array(rng.randn(10, 8 * 4 * 4)
+                                  .astype(np.float32) * 0.1),
+        "fc1_bias": mx.nd.array(rng.randn(10).astype(np.float32) * 0.1),
+    }
+
+
+def _forward(sym, params, x, aux=None):
+    args = dict(params)
+    args["data"] = x
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    ex = sym.bind(mx.cpu(),
+                  {n: args[n] for n in arg_names},
+                  aux_states={n: (aux or params)[n] for n in aux_names}
+                  if aux_names else None)
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def test_onnx_roundtrip_cnn(tmp_path):
+    rng = np.random.RandomState(0)
+    sym = _small_cnn()
+    params = _params(rng)
+    path = str(tmp_path / "model.onnx")
+    out_path = onnx_mx.export_model(sym, params, (1, 3, 8, 8),
+                                    onnx_file_path=path)
+    assert out_path == path
+    blob = open(path, "rb").read()
+    assert len(blob) > 2000  # weights are really in there
+
+    sym2, arg_params, aux_params = onnx_mx.import_model(path)
+    x = mx.nd.array(rng.rand(1, 3, 8, 8).astype(np.float32))
+    ref = _forward(sym, params, x)
+    merged = dict(arg_params)
+    merged.update(aux_params)
+    got = _forward(sym2, merged, x, aux=merged)
+    assert ref.shape == got.shape == (1, 10)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # probabilities: the Softmax really made it through
+    np.testing.assert_allclose(got.sum(axis=-1), 1.0, rtol=1e-5)
+
+
+def test_onnx_bytes_are_valid_protobuf(tmp_path):
+    """The emitted bytes parse as a ModelProto with ir_version/opset/graph
+    under an independent decode (our own reader)."""
+    from mxnet_tpu.contrib import _onnx_proto as P
+
+    rng = np.random.RandomState(1)
+    path = str(tmp_path / "m.onnx")
+    onnx_mx.export_model(_small_cnn(), _params(rng), (1, 3, 8, 8),
+                         onnx_file_path=path)
+    model = P.parse_message(open(path, "rb").read())
+    assert model[1][0] == 7  # ir_version
+    opset = P.parse_message(model[8][0])
+    assert P.ints_of(opset[2]) == [9]
+    graph = P.parse_message(model[7][0])
+    node_ops = [P.string_of(P.parse_message(n)[4][0]) for n in graph[1]]
+    assert "Conv" in node_ops and "Gemm" in node_ops \
+        and "BatchNormalization" in node_ops
+    # initializers carry the conv weights verbatim
+    names = []
+    for raw in graph[5]:
+        f = P.parse_message(raw)
+        names.append(P.string_of(f[8][0]))
+    assert "conv1_weight" in names
+
+
+def test_onnx_elemwise_and_global_pool(tmp_path):
+    rng = np.random.RandomState(2)
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    c = mx.sym.Convolution(data, w, kernel=(1, 1), num_filter=4,
+                           no_bias=True, name="c")
+    s = mx.sym.broadcast_add(c, c, name="dbl")
+    g = mx.sym.Pooling(s, global_pool=True, pool_type="avg", kernel=(1, 1),
+                       name="gap")
+    f = mx.sym.Flatten(g, name="fl")
+    params = {"w": mx.nd.array(rng.randn(4, 3, 1, 1).astype(np.float32))}
+    path = str(tmp_path / "m2.onnx")
+    onnx_mx.export_model(g, params, (2, 3, 5, 5), onnx_file_path=path)
+    sym2, arg_params, aux_params = onnx_mx.import_model(path)
+    x = mx.nd.array(rng.rand(2, 3, 5, 5).astype(np.float32))
+    ref = _forward(g, params, x)
+    got = _forward(sym2, dict(arg_params), x)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_unsupported_op_raises(tmp_path):
+    data = mx.sym.Variable("data")
+    bad = mx.sym.erf(data, name="e")
+    with pytest.raises(ValueError, match="no ONNX mapping"):
+        onnx_mx.export_model(bad, {}, (2, 2),
+                             onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_onnx_fix_gamma_exports_ones(tmp_path):
+    """fix_gamma=True BatchNorms ignore stored gamma (forced to 1); the
+    exported initializer must carry the ones, not the stale values."""
+    data = mx.sym.Variable("data")
+    gamma = mx.sym.Variable("g")
+    beta = mx.sym.Variable("b")
+    mean = mx.sym.Variable("m")
+    var = mx.sym.Variable("v")
+    bn = mx.sym.BatchNorm(data, gamma, beta, mean, var, fix_gamma=True,
+                          name="bn")
+    rng = np.random.RandomState(0)
+    params = {
+        "g": mx.nd.array(rng.rand(3).astype(np.float32) + 2.0),  # stale != 1
+        "b": mx.nd.array(np.zeros(3, np.float32)),
+        "m": mx.nd.array(np.zeros(3, np.float32)),
+        "v": mx.nd.array(np.ones(3, np.float32)),
+    }
+    path = str(tmp_path / "bn.onnx")
+    onnx_mx.export_model(bn, params, (2, 3, 4, 4), onnx_file_path=path)
+    sym2, arg_params, aux_params = onnx_mx.import_model(path)
+    merged = dict(arg_params)
+    merged.update(aux_params)
+    np.testing.assert_allclose(merged["g"].asnumpy(), np.ones(3), rtol=0)
+    x = mx.nd.array(rng.rand(2, 3, 4, 4).astype(np.float32))
+    ref = _forward(bn, params, x)
+    got = _forward(sym2, merged, x, aux=merged)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_gemm_transb0_rejected(tmp_path):
+    """Imports of unsupported Gemm layouts fail loudly, not silently."""
+    from mxnet_tpu.contrib import _onnx_proto as P
+    from mxnet_tpu.contrib.onnx import _node, _tensor, _value_info, _attr_int
+
+    w = np.ones((4, 3), np.float32)
+    graph = (_node("Gemm", ["data", "w", "b"], ["out"], "g",
+                   _attr_int("transB", 0))
+             + P.field_string(2, "t")
+             + P.field_message(5, _tensor("w", w))
+             + P.field_message(5, _tensor("b", np.zeros(4, np.float32)))
+             + P.field_message(11, _value_info("data", (2, 3)))
+             + P.field_message(12, _value_info("out", ())))
+    model = (P.field_varint(1, 7) + P.field_message(7, graph)
+             + P.field_message(8, P.field_varint(2, 9)))
+    path = str(tmp_path / "t.onnx")
+    open(path, "wb").write(model)
+    with pytest.raises(ValueError, match="transB"):
+        onnx_mx.import_model(path)
