@@ -177,11 +177,12 @@ def bench_resnet_piped(platform, compute_dtype=None):
     native = raw._native is not None
 
     # --- host-floor probe: what can this 1-core host even deliver? ---
-    # (a) decode+augment rate of the iterator alone (no training, no
-    #     prefetch thread contention), (b) host→device wire bandwidth for
-    #     one uint8 batch through the tunnel. The steady-state piped step
-    #     cannot beat max(decode, transfer, device_step); reporting the
-    #     floor makes the piped number falsifiable (VERDICT r3 item 3).
+    # (a) serial rate of the iterator alone (decode+augment+upload — the
+    #     upload is inseparable without bypassing the iterator), (b) wire
+    #     bandwidth for distinct uint8 batches. The tunnel's wire rate
+    #     swings ~10x across hours (10-60 MB/s measured), so these probes
+    #     timestamp the conditions the piped number was taken under
+    #     (VERDICT r3 item 3: make the piped number falsifiable).
     t0 = time.perf_counter()
     probe_batches = 0
     for bb in raw:
@@ -248,11 +249,10 @@ def bench_resnet_piped(platform, compute_dtype=None):
     assert np.isfinite(final), f"non-finite piped loss {final}"
     dt, t_data, t_disp = min(runs)
     spread = (max(r[0] for r in runs) - dt) / dt
-    # steady state cannot beat serial decode (1 CPU core) or the tunnel
-    # wire; the 2-worker prefetcher overlaps two upload streams, so the
-    # wire leg is halved (aggregate bandwidth measured to scale ~linearly
-    # to 2 streams, weakly beyond)
-    host_floor_ips = batch / (max(host_ms, wire_ms / 2) / 1000)
+    # optimistic ceiling: the 2-worker prefetcher can at best halve the
+    # serial iterator time (decode+upload overlapped pairwise); measured
+    # ips should sit at or below this
+    host_floor_ips = batch / (max(host_ms / 2, wire_ms / 2) / 1000)
     return {
         "ips": round(batch / dt, 2),
         "ms_per_batch": round(dt * 1000, 1),
@@ -260,7 +260,7 @@ def bench_resnet_piped(platform, compute_dtype=None):
         "step_dispatch_ms": round(t_disp * 1000, 1),
         "n_runs": len(runs),
         "spread": round(spread, 3),
-        "host_decode_ms_per_batch": round(host_ms, 1),
+        "host_iter_serial_ms_per_batch": round(host_ms, 1),
         "wire_transfer_ms_per_batch": round(wire_ms, 1),
         "host_floor_ips": round(host_floor_ips, 1),
         "native_decode": native,
@@ -438,6 +438,20 @@ def main():
     platform = jax.devices()[0].platform
     device_kind = jax.devices()[0].device_kind
 
+    # Optional legs self-skip past this wall-clock budget so a cold compile
+    # cache can never time the whole bench out of the driver's capture
+    # (round 4: the first cold run exceeded 58 min; warm-cache runs are
+    # several times faster — the persistent XLA cache in ~/.cache makes
+    # every later run warm).
+    t_start = time.perf_counter()
+    budget_s = float(os.environ.get("BENCH_TIME_BUDGET", 2100))
+
+    def over_budget(section):
+        if time.perf_counter() - t_start > budget_s:
+            extra[f"{section}_skipped"] = "time budget exceeded"
+            return True
+        return False
+
     load0 = _loadavg()
     ips, fp32_spread = bench_resnet(platform)
     extra = {"device_kind": device_kind,
@@ -451,7 +465,8 @@ def main():
         extra["resnet50_bf16_spread"] = round(bf16_spread, 3)
     except Exception as e:  # never lose the primary metric
         extra["resnet50_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
-    if platform == "tpu" and os.environ.get("BENCH_FP32_HIGH", "1") != "0":
+    if platform == "tpu" and os.environ.get("BENCH_FP32_HIGH", "1") != "0" \
+            and not over_budget("resnet50_fp32_high"):
         # fp32 storage with 3-pass bf16 matmul emulation (~1e-6 rel err) —
         # the TF32-class mode modern GPU "fp32" baselines actually run;
         # the primary metric above stays true-fp32 (HIGHEST, 6-pass)
@@ -474,11 +489,12 @@ def main():
         extra["resnet50_piped_breakdown"] = piped
     except Exception as e:
         extra["resnet50_piped_error"] = f"{type(e).__name__}: {e}"[:200]
-    try:
-        extra["resnet50_piped_bf16_ips"] = bench_resnet_piped(
-            platform, compute_dtype="bfloat16")["ips"]
-    except Exception as e:
-        extra["resnet50_piped_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("resnet50_piped_bf16"):
+        try:
+            extra["resnet50_piped_bf16_ips"] = bench_resnet_piped(
+                platform, compute_dtype="bfloat16")["ips"]
+        except Exception as e:
+            extra["resnet50_piped_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         peak = _measure_matmul_peak()
         bert = bench_bert(platform)
@@ -508,7 +524,8 @@ def main():
         extra["lm_seq2048_bf16"] = bench_lm_long(platform)
     except Exception as e:
         extra["lm_seq2048_error"] = f"{type(e).__name__}: {e}"[:200]
-    if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0":
+    if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
+            and not over_budget("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's
         # S×S scores are ~3.2 GB f32 — the config flash exists for).
         # batch 1: the axon remote-compile helper crashes (HTTP 500) on the
@@ -527,6 +544,7 @@ def main():
                 os.environ.pop(k, None)
 
     extra["loadavg_end"] = _loadavg()
+    extra["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
     # 1-core VM: loadavg much above 1 means something else was competing
     # with the bench dispatch thread — numbers are then lower bounds
     if max(load0, extra["loadavg_end"]) > 1.5:

@@ -172,14 +172,17 @@ def _export_node(node, in_names, out_name, params, extra_inits):
             op_t = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
             return _node(op_t, in_names, [out_name], nm), True
         kernel = _tuple(a.get("kernel", (1, 1)))
-        stride = _tuple(a.get("stride", kernel), len(kernel))
+        # framework Pooling default stride is 1 (ops/nn.py), NOT kernel
+        stride = _tuple(a.get("stride", (1,) * len(kernel)), len(kernel))
         pad = _tuple(a.get("pad", (0,) * len(kernel)), len(kernel))
         attrs = (_attr_ints("kernel_shape", kernel)
                  + _attr_ints("strides", stride)
                  + _attr_ints("pads", pad + pad))
         op_t = "MaxPool" if ptype == "max" else "AveragePool"
         if ptype == "avg":
-            attrs += _attr_int("count_include_pad", 1)
+            attrs += _attr_int(
+                "count_include_pad",
+                1 if _flag(a.get("count_include_pad", True)) else 0)
         return _node(op_t, in_names, [out_name], nm, attrs), True
     if op in ("softmax", "SoftmaxOutput", "SoftmaxActivation"):
         ins = in_names[:1]
@@ -403,7 +406,10 @@ def import_model(model_file):
             if flatten:
                 data_name = pending_flatten[ins[0]]
             w = inits[ins[1]]
+            # only OUR exporter's synthetic placeholder marks no_bias — a
+            # genuinely all-zero bias in a third-party model must survive
             zero_bias = (len(ins) > 2 and ins[2] in inits
+                         and ins[2].endswith("_zero_bias")
                          and not inits[ins[2]].any())
             syms = [sym_of(data_name), sym_of(ins[1])]
             no_bias = zero_bias or len(ins) <= 2
@@ -447,14 +453,18 @@ def import_model(model_file):
                 k = two("kernel_shape", (1, 1))
                 pads = a.get("pads", [0] * (2 * len(k)))
                 out = S.Pooling(sym_of(ins[0]), kernel=k,
-                                stride=two("strides", k),
+                                stride=two("strides", (1,) * len(k)),
                                 pad=tuple(int(x) for x in pads[:len(k)]),
-                                pool_type=ptype, name=name)
+                                pool_type=ptype,
+                                count_include_pad=bool(
+                                    a.get("count_include_pad", 0)),
+                                name=name)
         elif op == "Softmax":
-            out = S.softmax(sym_of(ins[0]), axis=int(a.get("axis", -1)),
+            # opset-9 default axis is 1 (coerce-to-2D semantics), not -1
+            out = S.softmax(sym_of(ins[0]), axis=int(a.get("axis", 1)),
                             name=name)
         elif op == "LogSoftmax":
-            out = S.log_softmax(sym_of(ins[0]), axis=int(a.get("axis", -1)),
+            out = S.log_softmax(sym_of(ins[0]), axis=int(a.get("axis", 1)),
                                 name=name)
         elif op in ("Add", "Sub", "Mul", "Div"):
             fn = {"Add": S.broadcast_add, "Sub": S.broadcast_sub,

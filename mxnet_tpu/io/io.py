@@ -333,7 +333,8 @@ class ImageRecordIter(DataIter):
         if self._shuffle:
             np.random.shuffle(self._order)
 
-    def _load_one(self, offset):
+    def _load_one(self, offset, rng=None):
+        rng = rng if rng is not None else np.random
         with self._read_lock:  # decode below stays parallel; IO is serialized
             self._rec.record.seek(offset)
             blob = self._rec.read()
@@ -342,10 +343,10 @@ class ImageRecordIter(DataIter):
         if self._resize > 0:
             img = _resize_short(img, self._resize)
         if self._rand_crop:
-            img = _rand_crop(img, h, w)
+            img = _rand_crop(img, h, w, rng)
         else:
             img = _center_crop(img, h, w)
-        if self._rand_mirror and np.random.rand() < 0.5:
+        if self._rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
         if self.dtype == "uint8":
             chw = img.transpose(2, 0, 1)
@@ -388,11 +389,16 @@ class ImageRecordIter(DataIter):
                 self._native = None  # e.g. PNG records → PIL fallback
         import concurrent.futures as cf
 
+        # per-image RandomStates derived from the batch's reserved seed:
+        # the PIL fallback stays deterministic per (seed, position) even
+        # with concurrent prefetch workers (no global-RNG races)
+        rngs = [np.random.RandomState((seed + 31 * i) % (2 ** 31))
+                for i in range(len(offsets))]
         if self._threads > 1:
             with cf.ThreadPoolExecutor(self._threads) as pool:
-                results = list(pool.map(self._load_one, offsets))
+                results = list(pool.map(self._load_one, offsets, rngs))
         else:
-            results = [self._load_one(o) for o in offsets]
+            results = [self._load_one(o, r) for o, r in zip(offsets, rngs)]
         data = np.stack([r[0] for r in results])
         label = np.stack([r[1] for r in results])
         return DataBatch([nd_array(data)], [nd_array(label)], 0, None)
@@ -460,13 +466,14 @@ def _center_crop(img, h, w):
     return img[y0:y0 + h, x0:x0 + w]
 
 
-def _rand_crop(img, h, w):
+def _rand_crop(img, h, w, rng=None):
+    rng = rng if rng is not None else np.random
     H, W = img.shape[:2]
     if H < h or W < w:
         img = _pad_to(img, max(h, H), max(w, W))
         H, W = img.shape[:2]
-    y0 = np.random.randint(0, H - h + 1)
-    x0 = np.random.randint(0, W - w + 1)
+    y0 = rng.randint(0, H - h + 1)
+    x0 = rng.randint(0, W - w + 1)
     return img[y0:y0 + h, x0:x0 + w]
 
 
