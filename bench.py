@@ -410,11 +410,12 @@ def bench_lm_long(platform):
             net.initialize()
             loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
             mesh = par.make_mesh({"dp": 1}, devices=jax.devices()[:1])
-            trainer = par.ShardedTrainer(net, loss_fn, mesh,
-                                         rules=bert_sharding_rules(),
-                                         optimizer="adam",
-                                         optimizer_params={"learning_rate": 1e-4},
-                                         compute_dtype="bfloat16")
+            trainer = par.ShardedTrainer(
+                net, loss_fn, mesh, rules=bert_sharding_rules(),
+                optimizer="adam",
+                optimizer_params={"learning_rate": 1e-4},
+                compute_dtype="bfloat16",
+                remat=os.environ.get("BENCH_LM_REMAT") == "1")
             xd = nd.array(x)
             net(xd)
             sec, spread = _time_steps(trainer, lambda i: (xd, xd), steps,

@@ -350,6 +350,11 @@ def import_model(model_file):
     with open(model_file, "rb") as f:
         model = P.parse_message(f.read())
     graph = P.parse_message(model[7][0])
+    opset = 9
+    for raw in model.get(8, []):  # opset_import (default domain)
+        f8 = P.parse_message(raw)
+        if 1 not in f8 or P.string_of(f8[1][0]) in ("", "ai.onnx"):
+            opset = P.ints_of(f8.get(2, [9]))[0]
 
     inits = {}
     for raw in graph.get(5, []):
@@ -385,6 +390,10 @@ def import_model(model_file):
         if op == "Conv":
             k = two("kernel_shape", (1, 1))
             pads = a.get("pads", [0] * (2 * len(k)))
+            if list(pads[:len(k)]) != list(pads[len(k):]):
+                raise ValueError(
+                    f"onnx2mx: asymmetric Conv pads {pads} are not "
+                    "supported (mx Convolution pads symmetrically)")
             no_bias = len(ins) == 2
             args = dict(kernel=k, stride=two("strides", (1,) * len(k)),
                         pad=tuple(int(x) for x in pads[:len(k)]),
@@ -452,6 +461,10 @@ def import_model(model_file):
             else:
                 k = two("kernel_shape", (1, 1))
                 pads = a.get("pads", [0] * (2 * len(k)))
+                if list(pads[:len(k)]) != list(pads[len(k):]):
+                    raise ValueError(
+                        f"onnx2mx: asymmetric pooling pads {pads} are not "
+                        "supported (mx Pooling pads symmetrically)")
                 out = S.Pooling(sym_of(ins[0]), kernel=k,
                                 stride=two("strides", (1,) * len(k)),
                                 pad=tuple(int(x) for x in pads[:len(k)]),
@@ -459,13 +472,21 @@ def import_model(model_file):
                                 count_include_pad=bool(
                                     a.get("count_include_pad", 0)),
                                 name=name)
-        elif op == "Softmax":
-            # opset-9 default axis is 1 (coerce-to-2D semantics), not -1
-            out = S.softmax(sym_of(ins[0]), axis=int(a.get("axis", 1)),
-                            name=name)
-        elif op == "LogSoftmax":
-            out = S.log_softmax(sym_of(ins[0]), axis=int(a.get("axis", 1)),
-                                name=name)
+        elif op in ("Softmax", "LogSoftmax"):
+            # opset >= 13: single-axis semantics, default axis -1 (exact
+            # match to mx softmax). opset < 13: coerce-to-2D semantics —
+            # exactly equivalent to single-axis only when the axis is the
+            # last dim; axis=1 (the old default) coincides for 2D inputs,
+            # which is all our own exporter emits it for. Anything else
+            # cannot be imported faithfully — fail loudly.
+            ax = int(a.get("axis", -1 if opset >= 13 else 1))
+            if opset < 13 and ax not in (-1, 1):
+                raise ValueError(
+                    f"onnx2mx: opset-{opset} {op} with axis={ax} uses "
+                    "coerce-to-2D semantics that single-axis softmax "
+                    "cannot reproduce")
+            fn = S.softmax if op == "Softmax" else S.log_softmax
+            out = fn(sym_of(ins[0]), axis=ax, name=name)
         elif op in ("Add", "Sub", "Mul", "Div"):
             fn = {"Add": S.broadcast_add, "Sub": S.broadcast_sub,
                   "Mul": S.broadcast_mul, "Div": S.broadcast_div}[op]

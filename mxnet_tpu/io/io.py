@@ -391,9 +391,14 @@ class ImageRecordIter(DataIter):
 
         # per-image RandomStates derived from the batch's reserved seed:
         # the PIL fallback stays deterministic per (seed, position) even
-        # with concurrent prefetch workers (no global-RNG races)
-        rngs = [np.random.RandomState((seed + 31 * i) % (2 ** 31))
-                for i in range(len(offsets))]
+        # with concurrent prefetch workers (no global-RNG races). Skipped
+        # entirely when nothing draws randomness (MT19937 init per image
+        # is measurable on the 1-core host).
+        if self._rand_crop or self._rand_mirror:
+            rngs = [np.random.RandomState((seed + 31 * i) % (2 ** 31))
+                    for i in range(len(offsets))]
+        else:
+            rngs = [None] * len(offsets)
         if self._threads > 1:
             with cf.ThreadPoolExecutor(self._threads) as pool:
                 results = list(pool.map(self._load_one, offsets, rngs))

@@ -47,7 +47,7 @@ class ShardedTrainer:
                  optimizer: str = "sgd", optimizer_params: Optional[Dict] = None,
                  input_specs=P("dp"), label_specs=P("dp"), grad_clip: float = -1.0,
                  donate: bool = True, compute_dtype=None,
-                 preprocess: Optional[Callable] = None):
+                 preprocess: Optional[Callable] = None, remat: bool = False):
         if optimizer not in _SUPPORTED:
             raise ValueError(f"optimizer {optimizer!r} not in {_SUPPORTED}")
         self.net = net
@@ -71,6 +71,12 @@ class ShardedTrainer:
         # (x-mean)/std math rides the first conv's HBM read for free instead
         # of burning host CPU + 4x host→device bandwidth.
         self._preprocess = preprocess
+        # Rematerialization (jax.checkpoint over the whole forward, matmul
+        # results saved): trades recompute FLOPs for activation memory —
+        # the long-context lever for sequences whose activations don't fit
+        # (and for compile-side buffer pressure). Reference counterpart:
+        # mxnet memonger / mirror mode (TBV).
+        self._remat = bool(remat)
 
         self._t = 0
         self._in_sh = batch_sharding(mesh, input_specs if isinstance(input_specs, P)
@@ -189,7 +195,15 @@ class ShardedTrainer:
                 return loss_val, aux
 
             grad_part = {n: param_vals[n] for n in grad_names}
-            (loss, aux), grads = jax.value_and_grad(loss_f, has_aux=True)(grad_part)
+            loss_f_used = loss_f
+            if self._remat:
+                # save matmul outputs, recompute the elementwise tail — the
+                # standard transformer remat policy
+                policy = getattr(jax.checkpoint_policies,
+                                 "dots_with_no_batch_dims_saveable", None)
+                loss_f_used = jax.checkpoint(loss_f, policy=policy)
+            (loss, aux), grads = jax.value_and_grad(loss_f_used,
+                                                    has_aux=True)(grad_part)
             new_params = dict(param_vals)
             new_state = {}
             for n in grad_names:

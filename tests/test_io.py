@@ -262,12 +262,14 @@ def test_pil_fallback_augmentation_deterministic(tmp_path):
                              shuffle=False, rand_crop=True,
                              rand_mirror=True, resize=40,
                              preprocess_threads=1, dtype="uint8")
-        assert it._native is None or True  # PIL kicks in on first decode
         pf = PrefetchingIter(it, prefetch=3, num_threads=workers)
         out = []
         for b in pf:
             out.append(onp.asarray(b.data[0].asnumpy()))
         pf.close()
+        # PNG records MUST have forced the PIL fallback (else this test no
+        # longer exercises the per-image RandomState path it exists for)
+        assert it._native is None
         return onp.concatenate(out)
 
     a, b = run(1), run(2)
