@@ -229,7 +229,7 @@ def bench_resnet_piped(platform, compute_dtype=None):
             last = trainer.step(*next_batch())
         float(last.asnumpy())
         runs = []
-        for _ in range(_n_runs(platform)):
+        for _ in range(max(_n_runs(platform), 1)):
             t_data = t_disp = 0.0
             t0_all = time.perf_counter()
             for _ in range(steps):

@@ -126,8 +126,12 @@ def _conv_attrs(a):
     return out
 
 
-def _export_node(node, in_names, out_name, params, extra_inits):
-    """Returns (onnx node bytes, handled: bool)."""
+def _export_node(node, in_names, out_name, params, extra_inits, in_rank=None):
+    """Returns (onnx node bytes, handled: bool).
+
+    in_rank: rank of the node's first input when shape inference succeeded,
+    else None — used to guard opset-9 coerce-to-2D Softmax semantics.
+    """
     op = node._op
     a = node._attrs
     nm = node._name
@@ -187,6 +191,17 @@ def _export_node(node, in_names, out_name, params, extra_inits):
     if op in ("softmax", "SoftmaxOutput", "SoftmaxActivation"):
         ins = in_names[:1]
         ax = int(a.get("axis", -1 if op == "softmax" else 1))
+        # opset-9 Softmax coerces to 2D at `ax`: softmax over ALL trailing
+        # dims, which equals mx single-axis softmax only when ax is the last
+        # dim. Mirror the importer's guard — exporting anything else would
+        # silently diverge on conformant runtimes (e.g. axis=1 on NCHW maps).
+        last_ok = ax == -1 or (in_rank is not None and ax == in_rank - 1)
+        if not last_ok:
+            raise ValueError(
+                f"mx2onnx: opset-9 Softmax with axis={ax} on a rank-"
+                f"{in_rank if in_rank is not None else '?'} input uses "
+                "coerce-to-2D semantics that diverge from single-axis "
+                "softmax; only last-dim softmax exports faithfully")
         return _node("Softmax", ins, [out_name], nm, _attr_int("axis", ax)), True
     if op == "log_softmax":
         return _node("LogSoftmax", in_names, [out_name], nm,
@@ -252,6 +267,25 @@ def export_model(sym, params, input_shape, input_type=None,
     shapes = ([tuple(input_shape)] if isinstance(input_shape[0], int)
               else [tuple(s) for s in input_shape])
 
+    # Per-node shape inference (for rank-dependent export guards). Build the
+    # known-shape map the same way the export loop assigns graph inputs:
+    # params from np_params, data inputs from `shapes` in topo order.
+    known = {}
+    si = 0
+    for node in topo:
+        if node._op is not None:
+            continue
+        if node._name in np_params:
+            known[node._name] = np_params[node._name].shape
+        else:
+            known[node._name] = shapes[min(si, len(shapes) - 1)]
+            si += 1
+    try:
+        from ..symbol.symbol import infer_node_shapes
+        node_shapes = infer_node_shapes(base, known)
+    except Exception:
+        node_shapes = {}
+
     out_of: Dict[int, str] = {}
     nodes = b""
     graph_inputs: List[bytes] = []
@@ -277,7 +311,13 @@ def export_model(sym, params, input_shape, input_type=None,
                     "multi-output node — not supported")
         in_names = [out_of[id(i._base())] for i in node._inputs]
         out_name = node._name + "_out"
-        nb, ok = _export_node(node, in_names, out_name, np_params, extra_inits)
+        in_rank = None
+        if node._inputs:
+            s = node_shapes.get(id(node._inputs[0]._base()))
+            if isinstance(s, tuple):
+                in_rank = len(s)
+        nb, ok = _export_node(node, in_names, out_name, np_params,
+                              extra_inits, in_rank=in_rank)
         if not ok:
             raise ValueError(f"mx2onnx: op {node._op!r} has no ONNX mapping; "
                              "supported set is the model-zoo CNN/MLP family")
@@ -509,8 +549,25 @@ def import_model(model_file):
             for extra in ins[1:]:
                 out = S.broadcast_add(out, sym_of(extra))
         elif op == "Clip":
-            out = S.clip(sym_of(ins[0]), a_min=float(a.get("min", -3e38)),
-                         a_max=float(a.get("max", 3e38)), name=name)
+            # opset <= 6 passes bounds as attributes; opset >= 11 as
+            # optional inputs 1-2 (must be initializers here — a dynamic
+            # bound has no mx.clip counterpart, so fail loudly).
+            lo, hi = a.get("min"), a.get("max")
+            if len(ins) > 1:
+                def _bound(nm_):
+                    if not nm_:
+                        return None
+                    if nm_ in inits:
+                        return float(np.asarray(inits.pop(nm_)).reshape(()))
+                    raise ValueError(
+                        "onnx2mx: Clip min/max passed as non-initializer "
+                        "inputs (dynamic bounds) — unsupported")
+                lo = _bound(ins[1])
+                hi = _bound(ins[2]) if len(ins) > 2 else None
+            out = S.clip(sym_of(ins[0]),
+                         a_min=float(lo) if lo is not None else -3e38,
+                         a_max=float(hi) if hi is not None else 3e38,
+                         name=name)
         elif op == "Identity":
             out = sym_of(ins[0])
         else:

@@ -383,7 +383,7 @@ _unary("isinf", jnp.isinf)
 _unary("isfinite", jnp.isfinite)
 _unary("isposinf", jnp.isposinf)
 _unary("isneginf", jnp.isneginf)
-_unary("fix", jnp.fix)
+_unary("fix", jnp.trunc)  # jnp.fix is deprecated; trunc is identical on reals
 _unary("positive", jnp.positive)
 _unary("conj", jnp.conj)
 _unary("conjugate", jnp.conjugate)

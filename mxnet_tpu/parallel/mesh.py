@@ -51,6 +51,13 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
         raise ValueError(f"mesh axes {axes} need {known} devices, have {n}")
     # fully-specified mesh smaller than the host: take the first `known`
     # devices (reference analog: ctx=[mx.gpu(i) for i in ...] picks a subset)
+    if known < n:
+        import warnings
+
+        warnings.warn(
+            f"make_mesh: axes {axes} cover {known} of {n} available devices; "
+            f"using the first {known} (pass an axis of -1 to absorb the rest)",
+            stacklevel=2)
     devices = devices[:known]
     names = [a for a in AXIS_ORDER if a in axes] + \
             [a for a in axes if a not in AXIS_ORDER]

@@ -500,6 +500,17 @@ def infer_shapes(sym: Symbol, known: Dict[str, tuple]):
     """Topo-order forward shape inference. Returns (all_input_shapes,
     out_shapes). Auto-created params get shapes from op rules; other node
     outputs via jax.eval_shape of the same pure op functions."""
+    shapes, out_shapes, _ = _infer_shapes_full(sym, known)
+    return shapes, out_shapes
+
+
+def infer_node_shapes(sym: Symbol, known: Dict[str, tuple]):
+    """Per-node output shapes keyed by id(node) (used by export helpers
+    that need intermediate ranks, e.g. the ONNX Softmax axis guard)."""
+    return _infer_shapes_full(sym, known)[2]
+
+
+def _infer_shapes_full(sym: Symbol, known: Dict[str, tuple]):
     import jax
 
     shapes: Dict[str, tuple] = {k: tuple(v) for k, v in known.items()}
@@ -569,4 +580,4 @@ def infer_shapes(sym: Symbol, known: Dict[str, tuple]):
                 out_shapes.extend(s)
         else:
             out_shapes.append(s)
-    return shapes, out_shapes
+    return shapes, out_shapes, node_out
