@@ -413,6 +413,17 @@ def import_model(model_file):
             tensors[name] = sym_mod.Variable(name)
         return tensors[name]
 
+    # Initializers consumed as Clip bounds: read WITHOUT popping (exporters
+    # dedupe constants — one min/max tensor may feed many Clip nodes, e.g.
+    # every ReLU6 in a MobileNet). Count total input-uses per name so bound
+    # tensors are stripped from params only when nothing else consumes them.
+    use_count: Dict[str, int] = {}
+    for raw in graph.get(1, []):
+        for x in P.parse_message(raw).get(1, []):
+            nm_u = P.string_of(x)
+            use_count[nm_u] = use_count.get(nm_u, 0) + 1
+    bound_uses: Dict[str, int] = {}
+
     pending_flatten: Dict[str, str] = {}  # flatten_out -> flatten_in
     for raw in graph.get(1, []):
         f = P.parse_message(raw)
@@ -558,7 +569,8 @@ def import_model(model_file):
                     if not nm_:
                         return None
                     if nm_ in inits:
-                        return float(np.asarray(inits.pop(nm_)).reshape(()))
+                        bound_uses[nm_] = bound_uses.get(nm_, 0) + 1
+                        return float(np.asarray(inits[nm_]).reshape(()))
                     raise ValueError(
                         "onnx2mx: Clip min/max passed as non-initializer "
                         "inputs (dynamic bounds) — unsupported")
@@ -573,6 +585,10 @@ def import_model(model_file):
         else:
             raise ValueError(f"onnx2mx: unsupported ONNX op {op!r}")
         tensors[outs[0]] = out
+
+    for nm_b, n_bound in bound_uses.items():  # bounds-only tensors: not params
+        if use_count.get(nm_b, 0) <= n_bound:
+            inits.pop(nm_b, None)
 
     final_out = P.string_of(P.parse_message(graph[12][0])[1][0])
     sym = tensors[final_out]
