@@ -224,9 +224,11 @@ def bench_resnet_piped(platform, compute_dtype=None):
         batch_mb = wires[0].nbytes / 1e6
 
         def put_threads(k, per):
-            for w_ in wires:  # distinct bytes each round: defeat dedupe
-                w_.reshape(-1)[:1024] = rng_w.randint(0, 255, 1024,
-                                                      dtype=np.uint8)
+            # FULLY regenerate each buffer per round: the tunnel may dedupe
+            # at sub-buffer granularity, so a 1 KB perturbation could let
+            # later rounds measure cache hits instead of wire transfers
+            for w_ in wires:
+                w_[:] = rng_w.randint(0, 255, w_.shape, dtype=np.uint8)
             chunks = [wires[i * per:(i + 1) * per] for i in range(k)]
 
             def up(c):

@@ -92,17 +92,26 @@ def predict_mode() -> _Scope:
 # ---------------------------------------------------------------------------
 
 class _Node:
-    """One recorded op. parents[i] is (node, out_index) or None per input."""
+    """One recorded op. parents[i] is (node, out_index) or None per input.
 
-    __slots__ = ("vjp_fn", "parents", "out_avals", "outputs", "name", "out_is_tuple")
+    ``closed``/``primals`` keep the node's pure function and its primal
+    inputs so create_graph=True can RE-DERIVE the vjp as recorded ops: the
+    stored ``vjp_fn`` closes over residuals, hiding the primal dependence —
+    differentiating through it would yield zero for d(grad)/d(primal)."""
 
-    def __init__(self, vjp_fn, parents, out_avals, name, out_is_tuple=False):
+    __slots__ = ("vjp_fn", "parents", "out_avals", "outputs", "name",
+                 "out_is_tuple", "closed", "primals")
+
+    def __init__(self, vjp_fn, parents, out_avals, name, out_is_tuple=False,
+                 closed=None, primals=None):
         self.vjp_fn = vjp_fn
         self.parents = parents
         self.out_avals = out_avals  # list of (shape, dtype)
         self.outputs = None  # weakrefs set lazily for variable deposit
         self.name = name
         self.out_is_tuple = out_is_tuple
+        self.closed = closed
+        self.primals = primals
 
 
 class _VarNode:
@@ -168,7 +177,8 @@ def _record_op(opdef, inputs, datas, kwargs):
     outs = list(out) if multi else [out]
     avals = [(o.shape, o.dtype) for o in outs]
     node = _Node(vjp_fn, [(parents[i], i) for i in diff_idx], avals, opdef.name,
-                 out_is_tuple=multi)
+                 out_is_tuple=multi, closed=closed_norm,
+                 primals=[inputs[i] for i in diff_idx])
     # parents entries: (parent_ag, input_pos)
     wrapped = []
     like = next((x for x in inputs if isinstance(x, NDArray)), None)
@@ -183,7 +193,8 @@ def backward(heads: Sequence, head_grads: Optional[Sequence] = None,
              retain_graph: bool = False, train_mode: bool = True) -> None:
     """Compute gradients of heads w.r.t. all attached variables, depositing
     into ``.grad`` per each variable's grad_req ('write' or 'add')."""
-    _run_backward(heads, head_grads, retain_graph, create_graph=False, deposit=True)
+    _run_backward(heads, head_grads, retain_graph, create_graph=False,
+                  deposit=True, train=train_mode)
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
@@ -193,19 +204,16 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     With ``create_graph=True`` the backward pass itself is recorded, enabling
     higher-order gradients.
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order imperative grad) is not yet supported; "
-            "use jax.grad composition on a hybridized block instead")
     if retain_graph is None:
         retain_graph = create_graph
     var_list = list(variables) if isinstance(variables, (list, tuple)) else [variables]
     grads = _run_backward(heads, head_grads, retain_graph, create_graph, deposit=False,
-                          wanted=var_list)
+                          wanted=var_list, train=train_mode)
     return grads if isinstance(variables, (list, tuple)) else grads[0]
 
 
-def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted=None):
+def _run_backward(heads, head_grads, retain_graph, create_graph, deposit,
+                  wanted=None, train=True):
     from .ndarray.ndarray import NDArray
 
     heads = list(heads) if isinstance(heads, (list, tuple)) else [heads]
@@ -217,6 +225,14 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
     cotangents = {}  # id(node) -> list per output
     node_by_id = {}
 
+    def _acc(a, b):
+        """a + b, lifting to NDArray when either side is one (create_graph
+        threads NDArray cotangents through the walk)."""
+        if isinstance(a, NDArray) or isinstance(b, NDArray):
+            a = a if isinstance(a, NDArray) else NDArrayCls()(jnp.asarray(a))
+            b = b if isinstance(b, NDArray) else NDArrayCls()(jnp.asarray(b))
+        return a + b
+
     def seed(node, idx, ct):
         lst = cotangents.setdefault(id(node), [None] * len(getattr(node, "out_avals", [None])))
         if isinstance(node, _VarNode):
@@ -224,7 +240,7 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
         if lst[idx] is None:
             lst[idx] = ct
         else:
-            lst[idx] = lst[idx] + ct
+            lst[idx] = _acc(lst[idx], ct)
         node_by_id[id(node)] = node
 
     for h, hg in zip(heads, head_grads):
@@ -265,7 +281,7 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
 
     def deposit_var(vnode, ct):
         key = id(vnode)
-        var_grads[key] = ct if key not in var_grads else var_grads[key] + ct
+        var_grads[key] = ct if key not in var_grads else _acc(var_grads[key], ct)
         node_by_id[key] = vnode
 
     # seed direct-variable heads
@@ -273,7 +289,16 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
         if h._ag_node is not None and isinstance(h._ag_node[0], _VarNode):
             deposit_var(h._ag_node[0], hg if hg is not None else jnp.ones(h.shape, h.dtype))
 
-    rec_scope = record(train_mode) if create_graph else _Scope(False, None)
+    if create_graph:
+        from .ndarray.ndarray import invoke_fn
+
+        def _lift(x):
+            return x if isinstance(x, NDArray) else NDArrayCls()(jnp.asarray(x))
+
+    # NOTE: `record(train)` not `record(train_mode)` — the latter is the
+    # module-level context-manager function (always truthy), which silently
+    # forced training semantics into replayed backward forwards
+    rec_scope = record(train) if create_graph else _Scope(False, None)
     with rec_scope:
         for node in reversed(order):
             cts = cotangents.get(id(node))
@@ -283,8 +308,31 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
             for i, aval in enumerate(node.out_avals):
                 c = cts[i] if i < len(cts) and cts[i] is not None else jnp.zeros(aval[0], aval[1])
                 full_cts.append(c)
-            arg = tuple(full_cts) if node.out_is_tuple else full_cts[0]
-            in_cts = node.vjp_fn(arg)
+            if create_graph:
+                # Re-derive the vjp from (primals, cotangents) as a RECORDED
+                # op: the new tape node's parents include the primals, so a
+                # second backward reaches d(grad)/d(primal). The stored
+                # vjp_fn cannot do this — it closes over residuals.
+                if node.closed is None:
+                    raise NotImplementedError(
+                        f"create_graph=True through a custom autograd."
+                        f"Function node ({node.name}) is not supported")
+                prim = [_lift(p) for p in node.primals]
+                ctnd = [_lift(c) for c in full_cts]
+                k = len(prim)
+
+                def vfn(*args, _n=node, _k=k):
+                    ps, cs = args[:_k], args[_k:]
+                    arg2 = tuple(cs) if _n.out_is_tuple else cs[0]
+                    _, vjp = jax.vjp(_n.closed, *ps)
+                    return vjp(arg2)
+
+                in_cts = invoke_fn(vfn, prim + ctnd)
+                if not isinstance(in_cts, tuple):
+                    in_cts = (in_cts,)
+            else:
+                arg = tuple(full_cts) if node.out_is_tuple else full_cts[0]
+                in_cts = node.vjp_fn(arg)
             for (parent_entry, _inpos), ict in zip(node.parents, in_cts):
                 if parent_entry is None or ict is None:
                     continue
@@ -294,7 +342,12 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
                 else:
                     seed(pnode, pidx, ict)
             if not retain_graph:
+                # release everything that pins activations: vjp residuals
+                # AND the create_graph bookkeeping (closed closes over all
+                # input buffers; primals strongly ref the input NDArrays)
                 node.vjp_fn = None
+                node.closed = None
+                node.primals = None
 
     if deposit:
         for key, ct in var_grads.items():
@@ -302,6 +355,8 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
             arr = vnode.ref()
             if arr is None or arr._grad_req == "null":
                 continue
+            if isinstance(ct, NDArray):
+                ct = ct._data
             if arr._grad_req == "add":
                 arr._grad._set_data(arr._grad._data + ct)
             else:
@@ -315,7 +370,9 @@ def _run_backward(heads, head_grads, retain_graph, create_graph, deposit, wanted
         ct = var_grads.get(id(v._ag_node[0]))
         if ct is None:
             ct = jnp.zeros(v.shape, v.dtype)
-        g = NDArrayCls()(ct)
+        # NDArray cotangents (create_graph=True) keep their tape link so a
+        # second backward() can differentiate through the first
+        g = ct if isinstance(ct, NDArray) else NDArrayCls()(ct)
         out.append(g)
     return out
 

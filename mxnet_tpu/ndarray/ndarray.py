@@ -170,6 +170,15 @@ class NDArray:
         d = dtype_np(dtype)
         if not copy and self.dtype == d:
             return self
+        # float->float casts are differentiable and must stay on the tape
+        # (reference: Cast has a registered backward); raw _wrap would
+        # silently detach anything computed through e.g. .astype("float32").
+        # jnp.issubdtype, not dtype.kind: ml_dtypes bfloat16 reports kind
+        # 'V', which a kind=='f' test would silently detach again.
+        if (_recording_this([self])
+                and jnp.issubdtype(jnp.dtype(d), jnp.floating)
+                and jnp.issubdtype(self._data.dtype, jnp.floating)):
+            return invoke_fn(lambda x: x.astype(d), [self])
         return _wrap(self._data.astype(d), self)
 
     def detach(self) -> "NDArray":

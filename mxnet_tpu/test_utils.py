@@ -16,7 +16,7 @@ from .base import get_env
 __all__ = ["list_gpus", "list_tpus",
            "default_context", "assert_almost_equal", "almost_equal", "same",
            "rand_ndarray", "rand_shape_nd", "check_numeric_gradient",
-           "check_consistency"]
+           "check_consistency", "check_grad_consistency", "max_rel_err"]
 
 _DTOL = {
     np.dtype(np.float16): (1e-2, 1e-2),
@@ -119,11 +119,23 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
                             names=(f"autograd_grad[{i}]", f"numeric_grad[{i}]"))
 
 
+def max_rel_err(a, b, atol=1e-8):
+    """max |a-b| / (|b| + atol) — the error actually recorded by the
+    consistency artifacts (a bare ok-boolean hides how close a pass was)."""
+    a = _np(a).astype(np.float64)
+    b = _np(b).astype(np.float64)
+    if a.size == 0:
+        return 0.0
+    return float(np.max(np.abs(a - b) / (np.abs(b) + atol)))
+
+
 def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",), rtol=None, atol=None):
     """Run ``fn`` across contexts/dtypes and cross-compare (reference
-    check_consistency pattern — SURVEY.md §4 "the single most important idea")."""
+    check_consistency pattern — SURVEY.md §4 "the single most important
+    idea"). Returns the worst observed max_rel_err across comparisons."""
     ctx_list = ctx_list or [cpu(), default_context()]
     ref = None
+    worst = 0.0
     for ctx in ctx_list:
         for dt in dtypes:
             args = [array(_np(x), ctx=ctx, dtype=dt) for x in inputs]
@@ -135,6 +147,64 @@ def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",), rtol=None,
                 at = atol if atol is not None else (1e-2 if dt in ("float16", "bfloat16") else 1e-5)
                 assert_almost_equal(out.astype(np.float32), ref.astype(np.float32),
                                     rtol=rt, atol=at, names=(f"{ctx}/{dt}", "ref"))
+                worst = max(worst, max_rel_err(out, ref, atol=at))
+    return worst
+
+
+def check_grad_consistency(fn, inputs, ctx_list=None, dtype="float32",
+                           rtol=None, atol=None, wrt=None):
+    """Forward AND backward cross-context check (reference check_consistency
+    runs both directions — tests/python/gpu/test_operator_gpu.py, TBV).
+
+    ``fn(*ndarrays) -> NDArray`` runs under autograd.record on each context;
+    a fixed linspace cotangent weights the output (catches permutation /
+    sign bugs a plain sum() would mask), then every input gradient is
+    cross-compared. ``wrt``: indices of differentiable inputs (default all).
+    Returns worst max_rel_err over forward output + all gradients.
+    """
+    from . import autograd
+
+    ctx_list = ctx_list or [cpu(), default_context()]
+    rt = rtol if rtol is not None else (1e-2 if dtype in ("float16", "bfloat16") else 1e-3)
+    at = atol if atol is not None else (1e-2 if dtype in ("float16", "bfloat16") else 1e-4)
+    recs = []
+    for ctx in ctx_list:
+        args = [array(_np(x), ctx=ctx, dtype=dtype) for x in inputs]
+        grad_idx = list(wrt) if wrt is not None else list(range(len(args)))
+        for i in grad_idx:
+            args[i].attach_grad()
+        with autograd.record():
+            out = fn(*args)
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+            cot = np.linspace(0.5, 1.5, int(np.prod(out.shape or (1,)))) \
+                .reshape(out.shape).astype(np.float32)
+            loss = (out.astype("float32") * array(cot, ctx=ctx)).sum()
+        loss.backward()
+        recs.append((_np(out),
+                     [_np(args[i].grad) if args[i].grad is not None else None
+                      for i in grad_idx]))
+    ref_out, ref_grads = recs[0]
+    worst = 0.0
+    for j, (out, grads) in enumerate(recs[1:], start=1):
+        assert_almost_equal(out.astype(np.float32), ref_out.astype(np.float32),
+                            rtol=rt, atol=at,
+                            names=(f"{ctx_list[j]}/fwd", "ref/fwd"))
+        worst = max(worst, max_rel_err(out, ref_out, atol=at))
+        for gi, (g, rg) in enumerate(zip(grads, ref_grads)):
+            if (g is None) != (rg is None):
+                raise AssertionError(
+                    f"grad[{gi}] is {'None' if g is None else 'set'} on "
+                    f"{ctx_list[j]} but {'None' if rg is None else 'set'} on "
+                    f"{ctx_list[0]}")
+            if g is None:
+                continue
+            assert_almost_equal(g.astype(np.float32), rg.astype(np.float32),
+                                rtol=rt, atol=at,
+                                names=(f"{ctx_list[j]}/grad[{gi}]",
+                                       f"ref/grad[{gi}]"))
+            worst = max(worst, max_rel_err(g, rg, atol=at))
+    return worst
 
 
 def list_gpus():

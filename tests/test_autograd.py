@@ -139,3 +139,57 @@ def test_softmax_output_fused_grad():
     p = p / p.sum(-1, keepdims=True)
     oh = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
     assert_almost_equal(data.grad, p - oh, rtol=1e-4, atol=1e-4)
+
+
+def test_astype_stays_on_tape():
+    # float->float casts must record (a raw buffer cast silently detached
+    # everything downstream of e.g. .astype("float32") before round 5)
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.exp(x).astype("float32") * 2.0).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * np.exp(np.array([1, 2, 3], np.float32)),
+                        rtol=1e-5)
+
+
+def test_grad_create_graph_elemwise():
+    # d/dx of (d/dx sum(x^3))^2-sum: gx = 3x^2, z = sum(gx^2) = sum(9x^4),
+    # dz/dx = 36 x^3
+    xv = np.array([1.0, 2.0, -3.0], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        z = (gx * gx).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 36 * xv ** 3, rtol=1e-5)
+
+
+def test_grad_create_graph_matmul():
+    rng = np.random.RandomState(0)
+    xm = nd.array(rng.rand(4, 3).astype(np.float32))
+    w = nd.array(rng.rand(3, 2).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        f = (nd.dot(xm, w) ** 2).sum()
+        gw = autograd.grad(f, w, create_graph=True)
+        h = (gw ** 2).sum()
+    hw = autograd.grad(h, w)
+    XtX = xm.asnumpy().T @ xm.asnumpy()
+    expect = 8 * XtX @ XtX @ w.asnumpy()
+    assert_almost_equal(hw, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_third_order():
+    xv = np.array([0.5, -1.5, 2.0], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1.sum(), x, create_graph=True)
+        g3s = g2.sum()
+    g3 = autograd.grad(g3s, x)
+    assert_almost_equal(g3, 24 * xv, rtol=1e-5)

@@ -40,10 +40,11 @@ def main():
 
     results = {}
     for k in (1, 2, 4, 8):
-        # split nbuf buffers across k threads; distinct data each round to
-        # defeat dedupe: regenerate cheap permutations
+        # split nbuf buffers across k threads; FULLY regenerate each round —
+        # the tunnel may dedupe at sub-buffer granularity, so partial
+        # perturbation could let later rounds measure cache hits
         for b in bufs:
-            b[:1024] = rng.randint(0, 255, 1024, dtype=np.uint8)
+            b[:] = rng.randint(0, 255, shape, dtype=np.uint8)
         chunks = [bufs[i::k] for i in range(k)]
         t0 = time.perf_counter()
         threads = [threading.Thread(target=upload, args=(c,)) for c in chunks]
