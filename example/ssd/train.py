@@ -29,6 +29,8 @@ parser.add_argument("--image-size", type=int, default=128)
 parser.add_argument("--steps", type=int, default=20)
 parser.add_argument("--lr", type=float, default=1e-3)
 parser.add_argument("--log-interval", type=int, default=5)
+parser.add_argument("--eval-batches", type=int, default=2,
+                    help="post-training VOC07 mAP eval batches (0 disables)")
 args = parser.parse_args()
 
 
@@ -77,6 +79,17 @@ def main():
     dets = net.detect(x)
     valid = (dets[:, :, 0].asnumpy() >= 0).sum()
     print(f"detect: {valid} boxes kept after NMS across batch")
+
+    # --- evaluation: VOC07 mAP over held-out synthetic batches (the
+    # reference's SSD acceptance metric — example/ssd/evaluate) ---
+    if args.eval_batches > 0:
+        metric = mx.metric.VOC07MApMetric(ovp_thresh=0.5)
+        eval_rng = np.random.RandomState(99)
+        for _ in range(args.eval_batches):
+            ex, elabels = make_batch(eval_rng)
+            metric.update([elabels], [net.detect(ex)])
+        name, value = metric.get()
+        print(f"{name}: {value:.4f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -8,8 +8,11 @@ that quantize the input, run the MXU int8 op (ops/quantization.py:
 result. Unmatched layers stay f32 — the reference likewise quantizes a
 subset of ops and stitches (de)quantize nodes around them.
 
-``quantize_model`` (the raw-Symbol API) is intentionally routed to
-quantize_net; ``quantize_graph`` remains unsupported (no partition IR).
+``quantize_model`` is the reference's symbolic entry point: a graph
+rewrite that replaces each calibrated FullyConnected/Convolution with the
+explicit quantize_v2 → int8 MXU op → dequantize node trio and int8 weight
+params. ``quantize_graph`` is the same rewrite without a calibration
+dataset.
 """
 from __future__ import annotations
 
@@ -221,13 +224,215 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     return network
 
 
-def quantize_model(sym, arg_params=None, aux_params=None, **kwargs):
-    raise NotImplementedError(
-        "quantize_model operates on raw Symbols; wrap the symbol in a "
-        "SymbolBlock and use quantize_net")
+def _quantize_param(arr, name, qparams):
+    """f32 param -> int8 twin + min/max range params (symmetric grid).
+    Returns the three new param names."""
+    a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+    m = float(np.abs(a).max()) or 1.0
+    from ..ndarray import array
+
+    qparams[name + "_quantize"] = array(
+        np.clip(np.round(a * (127.0 / m)), -127, 127).astype(np.int8))
+    qparams[name + "_min"] = array(np.array([-m], np.float32))
+    qparams[name + "_max"] = array(np.array([m], np.float32))
+    return name + "_quantize", name + "_min", name + "_max"
 
 
-def quantize_graph(*a, **kw):
-    raise NotImplementedError(
-        "graph-level quantization partitioning is not supported; use "
-        "quantize_net")
+def _rewrite_quantized(sym, arg_params, excluded, ranges, online):
+    """Graph rewrite: each quantizable FC/Conv node becomes the reference's
+    explicit quantize_v2 -> int8 op -> dequantize chain (reference
+    quantize_graph pass inserts the same node trio — TBV). Returns
+    (new_sym, qarg_params)."""
+    from .. import symbol as S
+    from ..symbol.symbol import Symbol
+
+    qarg = dict(arg_params)
+    base = sym._base() if sym._op != "_group" else sym
+    topo = base._topo()
+    memo = {}
+    # tied weights: quantize once, reuse the int8 twin for every consumer;
+    # the f32 original is dropped only if no un-quantized node still needs it
+    qweight_cache = {}
+    consumed = set()
+
+    def remap(inp):
+        b = inp._base()
+        new_b = memo[id(b)]
+        if inp._index is not None:
+            return new_b[inp._index]
+        return new_b
+
+    def quantizable(node):
+        if node._op not in ("FullyConnected", "Convolution"):
+            return False
+        if node._name in excluded:
+            return False
+        if not online and node._name not in ranges:
+            return False
+        wvar = node._inputs[1]._base()
+        return wvar._op is None and wvar._name in arg_params
+
+    for node in topo:
+        if node._op is None:
+            memo[id(node)] = node
+            continue
+        new_ins = [remap(i) for i in node._inputs]
+        if quantizable(node):
+            a = node._attrs
+            nm = node._name
+            no_bias = str(a.get("no_bias", False)).lower() in ("1", "true")
+            wname = node._inputs[1]._base()._name
+            if wname in qweight_cache:
+                wq, wmin, wmax = qweight_cache[wname]
+            else:
+                wq, wmin, wmax = _quantize_param(arg_params[wname], wname,
+                                                 qarg)
+                qweight_cache[wname] = (wq, wmin, wmax)
+            consumed.add(wname)
+            if online:
+                dq = S._contrib_quantize_v2(new_ins[0], name=nm + "_quantize")
+            else:
+                lo, hi = ranges[nm]
+                dq = S._contrib_quantize_v2(
+                    new_ins[0], min_calib_range=float(lo),
+                    max_calib_range=float(hi), name=nm + "_quantize")
+            # int8 op runs bias-free; the f32 bias (kept at full precision,
+            # matching the int32-accumulator exactness better than an int8
+            # bias grid) is added after dequantize
+            q_ins = [dq[0], S.Variable(wq), S.zeros((1,)),
+                     dq[1], dq[2], S.Variable(wmin), S.Variable(wmax)]
+            q_kwargs = {"no_bias": True}
+            if node._op == "FullyConnected":
+                qop = S._contrib_quantized_fully_connected
+                q_kwargs["num_hidden"] = int(a.get("num_hidden", 1))
+                q_kwargs["flatten"] = str(a.get("flatten", True)).lower() \
+                    not in ("0", "false")
+            else:
+                qop = S._contrib_quantized_conv
+                for key in ("kernel", "stride", "pad", "dilate"):
+                    if key in a:
+                        q_kwargs[key] = a[key]
+                q_kwargs["num_filter"] = int(a.get("num_filter", 1))
+                q_kwargs["num_group"] = int(a.get("num_group", 1))
+            qnode = qop(*q_ins, name=nm + "_int8", **q_kwargs)
+            deq = S._contrib_dequantize(qnode[0], qnode[1], qnode[2],
+                                        name=nm + "_dequantize")
+            if not no_bias and len(node._inputs) > 2:
+                bias_sym = new_ins[2]
+                if node._op == "Convolution":
+                    bias_sym = S.reshape(bias_sym, shape=(1, -1, 1, 1),
+                                         name=nm + "_bias_r")
+                deq = S.broadcast_add(deq, bias_sym, name=nm + "_addbias")
+            memo[id(node)] = deq._base()
+        else:
+            memo[id(node)] = Symbol(node._op, node._name, new_ins,
+                                    node._attrs)
+    out = memo[id(base)]
+    if sym._index is not None:
+        out = out[sym._index]
+    # drop quantized f32 originals unless an un-quantized node still
+    # references them (tied weight feeding e.g. an excluded layer)
+    still_needed = set(out.list_arguments())
+    for wname in consumed:
+        if wname not in still_needed:
+            qarg.pop(wname, None)
+    return out, qarg
+
+
+def _calibrate_ranges(sym, arg_params, aux_params, calib_data, data_names,
+                      label_names, num_calib_examples, excluded):
+    """Naive calibration: min/max of every quantizable node's data input,
+    collected by evaluating a Group of those inputs over calib_data."""
+    from .. import symbol as S_mod
+    from ..ndarray import NDArray
+
+    base = sym._base() if sym._op != "_group" else sym
+    nodes = [n for n in base._topo()
+             if n._op in ("FullyConnected", "Convolution")
+             and n._name not in excluded]
+    if not nodes:
+        return {}
+    group = S_mod.Group([n._inputs[0] for n in nodes])
+    ranges = {}
+    seen = 0
+    exe = None
+    for batch in calib_data:
+        xs = batch.data if hasattr(batch, "data") else [batch]
+        xs = xs if isinstance(xs, (list, tuple)) else [xs]
+        feed = dict(zip(data_names, xs))
+        feed.update(arg_params)
+        feed.update(aux_params or {})
+        if exe is None:  # bind ONCE: per-batch eval() would recompile
+            exe = group.simple_bind(grad_req="null",
+                                    **{k: v.shape for k, v in feed.items()})
+        outs = exe.forward(is_train=False, **feed)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        for n, o in zip(nodes, outs):
+            a = o.asnumpy()
+            lo, hi = float(a.min()), float(a.max())
+            old = ranges.get(n._name)
+            if old is None:
+                ranges[n._name] = [lo, hi]
+            else:
+                old[0] = min(old[0], lo)
+                old[1] = max(old[1], hi)
+        seen += int(xs[0].shape[0])
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return ranges
+
+
+def quantize_model(sym, arg_params=None, aux_params=None,
+                   data_names=("data",), label_names=("softmax_label",),
+                   ctx=None, excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", **kwargs):
+    """Reference symbolic INT8 entry point: rewrite ``sym`` so every
+    calibrated FullyConnected/Convolution runs as the explicit
+    quantize_v2 → int8 MXU op → dequantize chain, with int8 weight/bias
+    params. Returns (qsym, qarg_params, aux_params).
+
+    calib_mode: 'none' (online min/max per batch) or 'naive' (min/max over
+    ``calib_data``). 'entropy' (KL threshold search) is not implemented —
+    raises rather than silently degrading.
+    """
+    if quantized_dtype not in ("int8", "auto"):
+        raise ValueError(f"quantized_dtype {quantized_dtype!r} not supported")
+    if calib_mode == "entropy":
+        raise NotImplementedError(
+            "calib_mode='entropy' (KL threshold search) is not implemented; "
+            "use 'naive' or 'none'")
+    if calib_mode not in ("none", "naive"):
+        raise ValueError(f"calib_mode {calib_mode!r} not supported")
+    arg_params = dict(arg_params or {})
+    aux_params = dict(aux_params or {})
+    excluded = set(excluded_sym_names or ())
+    if isinstance(data_names, str):
+        data_names = (data_names,)
+    if calib_mode == "naive":
+        if calib_data is None:
+            raise ValueError("calib_mode='naive' needs calib_data")
+        ranges = _calibrate_ranges(sym, arg_params, aux_params, calib_data,
+                                   data_names, label_names,
+                                   num_calib_examples, excluded)
+    else:
+        ranges = {}
+    qsym, qarg = _rewrite_quantized(sym, arg_params, excluded, ranges,
+                                    online=(calib_mode == "none"))
+    return qsym, qarg, aux_params
+
+
+def quantize_graph(sym, arg_params=None, aux_params=None, ctx=None,
+                   excluded_sym_names=None, calib_mode="none",
+                   quantized_dtype="int8", **kwargs):
+    """Reference quantize_graph: the same rewrite as quantize_model.
+    ``calib_mode`` is honored ('naive' needs calib_data in kwargs;
+    'entropy' raises, as in quantize_model). Returns
+    (qsym, qarg_params, aux_params, collector) — the collector slot is
+    None: calibration here runs through quantize_model's calib_data path
+    rather than a separate layer-output collector object."""
+    qsym, qarg, aux = quantize_model(
+        sym, arg_params, aux_params, ctx=ctx,
+        excluded_sym_names=excluded_sym_names, calib_mode=calib_mode,
+        quantized_dtype=quantized_dtype, **kwargs)
+    return qsym, qarg, aux, None

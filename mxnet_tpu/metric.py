@@ -291,3 +291,140 @@ class CompositeEvalMetric(EvalMetric):
             names.append(n)
             values.append(v)
         return names, values
+
+
+def _box_iou(a, b):
+    """IoU of one box [l,t,r,b] against (N,4) boxes."""
+    il = np.maximum(a[0], b[:, 0])
+    it = np.maximum(a[1], b[:, 1])
+    ir = np.minimum(a[2], b[:, 2])
+    ib = np.minimum(a[3], b[:, 3])
+    iw = np.maximum(ir - il, 0.0)
+    ih = np.maximum(ib - it, 0.0)
+    inter = iw * ih
+    area_a = max(a[2] - a[0], 0.0) * max(a[3] - a[1], 0.0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0.0) * \
+        np.maximum(b[:, 3] - b[:, 1], 0.0)
+    union = area_a + area_b - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+@register
+class MApMetric(EvalMetric):
+    """Detection mean Average Precision (reference
+    example/ssd/evaluate/eval_metric.py::MApMetric — TBV).
+
+    update(labels, preds):
+      preds:  (B, N, 6) rows [cls_id, score, l, t, r, b] — the
+              MultiBoxDetection / box_nms output; cls_id < 0 = invalid.
+      labels: (B, M, 5+) rows [cls_id, l, t, r, b, (difficult)]; cls_id < 0
+              pads.
+    AP integration is area-under-PR (VOC 2010+); VOC07MApMetric overrides
+    with the 11-point interpolation the reference publishes VOC07 mAP with.
+    """
+
+    def __init__(self, ovp_thresh=0.5, use_difficult=False, class_names=None,
+                 pred_idx=0, name="mAP", **kwargs):
+        self.ovp_thresh = float(ovp_thresh)
+        self.use_difficult = bool(use_difficult)
+        self.class_names = list(class_names) if class_names else None
+        self.pred_idx = int(pred_idx)
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        # per-class: list of (score, is_tp) + ground-truth counts
+        self._records = {}
+        self._gt_counts = {}
+
+    def update(self, labels, preds):
+        labels = _as_list(labels)
+        preds = _as_list(preds)
+        pred = _np(preds[self.pred_idx])
+        label = _np(labels[0])
+        assert pred.ndim == 3 and pred.shape[-1] >= 6, \
+            f"preds must be (B,N,6) detection rows, got {pred.shape}"
+        for b in range(pred.shape[0]):
+            self._update_one(label[b], pred[b])
+        self.num_inst += 1
+
+    def _update_one(self, gts, dets):
+        gts = gts[gts[:, 0] >= 0]
+        difficult = (gts[:, 5] > 0 if gts.shape[-1] > 5
+                     else np.zeros(len(gts), bool))
+        for c in np.unique(gts[:, 0]).astype(int):
+            n_easy = int(((gts[:, 0] == c) & ~difficult).sum())
+            self._gt_counts[c] = self._gt_counts.get(c, 0) + n_easy
+        dets = dets[dets[:, 0] >= 0]
+        dets = dets[np.argsort(-dets[:, 1])]  # score desc: greedy matching
+        matched = np.zeros(len(gts), bool)
+        for row in dets:
+            c = int(row[0])
+            rec = self._records.setdefault(c, [])
+            cand = np.where((gts[:, 0] == c) & ~matched)[0]
+            if len(cand) == 0:
+                rec.append((float(row[1]), 0))
+                continue
+            ious = _box_iou(row[2:6], gts[cand, 1:5])
+            j = int(np.argmax(ious))
+            if ious[j] >= self.ovp_thresh:
+                gi = cand[j]
+                if difficult[gi] and not self.use_difficult:
+                    # VOC devkit: a difficult match is ignored (not tp, not
+                    # fp) and the difficult GT is NEVER consumed — later
+                    # detections may still match it and be ignored too
+                    continue
+                matched[gi] = True
+                rec.append((float(row[1]), 1))
+            else:
+                rec.append((float(row[1]), 0))
+
+    def _average_precision(self, rec, prec):
+        """VOC 2010+ AP: area under the monotone precision envelope."""
+        mrec = np.concatenate([[0.0], rec, [1.0]])
+        mpre = np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = np.where(mrec[1:] != mrec[:-1])[0]
+        return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+
+    def get(self):
+        classes = [c for c, n in self._gt_counts.items() if n > 0]
+        if not classes:
+            return self.name, float("nan")
+        aps = []
+        for c in sorted(classes):
+            rec = sorted(self._records.get(c, []), key=lambda t: -t[0])
+            if not rec:
+                aps.append(0.0)
+                continue
+            tps = np.array([t[1] for t in rec], np.float64)
+            tp_cum = np.cumsum(tps)
+            fp_cum = np.cumsum(1.0 - tps)
+            recall = tp_cum / self._gt_counts[c]
+            precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+            aps.append(self._average_precision(recall, precision))
+        if self.class_names:
+            names = [f"{self.class_names[c]}_AP" if c < len(self.class_names)
+                     else f"class{c}_AP" for c in sorted(classes)]
+            return ([self.name] + names,
+                    [float(np.mean(aps))] + [float(a) for a in aps])
+        return self.name, float(np.mean(aps))
+
+
+@register
+class VOC07MApMetric(MApMetric):
+    """11-point interpolated AP (reference VOC07MApMetric — the metric the
+    reference's published SSD VOC07 numbers use)."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("name", "VOC07_mAP")
+        super().__init__(*args, **kwargs)
+
+    def _average_precision(self, rec, prec):
+        ap = 0.0
+        for t in np.linspace(0.0, 1.0, 11):
+            mask = rec >= t
+            ap += (float(prec[mask].max()) if mask.any() else 0.0) / 11.0
+        return ap

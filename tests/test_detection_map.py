@@ -1,0 +1,153 @@
+"""Detection mAP metric + end-to-end eval through MultiBoxDetection/box_nms
+(reference example/ssd/evaluate/eval_metric.py — the metric the reference's
+published SSD numbers use)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.metric import MApMetric, VOC07MApMetric
+
+
+def _labels(rows):
+    """rows: list of [cls, l, t, r, b] per image -> (1, M, 5)."""
+    return np.asarray([rows], np.float32)
+
+
+def _dets(rows):
+    """rows: list of [cls, score, l, t, r, b] -> (1, N, 6)."""
+    return np.asarray([rows], np.float32)
+
+
+def test_map_perfect_predictions():
+    m = MApMetric(ovp_thresh=0.5)
+    gt = _labels([[0, 0.1, 0.1, 0.4, 0.4], [1, 0.5, 0.5, 0.9, 0.9]])
+    det = _dets([[0, 0.9, 0.1, 0.1, 0.4, 0.4], [1, 0.8, 0.5, 0.5, 0.9, 0.9]])
+    m.update([gt], [det])
+    assert m.get()[1] == 1.0
+
+
+def test_map_all_wrong_class():
+    m = MApMetric()
+    gt = _labels([[0, 0.1, 0.1, 0.4, 0.4]])
+    det = _dets([[1, 0.9, 0.1, 0.1, 0.4, 0.4]])
+    m.update([gt], [det])
+    assert m.get()[1] == 0.0
+
+
+def test_map_scores_order_matters():
+    # one gt, two dets of the right class: high-score hit + low-score dup.
+    # greedy matching takes the high-score one; the dup is a false positive
+    # AFTER the tp in score order, so AP stays 1.0 under VOC07 11-point? No:
+    # precision at recall 1.0 is 1/1 at the tp, then fp lowers nothing
+    # before it. AP (AUC) = 1.0; adding an fp ABOVE the tp halves precision.
+    gt = _labels([[0, 0.1, 0.1, 0.4, 0.4]])
+    m_good = MApMetric()
+    m_good.update([gt], [_dets([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                                [0, 0.2, 0.6, 0.6, 0.9, 0.9]])])
+    assert m_good.get()[1] == 1.0
+    m_bad = MApMetric()
+    m_bad.update([gt], [_dets([[0, 0.9, 0.6, 0.6, 0.9, 0.9],
+                               [0, 0.2, 0.1, 0.1, 0.4, 0.4]])])
+    assert m_bad.get()[1] == 0.5
+
+
+def test_map_iou_threshold():
+    gt = _labels([[0, 0.0, 0.0, 0.4, 0.4]])
+    # shifted box, IoU ~ (0.3*0.4)/(2*0.16-0.12) = 0.6 -> tp at 0.5, fp at 0.7
+    det = _dets([[0, 0.9, 0.1, 0.0, 0.5, 0.4]])
+    m5 = MApMetric(ovp_thresh=0.5)
+    m5.update([gt], [det])
+    assert m5.get()[1] == 1.0
+    m7 = MApMetric(ovp_thresh=0.7)
+    m7.update([gt], [det])
+    assert m7.get()[1] == 0.0
+
+
+def test_voc07_eleven_point():
+    # 2 gts, one matched at score .9, one missed + an fp at .5:
+    # recall caps at 0.5 -> 11-point AP = 6/11 * 1.0 (precision 1.0 up to
+    # recall .5 from the first det; fp after does not raise recall)
+    gt = _labels([[0, 0.1, 0.1, 0.4, 0.4], [0, 0.5, 0.5, 0.9, 0.9]])
+    det = _dets([[0, 0.9, 0.1, 0.1, 0.4, 0.4], [0, 0.5, 0.0, 0.6, 0.2, 0.9]])
+    m = VOC07MApMetric()
+    m.update([gt], [det])
+    assert abs(m.get()[1] - 6.0 / 11.0) < 1e-9
+
+
+def test_map_difficult_ignored():
+    # difficult gt (flag col 6): match is neither tp nor fp; gt not counted
+    m = MApMetric(use_difficult=False)
+    gt = np.asarray([[[0, 0.1, 0.1, 0.4, 0.4, 1.0],
+                      [0, 0.5, 0.5, 0.9, 0.9, 0.0]]], np.float32)
+    det = _dets([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                 [0, 0.8, 0.5, 0.5, 0.9, 0.9]])
+    m.update([gt], [det])
+    assert m.get()[1] == 1.0  # only the easy gt counts; its det is tp
+
+
+def test_map_class_names_breakdown():
+    m = MApMetric(class_names=["cat", "dog"])
+    gt = _labels([[0, 0.1, 0.1, 0.4, 0.4], [1, 0.5, 0.5, 0.9, 0.9]])
+    det = _dets([[0, 0.9, 0.1, 0.1, 0.4, 0.4], [1, 0.8, 0.0, 0.0, 0.1, 0.1]])
+    m.update([gt], [det])
+    names, values = m.get()
+    assert names[0] == "mAP" and "cat_AP" in names and "dog_AP" in names
+    d = dict(zip(names, values))
+    assert d["cat_AP"] == 1.0 and d["dog_AP"] == 0.0 and d["mAP"] == 0.5
+
+
+def test_map_end_to_end_multibox_detection():
+    """Drive the real inference op chain: anchors == gt boxes, zero loc
+    offsets, confident class scores -> MultiBoxDetection + box_nms ->
+    VOC07 mAP == 1; scrambled classes -> 0."""
+    gt_boxes = np.asarray([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]],
+                          np.float32)
+    gt_cls = [0, 1]  # foreground ids (background_id=0 inside cls_prob)
+    extra = np.asarray([[0.0, 0.6, 0.25, 0.95]], np.float32)  # decoy anchor
+    anchors = nd.array(np.concatenate([gt_boxes, extra])[None])  # (1,3,4)
+    n = 3
+    num_classes = 3  # background + 2 fg
+    b = 1
+    cls_prob = np.full((b, num_classes, n), 0.02, np.float32)
+    cls_prob[0, 0, :] = 0.9  # background everywhere...
+    for i, c in enumerate(gt_cls):
+        cls_prob[0, :, i] = 0.02
+        cls_prob[0, c + 1, i] = 0.9  # ...except the gt anchors
+    loc_pred = np.zeros((b, n * 4), np.float32)
+
+    dets = nd.contrib.MultiBoxDetection(nd.array(cls_prob),
+                                        nd.array(loc_pred), anchors,
+                                        nms_threshold=0.45, threshold=0.1)
+    labels = np.concatenate(
+        [np.asarray(gt_cls, np.float32)[:, None], gt_boxes], axis=1)[None]
+    m = VOC07MApMetric(ovp_thresh=0.5)
+    m.update([labels], [dets])
+    assert abs(m.get()[1] - 1.0) < 1e-9, f"expected perfect mAP, got {m.get()}"
+
+    # scrambled: swap the two fg class scores -> every det is wrong-class
+    m2 = VOC07MApMetric(ovp_thresh=0.5)
+    cls_bad = cls_prob.copy()
+    cls_bad[0, 1, :], cls_bad[0, 2, :] = cls_prob[0, 2, :], cls_prob[0, 1, :]
+    dets_bad = nd.contrib.MultiBoxDetection(nd.array(cls_bad),
+                                            nd.array(loc_pred), anchors,
+                                            nms_threshold=0.45, threshold=0.1)
+    m2.update([labels], [dets_bad])
+    assert m2.get()[1] == 0.0
+
+
+def test_map_registry_create():
+    m = mx.metric.create("VOC07MApMetric")
+    assert isinstance(m, VOC07MApMetric)
+
+
+def test_map_difficult_not_consumed():
+    # two dets both overlap ONE difficult gt: VOC devkit ignores both
+    # (the difficult gt is never consumed); neither is a false positive
+    m = MApMetric(use_difficult=False)
+    gt = np.asarray([[[0, 0.1, 0.1, 0.4, 0.4, 1.0],
+                      [0, 0.5, 0.5, 0.9, 0.9, 0.0]]], np.float32)
+    det = _dets([[0, 0.9, 0.1, 0.1, 0.4, 0.4],
+                 [0, 0.85, 0.11, 0.1, 0.41, 0.4],
+                 [0, 0.8, 0.5, 0.5, 0.9, 0.9]])
+    m.update([gt], [det])
+    assert m.get()[1] == 1.0  # both difficult-matches ignored, easy gt tp

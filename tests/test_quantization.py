@@ -209,3 +209,124 @@ def test_quantize_net_activation_flatten_and_root():
         w.simplefilter("always")
         quantize_net(net3, calib_data=[x], calib_mode="naive")
     assert any("no Dense layer was quantized" in str(r.message) for r in rec)
+
+
+def test_quantize_model_symbolic_fc():
+    """Reference symbolic entry point: quantize_model on an MLP rewrites FC
+    nodes into quantize_v2 -> int8 FC -> dequantize and matches f32."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.contrib.quantization import quantize_model
+    from mxnet_tpu.io import NDArrayIter
+
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+
+    args = {
+        "fc1_weight": nd.array(rng.uniform(-0.5, 0.5, (16, 8)).astype(np.float32)),
+        "fc1_bias": nd.array(rng.uniform(-0.1, 0.1, (16,)).astype(np.float32)),
+        "fc2_weight": nd.array(rng.uniform(-0.5, 0.5, (4, 16)).astype(np.float32)),
+        "fc2_bias": nd.array(rng.uniform(-0.1, 0.1, (4,)).astype(np.float32)),
+    }
+    x = rng.uniform(0, 1, (32, 8)).astype(np.float32)
+    calib = NDArrayIter(x, batch_size=8)
+
+    qsym, qargs, qaux = quantize_model(
+        net, args, {}, calib_mode="naive", calib_data=calib,
+        data_names=("data",))
+    assert "fc1_weight_quantize" in qargs
+    assert qargs["fc1_weight_quantize"].dtype == np.int8
+    assert "fc1_weight" not in qargs
+    ops = [n._op for n in qsym._base()._topo() if n._op]
+    assert "_contrib_quantize_v2" in ops
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "_contrib_dequantize" in ops
+
+    ref = net.eval(data=nd.array(x), **args)
+    out = qsym.eval(data=nd.array(x), **qargs)
+    ref0 = ref[0].asnumpy() if isinstance(ref, (list, tuple)) else ref.asnumpy()
+    out0 = out[0].asnumpy() if isinstance(out, (list, tuple)) else out.asnumpy()
+    scale = np.abs(ref0).max()
+    assert np.abs(out0 - ref0).max() / scale < 0.05, \
+        f"int8 output deviates {np.abs(out0 - ref0).max() / scale:.3f}"
+
+
+def test_quantize_model_symbolic_conv():
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    rng = np.random.RandomState(1)
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                          name="conv1")
+    net = sym.Activation(net, act_type="relu", name="reluc")
+    args = {
+        "conv1_weight": nd.array(rng.uniform(-0.3, 0.3, (4, 3, 3, 3)).astype(np.float32)),
+        "conv1_bias": nd.array(rng.uniform(-0.1, 0.1, (4,)).astype(np.float32)),
+    }
+    x = rng.uniform(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    qsym, qargs, _ = quantize_model(net, args, {}, calib_mode="none")
+    ref = net.eval(data=nd.array(x), **args)
+    out = qsym.eval(data=nd.array(x), **qargs)
+    ref0 = ref[0].asnumpy() if isinstance(ref, (list, tuple)) else ref.asnumpy()
+    out0 = out[0].asnumpy() if isinstance(out, (list, tuple)) else out.asnumpy()
+    scale = np.abs(ref0).max()
+    assert np.abs(out0 - ref0).max() / scale < 0.05
+
+
+def test_quantize_model_excluded_and_graph():
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.contrib.quantization import quantize_graph, quantize_model
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fcx", no_bias=True)
+    args = {"fcx_weight": nd.array(np.eye(4, 6, dtype=np.float32))}
+    qsym, qargs, _ = quantize_model(net, args, {}, calib_mode="none",
+                                    excluded_sym_names=["fcx"])
+    assert [n._op for n in qsym._base()._topo() if n._op] == ["FullyConnected"]
+    gsym, gargs, _, collector = quantize_graph(net, args, {})
+    assert collector is None
+    assert "fcx_weight_quantize" in gargs
+
+
+def test_quantize_model_tied_weights():
+    """A weight shared by two FC nodes quantizes once and both layers
+    produce real (non-zero) int8 outputs."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.contrib.quantization import quantize_model
+
+    rng = np.random.RandomState(2)
+    w_shared = nd.array(rng.uniform(-0.5, 0.5, (8, 8)).astype(np.float32))
+    data = sym.Variable("data")
+    wvar = sym.Variable("shared_weight")
+    h = sym.FullyConnected(data, wvar, num_hidden=8, no_bias=True,
+                           name="fca")
+    out = sym.FullyConnected(h, wvar, num_hidden=8, no_bias=True,
+                             name="fcb")
+    args = {"shared_weight": w_shared}
+    x = rng.uniform(0, 1, (4, 8)).astype(np.float32)
+    qsym, qargs, _ = quantize_model(out, args, {}, calib_mode="none")
+    assert "shared_weight_quantize" in qargs
+    assert "shared_weight" not in qargs  # fully consumed
+    ref = out.eval(data=nd.array(x), **args)
+    got = qsym.eval(data=nd.array(x), **qargs)
+    ref0 = ref[0].asnumpy() if isinstance(ref, (list, tuple)) else ref.asnumpy()
+    got0 = got[0].asnumpy() if isinstance(got, (list, tuple)) else got.asnumpy()
+    assert np.abs(got0).max() > 0, "tied-weight int8 graph went silent zero"
+    scale = np.abs(ref0).max()
+    assert np.abs(got0 - ref0).max() / scale < 0.08
+
+
+def test_quantize_graph_honors_calib_mode():
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.contrib.quantization import quantize_graph
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fcg", no_bias=True)
+    args = {"fcg_weight": nd.array(np.eye(4, 6, dtype=np.float32))}
+    with pytest.raises(NotImplementedError):
+        quantize_graph(net, args, {}, calib_mode="entropy")
+    with pytest.raises(ValueError):
+        quantize_graph(net, args, {}, calib_mode="naive")  # no calib_data
