@@ -4,15 +4,24 @@ Reference counterpart: ``python/mxnet/contrib/onnx/`` (mx2onnx/onnx2mx —
 TBV, mount empty). The reference builds protobuf messages through the onnx
 package's generated classes; this image cannot install it, so the wire
 format is emitted/parsed directly by ``_onnx_proto`` (the format is three
-primitives; the schema field numbers are public). Covered surface: the
-CNN/MLP op families the model zoo uses — Conv, Gemm(+Flatten),
-BatchNormalization, activations, pooling (incl. global), Softmax/
-LogSoftmax, elementwise/broadcast arithmetic, Concat, Dropout, Reshape,
-Transpose, Sum, Clip, LeakyRelu, Identity. Opset 9, fp32 tensors.
+primitives; the schema field numbers are public). Covered surface:
+- CNN/MLP: Conv, Gemm(+Flatten), BatchNormalization, activations,
+  pooling (incl. global), Softmax/LogSoftmax, elementwise/broadcast
+  arithmetic, Concat, Dropout, Reshape, Transpose, Sum, Clip, LeakyRelu,
+  Identity, Tile, Slice, Squeeze/Unsqueeze.
+- word_lm family: Embedding→Cast+Gather, fused RNN (LSTM mode, any
+  num_layers)→per-layer ONNX LSTM chain with cuDNN→ONNX gate reorder,
+  FC(flatten=False)→Transpose+MatMul+Add.
+- transformer family: dot/batch_dot→MatMul (±Transpose), last-axis
+  LayerNorm→opset-9 ReduceMean/Sub/Sqrt decomposition, erf-gelu→Erf
+  decomposition, Exp/Log/Sqrt/Erf, Pow.
+Opset 9, fp32 tensors, single-direction RNN.
 
 ``export_model`` and ``import_model`` round-trip through real ONNX bytes:
 tests/test_onnx.py re-imports an exported ResNet-style graph and checks
-executor outputs match to 1e-5.
+executor outputs match to 1e-5; tests/test_onnx_models.py round-trips the
+word_lm LSTM and an attention block, and imports a fixture whose bytes
+were encoded INDEPENDENTLY of _onnx_proto (shared-misreading guard).
 """
 from __future__ import annotations
 
@@ -126,24 +135,50 @@ def _conv_attrs(a):
     return out
 
 
-def _export_node(node, in_names, out_name, params, extra_inits, in_rank=None):
+_LSTM_GATE_PERM = (0, 3, 1, 2)  # mx/cuDNN [i,f,g,o] -> ONNX [i,o,f,c]
+
+
+def _lstm_reorder(mat, h):
+    """Permute stacked (4h, ...) gate blocks from mx to ONNX order."""
+    blocks = [mat[i * h:(i + 1) * h] for i in range(4)]
+    return np.concatenate([blocks[j] for j in _LSTM_GATE_PERM], axis=0)
+
+
+def _export_node(node, in_names, out_name, params, extra_inits,
+                 in_shapes=None):
     """Returns (onnx node bytes, handled: bool).
 
-    in_rank: rank of the node's first input when shape inference succeeded,
-    else None — used to guard opset-9 coerce-to-2D Softmax semantics.
+    in_shapes: per-input shapes when shape inference succeeded (None
+    entries otherwise) — used for the opset-9 Softmax axis guard, RNN
+    weight unpacking, and MatMul transpose perms.
     """
     op = node._op
     a = node._attrs
     nm = node._name
+    in_rank = None
+    if in_shapes and in_shapes[0] is not None:
+        in_rank = len(in_shapes[0])
     if op == "Convolution":
         return _node("Conv", in_names, [out_name], nm, _conv_attrs(a)), True
     if op == "FullyConnected":
         flat_out = nm + "_flat"
         nodes = b""
         data_in = in_names[0]
+        if not _flag(a.get("flatten", True)) and in_rank != 2:
+            # ND input (e.g. the word_lm decoder over (T,N,H)): opset-9
+            # Gemm is 2D-only, so emit Transpose(W) + MatMul (+ Add bias)
+            wt = nm + "_wT"
+            nodes += _node("Transpose", [in_names[1]], [wt], wt,
+                           _attr_ints("perm", (1, 0)))
+            mm = nm + "_mm" if len(in_names) > 2 else out_name
+            nodes += _node("MatMul", [data_in, wt], [mm], nm + "_matmul")
+            if len(in_names) > 2:
+                nodes += _node("Add", [mm, in_names[2]], [out_name],
+                               nm + "_bias")
+            return nodes, True
         if _flag(a.get("flatten", True)):
-            nodes += _node("Flatten", [in_names[0]], [flat_out], nm + "_flatten",
-                           _attr_int("axis", 1))
+            nodes += _node("Flatten", [in_names[0]], [flat_out],
+                           nm + "_flatten", _attr_int("axis", 1))
             data_in = flat_out
         ins = [data_in] + in_names[1:]
         if len(ins) == 2:  # no_bias: opset-9 Gemm requires C — zeros
@@ -237,7 +272,185 @@ def _export_node(node, in_names, out_name, params, extra_inits, in_rank=None):
                      + _attr_float("max", float(a.get("a_max")))), True
     if op == "identity":
         return _node("Identity", in_names, [out_name], nm), True
+    if op == "Embedding":
+        # mx Embedding takes float indices; ONNX Gather needs int64
+        idx64 = nm + "_idx64"
+        nodes = _node("Cast", [in_names[0]], [idx64], idx64,
+                      _attr_int("to", 7))  # TensorProto.INT64
+        nodes += _node("Gather", [in_names[1], idx64], [out_name], nm,
+                       _attr_int("axis", 0))
+        return nodes, True
+    if op == "RNN":
+        return _export_rnn(node, in_names, out_name, params, extra_inits,
+                           in_shapes)
+    if op in ("dot", "batch_dot"):
+        ta = _flag(a.get("transpose_a", False))
+        tb = _flag(a.get("transpose_b", False))
+        nodes = b""
+        names = list(in_names)
+        for pos, t in ((0, ta), (1, tb)):
+            if not t:
+                continue
+            shp = in_shapes[pos] if in_shapes else None
+            rank = len(shp) if shp is not None else (2 if op == "dot" else 3)
+            perm = tuple(range(rank - 2)) + (rank - 1, rank - 2)
+            tnm = f"{nm}_in{pos}T"
+            nodes += _node("Transpose", [names[pos]], [tnm], tnm,
+                           _attr_ints("perm", perm))
+            names[pos] = tnm
+        nodes += _node("MatMul", names, [out_name], nm)
+        return nodes, True
+    if op == "LayerNorm":
+        ax = int(a.get("axis", -1))
+        rank = in_rank
+        if not (ax == -1 or (rank is not None and ax == rank - 1)):
+            raise ValueError(
+                f"mx2onnx: LayerNorm axis={ax} export supports only the "
+                "last axis (opset-9 decomposition reduces over -1)")
+        eps_nm = nm + "_eps"
+        extra_inits.append((eps_nm,
+                            np.float32(a.get("eps", 1e-5)).reshape(())))
+        x, g, b_ = in_names[0], in_names[1], in_names[2]
+        # positive reduce axis when the rank is known — opset-9 Reduce ops
+        # predate the negative-axes clarification
+        red = [rank - 1] if rank is not None else [-1]
+        n = lambda t: f"{nm}_{t}"  # noqa: E731
+        nodes = _node("ReduceMean", [x], [n("m")], n("m"),
+                      _attr_ints("axes", red) + _attr_int("keepdims", 1))
+        nodes += _node("Sub", [x, n("m")], [n("d")], n("d"))
+        nodes += _node("Mul", [n("d"), n("d")], [n("d2")], n("d2"))
+        nodes += _node("ReduceMean", [n("d2")], [n("v")], n("v"),
+                       _attr_ints("axes", red) + _attr_int("keepdims", 1))
+        nodes += _node("Add", [n("v"), eps_nm], [n("ve")], n("ve"))
+        nodes += _node("Sqrt", [n("ve")], [n("sd")], n("sd"))
+        nodes += _node("Div", [n("d"), n("sd")], [n("q")], n("q"))
+        nodes += _node("Mul", [n("q"), g], [n("sg")], n("sg"))
+        nodes += _node("Add", [n("sg"), b_], [out_name], nm)
+        return nodes, True
+    if op == "gelu" and a.get("approximation", "erf") == "erf":
+        # 0.5 * x * (1 + erf(x / sqrt(2))) — exact ops/elemwise.py form
+        s2 = nm + "_sqrt2"
+        half = nm + "_half"
+        one = nm + "_one"
+        extra_inits += [(s2, np.float32(1.4142135623730951).reshape(())),
+                        (half, np.float32(0.5).reshape(())),
+                        (one, np.float32(1.0).reshape(()))]
+        x = in_names[0]
+        n = lambda t: f"{nm}_{t}"  # noqa: E731
+        nodes = _node("Div", [x, s2], [n("xs")], n("xs"))
+        nodes += _node("Erf", [n("xs")], [n("e")], n("e"))
+        nodes += _node("Add", [n("e"), one], [n("e1")], n("e1"))
+        nodes += _node("Mul", [x, n("e1")], [n("xe")], n("xe"))
+        nodes += _node("Mul", [n("xe"), half], [out_name], nm)
+        return nodes, True
+    if op in ("exp", "log", "sqrt", "erf"):
+        return _node({"exp": "Exp", "log": "Log", "sqrt": "Sqrt",
+                      "erf": "Erf"}[op], in_names, [out_name], nm), True
+    if op == "squeeze":
+        ax = a.get("axis")
+        if ax is None:
+            attrs = b""
+        else:
+            axes = [int(x) for x in _tuple(ax, 1)]
+            if any(x < 0 for x in axes):
+                if in_rank is None:
+                    raise ValueError("mx2onnx: negative squeeze axis needs "
+                                     "shape inference (opset-9 Squeeze "
+                                     "requires non-negative axes)")
+                axes = [x % in_rank for x in axes]
+            attrs = _attr_ints("axes", axes)
+        return _node("Squeeze", in_names, [out_name], nm, attrs), True
+    if op == "expand_dims":
+        ax = int(a.get("axis", 0))
+        if ax < 0:
+            if in_rank is None:
+                raise ValueError("mx2onnx: negative expand_dims axis needs "
+                                 "shape inference (opset-9 Unsqueeze "
+                                 "requires non-negative axes)")
+            ax %= in_rank + 1
+        return _node("Unsqueeze", in_names, [out_name], nm,
+                     _attr_ints("axes", [ax])), True
+    if op == "tile":
+        reps = _tuple(a.get("reps", a.get("repeats", ())), 1)
+        rname = nm + "_reps"
+        extra_inits.append((rname, np.asarray(reps, np.int64)))
+        return _node("Tile", [in_names[0], rname], [out_name], nm), True
+    if op == "slice_axis":
+        ax = int(a.get("axis", 0))
+        begin = int(a.get("begin", 0))
+        end = a.get("end")
+        end = 2 ** 31 - 1 if end in (None, "None") else int(end)
+        return _node("Slice", in_names, [out_name], nm,
+                     _attr_ints("axes", [ax]) + _attr_ints("starts", [begin])
+                     + _attr_ints("ends", [end])), True
     return b"", False
+
+
+def _export_rnn(node, in_names, out_name, params, extra_inits, in_shapes):
+    """mx fused RNN (LSTM mode) -> a chain of ONNX LSTM nodes, one per
+    layer (ONNX LSTM is single-layer). The cuDNN-canonical flat parameter
+    vector (ops/rnn.py layout) unpacks into per-layer W/R/B with gate
+    reorder [i,f,g,o] -> [i,o,f,c]. Dropout (`p`) is ignored — exported
+    graphs are inference graphs, where it is inactive anyway."""
+    a = node._attrs
+    nm = node._name
+    mode = a.get("mode", "rnn_tanh")
+    if mode != "lstm":
+        return b"", False  # GRU gate conventions differ (linear_before_reset)
+    if _flag(a.get("bidirectional", False)):
+        raise ValueError("mx2onnx: bidirectional RNN export not supported")
+    h = int(a.get("state_size"))
+    L = int(a.get("num_layers", 1))
+    pname = node._inputs[1]._base()._name
+    pvec = params.get(pname)
+    if pvec is None:
+        raise ValueError(f"mx2onnx: RNN parameter vector {pname!r} must be "
+                         "a stored parameter")
+    if not in_shapes or in_shapes[0] is None:
+        raise ValueError("mx2onnx: RNN export needs input shape inference")
+    input_size = int(in_shapes[0][-1])
+    pvec = np.asarray(pvec, np.float32).reshape(-1)
+    off = 0
+    Ws, Rs, Bs = [], [], []
+    for layer in range(L):
+        isz = input_size if layer == 0 else h
+        Ws.append(pvec[off:off + 4 * h * isz].reshape(4 * h, isz))
+        off += 4 * h * isz
+        Rs.append(pvec[off:off + 4 * h * h].reshape(4 * h, h))
+        off += 4 * h * h
+    for layer in range(L):
+        b_ih = pvec[off:off + 4 * h]
+        off += 4 * h
+        b_hh = pvec[off:off + 4 * h]
+        off += 4 * h
+        Bs.append((b_ih, b_hh))
+    nodes = b""
+    x_name = in_names[0]
+    h0_name, c0_name = in_names[2], in_names[3]
+    for layer in range(L):
+        wn, rn, bn = (f"{nm}_W{layer}", f"{nm}_R{layer}", f"{nm}_B{layer}")
+        extra_inits.append((wn, _lstm_reorder(Ws[layer], h)[None]))
+        extra_inits.append((rn, _lstm_reorder(Rs[layer], h)[None]))
+        extra_inits.append((bn, np.concatenate(
+            [_lstm_reorder(Bs[layer][0], h),
+             _lstm_reorder(Bs[layer][1], h)])[None]))
+        if L == 1:
+            h0_l, c0_l = h0_name, c0_name
+        else:
+            h0_l, c0_l = f"{nm}_h0_{layer}", f"{nm}_c0_{layer}"
+            sl = (_attr_ints("axes", [0]) + _attr_ints("starts", [layer])
+                  + _attr_ints("ends", [layer + 1]))
+            nodes += _node("Slice", [h0_name], [h0_l], h0_l, sl)
+            nodes += _node("Slice", [c0_name], [c0_l], c0_l, sl)
+        y4 = f"{nm}_l{layer}_y4"
+        nodes += _node("LSTM", [x_name, wn, rn, bn, "", h0_l, c0_l], [y4],
+                       f"{nm}_l{layer}", _attr_int("hidden_size", h))
+        y3 = out_name if layer == L - 1 else f"{nm}_l{layer}_y"
+        # ONNX Y is (T, num_dir, N, h); drop the direction axis
+        nodes += _node("Squeeze", [y4], [y3], y3 + "_sq",
+                       _attr_ints("axes", [1]))
+        x_name = y3
+    return nodes, True
 
 
 def export_model(sym, params, input_shape, input_type=None,
@@ -286,6 +499,19 @@ def export_model(sym, params, input_shape, input_type=None,
     except Exception:
         node_shapes = {}
 
+    # params consumed ONLY as RNN packed-parameter vectors are replaced by
+    # the repacked per-layer W/R/B initializers — writing the flat vector
+    # too would double the RNN weight bytes and leave a dead arg_param
+    replaced_params = set()
+    for node in topo:
+        if node._op == "RNN" and len(node._inputs) > 1:
+            replaced_params.add(node._inputs[1]._base()._name)
+    for node in topo:
+        for pos, i in enumerate(node._inputs):
+            if node._op == "RNN" and pos == 1:
+                continue
+            replaced_params.discard(i._base()._name)
+
     out_of: Dict[int, str] = {}
     nodes = b""
     graph_inputs: List[bytes] = []
@@ -296,8 +522,9 @@ def export_model(sym, params, input_shape, input_type=None,
         if node._op is None:
             out_of[id(node)] = node._name
             if node._name in np_params:
-                inits += P.field_message(5, _tensor(node._name,
-                                                    np_params[node._name]))
+                if node._name not in replaced_params:
+                    inits += P.field_message(5, _tensor(node._name,
+                                                        np_params[node._name]))
             else:
                 shp = shapes[min(shape_i, len(shapes) - 1)]
                 shape_i += 1
@@ -311,13 +538,14 @@ def export_model(sym, params, input_shape, input_type=None,
                     "multi-output node — not supported")
         in_names = [out_of[id(i._base())] for i in node._inputs]
         out_name = node._name + "_out"
-        in_rank = None
-        if node._inputs:
-            s = node_shapes.get(id(node._inputs[0]._base()))
-            if isinstance(s, tuple):
-                in_rank = len(s)
+        in_shapes = []
+        for i in node._inputs:
+            s = node_shapes.get(id(i._base()))
+            if isinstance(s, list) and i._index is not None:
+                s = s[i._index]
+            in_shapes.append(s if isinstance(s, tuple) else None)
         nb, ok = _export_node(node, in_names, out_name, np_params,
-                              extra_inits, in_rank=in_rank)
+                              extra_inits, in_shapes=in_shapes)
         if not ok:
             raise ValueError(f"mx2onnx: op {node._op!r} has no ONNX mapping; "
                              "supported set is the model-zoo CNN/MLP family")
@@ -364,6 +592,57 @@ def _parse_tensor(raw):
     return name, arr
 
 
+_LSTM_GATE_UNPERM = (0, 2, 3, 1)  # ONNX [i,o,f,c] -> mx/cuDNN [i,f,g,o]
+
+
+def _import_lstm(ins, outs, a, name, inits, sym_of, S):
+    """ONNX LSTM node -> mx fused RNN symbol. W/R/B initializers repack
+    (gate reorder + flatten) into the cuDNN-canonical vector ops/rnn.py
+    unpacks; only the single-direction, Y-consumed form is supported."""
+    if len(ins) > 4 and ins[4]:
+        raise ValueError("onnx2mx: LSTM sequence_lens input unsupported")
+    for missing in (1, 2):
+        if ins[missing] not in inits:
+            raise ValueError("onnx2mx: LSTM W/R must be initializers")
+    h = int(a.get("hidden_size"))
+    W = np.asarray(inits.pop(ins[1]), np.float32)
+    R = np.asarray(inits.pop(ins[2]), np.float32)
+    if W.shape[0] != 1:
+        raise ValueError("onnx2mx: bidirectional LSTM import unsupported")
+    W, R = W[0], R[0]
+    if len(ins) > 3 and ins[3]:
+        if ins[3] not in inits:
+            raise ValueError("onnx2mx: LSTM B must be an initializer "
+                             "(computed/graph-input biases unsupported)")
+        B = np.asarray(inits.pop(ins[3]), np.float32)[0]
+    else:
+        B = np.zeros(8 * h, np.float32)
+
+    def unperm(mat):
+        blocks = [mat[i * h:(i + 1) * h] for i in range(4)]
+        return np.concatenate([blocks[j] for j in _LSTM_GATE_UNPERM], axis=0)
+
+    flat = np.concatenate([unperm(W).reshape(-1), unperm(R).reshape(-1),
+                           unperm(B[:4 * h]), unperm(B[4 * h:])])
+    pname = name + "_rnn_params"
+    inits[pname] = flat
+
+    def default_state():
+        # spec default is zeros with the INPUT's batch dim — build it from
+        # X so the shape stays symbolic: (1, N, 1) zeros tiled to (1, N, h)
+        t0 = S.slice_axis(sym_of(ins[0]), axis=0, begin=0, end=1)
+        z = S.mean(t0, axis=-1, keepdims=True) * 0.0
+        return S.tile(z, reps=(1, 1, h))
+
+    h0 = (sym_of(ins[5]) if len(ins) > 5 and ins[5] else default_state())
+    c0 = (sym_of(ins[6]) if len(ins) > 6 and ins[6] else default_state())
+    rnn = S.RNN(sym_of(ins[0]), S.Variable(pname), h0, c0, state_size=h,
+                num_layers=1, mode="lstm", name=name)
+    # ONNX Y is (T, num_dir=1, N, h): restore the direction axis the mx
+    # RNN output (T, N, h) lacks so downstream Squeeze/Slice nodes fit
+    return S.expand_dims(rnn, axis=1, name=name + "_y4")
+
+
 def _parse_attrs(node_fields):
     attrs = {}
     for raw in node_fields.get(5, []):
@@ -408,9 +687,12 @@ def import_model(model_file):
         if name not in inits:
             tensors[name] = sym_mod.Variable(name)
 
+    auto_vars = set()  # names sym_of materialized out of thin air
+
     def sym_of(name):
         if name not in tensors:
             tensors[name] = sym_mod.Variable(name)
+            auto_vars.add(name)
         return tensors[name]
 
     # Initializers consumed as Clip bounds: read WITHOUT popping (exporters
@@ -582,6 +864,74 @@ def import_model(model_file):
                          name=name)
         elif op == "Identity":
             out = sym_of(ins[0])
+        elif op == "Cast":
+            to = int(a.get("to", 1))
+            dt = {1: "float32", 6: "int32", 7: "int64", 10: "float16",
+                  11: "float64", 16: "bfloat16"}.get(to)
+            if dt is None:
+                raise ValueError(f"onnx2mx: Cast to dtype enum {to} "
+                                 "unsupported")
+            out = S.Cast(sym_of(ins[0]), dtype=dt, name=name)
+        elif op == "Gather":
+            ax = int(a.get("axis", 0))
+            out = S.take(sym_of(ins[0]), sym_of(ins[1]), axis=ax, name=name)
+        elif op == "MatMul":
+            # rank is unknown at import: a 2D initializer operand means the
+            # projection form (dot); otherwise assume batched 3D matmul
+            if ins[1] in inits and inits[ins[1]].ndim == 2:
+                out = S.dot(sym_of(ins[0]), sym_of(ins[1]), name=name)
+            elif ins[0] in inits and inits[ins[0]].ndim == 2:
+                out = S.dot(sym_of(ins[0]), sym_of(ins[1]), name=name)
+            else:
+                out = S.batch_dot(sym_of(ins[0]), sym_of(ins[1]), name=name)
+        elif op == "LSTM":
+            out = _import_lstm(ins, outs, a, name, inits, sym_of, S)
+            tensors[outs[0]] = out
+            continue
+        elif op == "Squeeze":
+            axes = a.get("axes")
+            out = S.squeeze(sym_of(ins[0]),
+                            axis=(tuple(int(x) for x in axes)
+                                  if axes is not None else None), name=name)
+        elif op == "Unsqueeze":
+            axes = tuple(int(x) for x in a.get("axes", (0,)))
+            out = sym_of(ins[0])
+            for ax in sorted(axes):
+                out = S.expand_dims(out, axis=ax)
+        elif op == "Slice":
+            axes = [int(x) for x in a.get("axes", ())]
+            starts = [int(x) for x in a.get("starts", ())]
+            ends = [int(x) for x in a.get("ends", ())]
+            if len(ins) > 1:
+                raise ValueError("onnx2mx: opset-10+ Slice with bound "
+                                 "inputs is unsupported (attrs only)")
+            out = sym_of(ins[0])
+            for ax, b0, e0 in zip(axes or range(len(starts)), starts, ends):
+                out = S.slice_axis(out, axis=ax, begin=b0,
+                                   end=None if e0 >= 2 ** 31 - 1 else e0)
+        elif op == "ReduceMean":
+            axes = a.get("axes")
+            kd = bool(int(a.get("keepdims", 1)))
+            out = S.mean(sym_of(ins[0]),
+                         axis=(tuple(int(x) for x in axes)
+                               if axes is not None else None),
+                         keepdims=kd, name=name)
+        elif op in ("Sqrt", "Exp", "Log", "Erf"):
+            fn = {"Sqrt": S.sqrt, "Exp": S.exp, "Log": S.log,
+                  "Erf": S.erf}[op]
+            out = fn(sym_of(ins[0]), name=name)
+        elif op == "Pow":
+            out = S.broadcast_power(sym_of(ins[0]), sym_of(ins[1]),
+                                    name=name)
+        elif op == "Tile":
+            if ins[1] not in inits:
+                raise ValueError("onnx2mx: Tile repeats must be an "
+                                 "initializer (dynamic repeats unsupported)")
+            # read WITHOUT popping — exporters dedupe constants, one reps
+            # tensor may feed several Tiles (same rule as Clip bounds)
+            bound_uses[ins[1]] = bound_uses.get(ins[1], 0) + 1
+            reps = tuple(int(x) for x in inits[ins[1]])
+            out = S.tile(sym_of(ins[0]), reps=reps, name=name)
         else:
             raise ValueError(f"onnx2mx: unsupported ONNX op {op!r}")
         tensors[outs[0]] = out
@@ -589,6 +939,18 @@ def import_model(model_file):
     for nm_b, n_bound in bound_uses.items():  # bounds-only tensors: not params
         if use_count.get(nm_b, 0) <= n_bound:
             inits.pop(nm_b, None)
+
+    # Fail loudly on dangling references: a node consuming a tensor that is
+    # neither a graph input, an initializer, nor another node's output
+    # (e.g. an unsupported multi-output leg like LSTM Y_h) would otherwise
+    # silently import as a free Variable
+    graph_input_names = {P.string_of(P.parse_message(r)[1][0])
+                         for r in graph.get(11, [])}
+    dangling = auto_vars - set(inits) - graph_input_names - aux_names
+    if dangling:
+        raise ValueError(
+            f"onnx2mx: graph references undeclared tensors {sorted(dangling)}"
+            " — likely an unsupported multi-output leg of an imported node")
 
     final_out = P.string_of(P.parse_message(graph[12][0])[1][0])
     sym = tensors[final_out]
