@@ -786,3 +786,230 @@ def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
 def array_equal(a1, a2, equal_nan=False):
     return bool(jnp.array_equal(_unwrap(a1), _unwrap(a2),
                                 equal_nan=equal_nan))
+
+
+# --------------------------------------------------------------------------
+# Round-5 explicit promotions (VERDICT r4 item 4: shrink the delegate tail).
+# `_tape_op` binds a jnp function whose numpy semantics already coincide
+# with mxnet-numpy under this build (32-bit default dtypes: jax_enable_x64
+# is off, so the float64-promotion divergence the delegate warns about
+# cannot occur in these), recording array inputs on the autograd tape.
+# Ops whose mxnet semantics differ from raw jnp get dedicated defs below.
+# --------------------------------------------------------------------------
+
+def _unwrap_deep(x):
+    """Recursive unwrap: ops like select/row_stack take LISTS of arrays."""
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap_deep(v) for v in x)
+    return x
+
+
+def _tape_op(name, fn):
+    @_np_op(name)
+    def op(*args, **kwargs):
+        arr_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+        kw = {k: _unwrap_deep(v) for k, v in kwargs.items()}
+        if not arr_idx:
+            res = fn(*[_unwrap_deep(a) for a in args], **kw)
+            return _wrap_out(res)
+
+        idx_set = set(arr_idx)
+
+        def pure(*tensors):
+            it = iter(tensors)
+            rebuilt = [next(it) if i in idx_set else _unwrap_deep(args[i])
+                       for i in range(len(args))]
+            return fn(*rebuilt, **kw)
+
+        return _invoke(pure, [args[i] for i in arr_idx])
+    return op
+
+
+def _wrap_out(res):
+    if isinstance(res, (tuple, list)):
+        return type(res)(_wrap_out(r) for r in res)
+    if isinstance(res, jax.Array):
+        return NDArray(res)
+    return res
+
+
+for _nm, _fn in [
+    # elementwise / unary
+    ("signbit", jnp.signbit), ("real", jnp.real), ("imag", jnp.imag),
+    ("angle", jnp.angle), ("sinc", jnp.sinc), ("i0", jnp.i0),
+    ("fabs", jnp.fabs), ("flatnonzero", jnp.flatnonzero),
+    ("nextafter", jnp.nextafter), ("ldexp", jnp.ldexp),
+    ("frexp", jnp.frexp), ("logaddexp2", jnp.logaddexp2),
+    ("divmod", jnp.divmod), ("nanargmax", jnp.nanargmax),
+    ("nanargmin", jnp.nanargmin), ("nancumsum", jnp.nancumsum),
+    ("nancumprod", jnp.nancumprod),
+    # logic / sets / search
+    ("isin", jnp.isin), ("digitize", jnp.digitize),
+    # manipulation
+    ("broadcast_arrays", jnp.broadcast_arrays),
+    ("row_stack", jnp.vstack), ("vander", jnp.vander),
+    ("delete", jnp.delete), ("append", jnp.append),
+    ("resize", jnp.resize), ("compress", jnp.compress),
+    ("extract", jnp.extract), ("unwrap", jnp.unwrap),
+    ("select", jnp.select), ("trim_zeros", jnp.trim_zeros),
+    # math over arrays
+    ("convolve", jnp.convolve), ("correlate", jnp.correlate),
+    ("polyval", jnp.polyval), ("gradient", jnp.gradient),
+    ("histogram", jnp.histogram),
+    # index helpers
+    ("tril_indices", jnp.tril_indices), ("triu_indices", jnp.triu_indices),
+    ("diag_indices", jnp.diag_indices), ("indices", jnp.indices),
+    ("ix_", jnp.ix_), ("unravel_index", jnp.unravel_index),
+    ("ravel_multi_index", jnp.ravel_multi_index),
+]:
+    _tape_op(_nm, _fn)
+
+
+@_np_op("insert")
+def insert(arr, obj, values, axis=None):
+    return _invoke(lambda a, v: jnp.insert(a, _unwrap(obj), v, axis=axis),
+                   [arr, values])
+
+
+@_np_op("float_power")
+def float_power(x1, x2, out=None, **kw):
+    # numpy promises float64; the mxnet default float is float32
+    return _invoke(lambda a, b: jnp.power(_to_float(a), _to_float(b)),
+                   [x1, x2], out)
+
+
+@_np_op("trapz")
+def trapz(y, x=None, dx=1.0, axis=-1):
+    arrays = [y] if x is None else [y, x]
+
+    def pure(yy, *maybe_x):
+        return jnp.trapezoid(_to_float(yy),
+                             _to_float(maybe_x[0]) if maybe_x else None,
+                             dx=dx, axis=axis)
+    return _invoke(pure, arrays)
+
+
+@_np_op("nanstd")
+def nanstd(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+    # int inputs promote to float32 (mxnet default float); float inputs
+    # keep their dtype, matching std()/var() above
+    def pure(x):
+        r = jnp.nanstd(_to_float(x), axis=_axis_tuple(axis), ddof=ddof,
+                       keepdims=keepdims)
+        return r.astype(dtype) if dtype is not None else r
+    return _invoke(pure, [a], out)
+
+
+@_np_op("nanvar")
+def nanvar(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+    def pure(x):
+        r = jnp.nanvar(_to_float(x), axis=_axis_tuple(axis), ddof=ddof,
+                       keepdims=keepdims)
+        return r.astype(dtype) if dtype is not None else r
+    return _invoke(pure, [a], out)
+
+
+@_np_op("geomspace")
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, axis=0,
+              ctx=None, device=None):
+    out = jnp.geomspace(_unwrap(start), _unwrap(stop), num=num,
+                        endpoint=endpoint, axis=axis)
+    return NDArray(out.astype(dtype or jnp.float32))
+
+
+@_np_op("asarray")
+def asarray(a, dtype=None, ctx=None, device=None):
+    if isinstance(a, NDArray) and dtype is None:
+        return a
+    from ..ndarray import array as nd_array
+
+    return nd_array(a, ctx=ctx or device, dtype=dtype)
+
+
+@_np_op("ascontiguousarray")
+def ascontiguousarray(a, dtype=None):
+    return asarray(a, dtype=dtype)  # PJRT buffers are always contiguous
+
+
+@_np_op("empty_like")
+def empty_like(a, dtype=None, order="C", ctx=None, device=None):
+    return _invoke(lambda x: jnp.zeros_like(x, dtype=dtype), [a])
+
+
+@_np_op("ndim")
+def ndim(a):
+    return len(a.shape) if hasattr(a, "shape") else _onp.ndim(a)
+
+
+@_np_op("shape")
+def shape(a):
+    return tuple(a.shape) if hasattr(a, "shape") else _onp.shape(a)
+
+
+@_np_op("size")
+def size(a, axis=None):
+    if not hasattr(a, "shape"):
+        return _onp.size(a, axis)
+    if axis is None:
+        n = 1
+        for d in a.shape:
+            n *= d
+        return n
+    return a.shape[axis]
+
+
+@_np_op("put")
+def put(a, ind, v, mode="clip"):
+    """In-place by buffer rebinding (the reference mutates the ndarray).
+    mode='raise' behaves as 'clip' — an XLA update cannot raise on
+    out-of-bounds indices; 'clip'/'wrap' follow numpy."""
+    if not isinstance(a, NDArray):
+        raise TypeError("np.put needs an mx.np.ndarray")
+    flat = _unwrap(a).reshape(-1)
+    n = flat.shape[0]
+    idx = jnp.asarray(_unwrap(ind)).reshape(-1)
+    idx = idx % n if mode == "wrap" else jnp.clip(idx, -n, n - 1)
+    vals = jnp.asarray(_unwrap(v), flat.dtype).reshape(-1)
+    if vals.shape[0] < idx.shape[0]:  # numpy cycles short value vectors
+        # NB: builtin max is shadowed by the mx.np reduction in this module
+        vals = jnp.tile(vals, -(-idx.shape[0] // (vals.shape[0] or 1)))
+    a._set_data(flat.at[idx].set(vals[:idx.shape[0]]).reshape(a.shape))
+
+
+@_np_op("place")
+def place(a, mask, vals):
+    if not isinstance(a, NDArray):
+        raise TypeError("np.place needs an mx.np.ndarray")
+    m = jnp.asarray(_unwrap(mask), bool).reshape(-1)
+    flat = _unwrap(a).reshape(-1)
+    v = jnp.asarray(_unwrap(vals), flat.dtype).reshape(-1)
+    n = int(m.sum())
+    reps = -(-n // (v.shape[0] or 1))  # builtin max is shadowed here
+    vfull = jnp.tile(v, reps)[:flat.shape[0]]
+    pos = jnp.cumsum(m) - 1
+    a._set_data(jnp.where(m, vfull[pos], flat).reshape(a.shape))
+
+
+@_np_op("fill_diagonal")
+def fill_diagonal(a, val, wrap=False):
+    if not isinstance(a, NDArray):
+        raise TypeError("np.fill_diagonal needs an mx.np.ndarray")
+    a._set_data(jnp.fill_diagonal(_unwrap(a), _unwrap(val), wrap=wrap,
+                                  inplace=False))
+
+
+@_np_op("iscomplexobj")
+def iscomplexobj(x):
+    return bool(jnp.iscomplexobj(_unwrap_deep(x)))
+
+
+@_np_op("isrealobj")
+def isrealobj(x):
+    return bool(jnp.isrealobj(_unwrap_deep(x)))
+
+
+@_np_op("array_equiv")
+def array_equiv(a1, a2):
+    return bool(jnp.array_equiv(_unwrap_deep(a1), _unwrap_deep(a2)))
