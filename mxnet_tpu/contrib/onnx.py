@@ -135,13 +135,21 @@ def _conv_attrs(a):
     return out
 
 
-_LSTM_GATE_PERM = (0, 3, 1, 2)  # mx/cuDNN [i,f,g,o] -> ONNX [i,o,f,c]
+# mx/cuDNN -> ONNX gate orders: LSTM [i,f,g,o]->[i,o,f,c], GRU
+# [r,z,n]->[z,r,h] (both conventions use the cuDNN linear_before_reset
+# recurrence our scan implements), vanilla: single gate.
+_RNN_ONNX = {
+    "lstm": ("LSTM", 4, (0, 3, 1, 2), (0, 2, 3, 1)),
+    "gru": ("GRU", 3, (1, 0, 2), (1, 0, 2)),
+    "rnn_tanh": ("RNN", 1, (0,), (0,)),
+    "rnn_relu": ("RNN", 1, (0,), (0,)),
+}
 
 
-def _lstm_reorder(mat, h):
-    """Permute stacked (4h, ...) gate blocks from mx to ONNX order."""
-    blocks = [mat[i * h:(i + 1) * h] for i in range(4)]
-    return np.concatenate([blocks[j] for j in _LSTM_GATE_PERM], axis=0)
+def _gate_reorder(mat, h, perm):
+    """Permute stacked (g*h, ...) gate blocks between conventions."""
+    blocks = [mat[i * h:(i + 1) * h] for i in range(len(perm))]
+    return np.concatenate([blocks[j] for j in perm], axis=0)
 
 
 def _export_node(node, in_names, out_name, params, extra_inits,
@@ -386,17 +394,24 @@ def _export_node(node, in_names, out_name, params, extra_inits,
     return b"", False
 
 
+def _attr_strs(name, vals):
+    body = P.field_string(1, name)
+    for v in vals:
+        body += P.field_string(9, v)  # AttributeProto.strings = field 9
+    return P.field_message(5, body + P.field_varint(20, _AT_STRINGS))
+
+
 def _export_rnn(node, in_names, out_name, params, extra_inits, in_shapes):
-    """mx fused RNN (LSTM mode) -> a chain of ONNX LSTM nodes, one per
-    layer (ONNX LSTM is single-layer). The cuDNN-canonical flat parameter
+    """mx fused RNN -> a chain of ONNX LSTM/GRU/RNN nodes, one per layer
+    (the ONNX ops are single-layer). The cuDNN-canonical flat parameter
     vector (ops/rnn.py layout) unpacks into per-layer W/R/B with gate
-    reorder [i,f,g,o] -> [i,o,f,c]. Dropout (`p`) is ignored — exported
-    graphs are inference graphs, where it is inactive anyway."""
+    reorder; GRU exports linear_before_reset=1 (the cuDNN recurrence the
+    scan implements). Dropout (`p`) is ignored — exported graphs are
+    inference graphs, where it is inactive anyway."""
     a = node._attrs
     nm = node._name
     mode = a.get("mode", "rnn_tanh")
-    if mode != "lstm":
-        return b"", False  # GRU gate conventions differ (linear_before_reset)
+    onnx_op, g, perm, _ = _RNN_ONNX[mode]
     if _flag(a.get("bidirectional", False)):
         raise ValueError("mx2onnx: bidirectional RNN export not supported")
     h = int(a.get("state_size"))
@@ -414,37 +429,49 @@ def _export_rnn(node, in_names, out_name, params, extra_inits, in_shapes):
     Ws, Rs, Bs = [], [], []
     for layer in range(L):
         isz = input_size if layer == 0 else h
-        Ws.append(pvec[off:off + 4 * h * isz].reshape(4 * h, isz))
-        off += 4 * h * isz
-        Rs.append(pvec[off:off + 4 * h * h].reshape(4 * h, h))
-        off += 4 * h * h
+        Ws.append(pvec[off:off + g * h * isz].reshape(g * h, isz))
+        off += g * h * isz
+        Rs.append(pvec[off:off + g * h * h].reshape(g * h, h))
+        off += g * h * h
     for layer in range(L):
-        b_ih = pvec[off:off + 4 * h]
-        off += 4 * h
-        b_hh = pvec[off:off + 4 * h]
-        off += 4 * h
+        b_ih = pvec[off:off + g * h]
+        off += g * h
+        b_hh = pvec[off:off + g * h]
+        off += g * h
         Bs.append((b_ih, b_hh))
+    has_cell = mode == "lstm"
+    attrs = _attr_int("hidden_size", h)
+    if mode == "gru":
+        attrs += _attr_int("linear_before_reset", 1)
+    elif mode == "rnn_relu":
+        attrs += _attr_strs("activations", ["Relu"])
     nodes = b""
     x_name = in_names[0]
-    h0_name, c0_name = in_names[2], in_names[3]
+    h0_name = in_names[2]
+    c0_name = in_names[3] if has_cell and len(in_names) > 3 else None
     for layer in range(L):
         wn, rn, bn = (f"{nm}_W{layer}", f"{nm}_R{layer}", f"{nm}_B{layer}")
-        extra_inits.append((wn, _lstm_reorder(Ws[layer], h)[None]))
-        extra_inits.append((rn, _lstm_reorder(Rs[layer], h)[None]))
+        extra_inits.append((wn, _gate_reorder(Ws[layer], h, perm)[None]))
+        extra_inits.append((rn, _gate_reorder(Rs[layer], h, perm)[None]))
         extra_inits.append((bn, np.concatenate(
-            [_lstm_reorder(Bs[layer][0], h),
-             _lstm_reorder(Bs[layer][1], h)])[None]))
+            [_gate_reorder(Bs[layer][0], h, perm),
+             _gate_reorder(Bs[layer][1], h, perm)])[None]))
         if L == 1:
             h0_l, c0_l = h0_name, c0_name
         else:
-            h0_l, c0_l = f"{nm}_h0_{layer}", f"{nm}_c0_{layer}"
             sl = (_attr_ints("axes", [0]) + _attr_ints("starts", [layer])
                   + _attr_ints("ends", [layer + 1]))
+            h0_l = f"{nm}_h0_{layer}"
             nodes += _node("Slice", [h0_name], [h0_l], h0_l, sl)
-            nodes += _node("Slice", [c0_name], [c0_l], c0_l, sl)
+            c0_l = None
+            if has_cell:
+                c0_l = f"{nm}_c0_{layer}"
+                nodes += _node("Slice", [c0_name], [c0_l], c0_l, sl)
         y4 = f"{nm}_l{layer}_y4"
-        nodes += _node("LSTM", [x_name, wn, rn, bn, "", h0_l, c0_l], [y4],
-                       f"{nm}_l{layer}", _attr_int("hidden_size", h))
+        rnn_ins = [x_name, wn, rn, bn, "", h0_l]
+        if has_cell:
+            rnn_ins.append(c0_l)
+        nodes += _node(onnx_op, rnn_ins, [y4], f"{nm}_l{layer}", attrs)
         y3 = out_name if layer == L - 1 else f"{nm}_l{layer}_y"
         # ONNX Y is (T, num_dir, N, h); drop the direction axis
         nodes += _node("Squeeze", [y4], [y3], y3 + "_sq",
@@ -592,38 +619,68 @@ def _parse_tensor(raw):
     return name, arr
 
 
-_LSTM_GATE_UNPERM = (0, 2, 3, 1)  # ONNX [i,o,f,c] -> mx/cuDNN [i,f,g,o]
-
-
-def _import_lstm(ins, outs, a, name, inits, sym_of, S):
-    """ONNX LSTM node -> mx fused RNN symbol. W/R/B initializers repack
-    (gate reorder + flatten) into the cuDNN-canonical vector ops/rnn.py
-    unpacks; only the single-direction, Y-consumed form is supported."""
+def _import_onnx_rnn(op, ins, outs, a, name, inits, sym_of, S):
+    """ONNX LSTM/GRU/RNN node -> mx fused RNN symbol. W/R/B initializers
+    repack (gate reorder + flatten) into the cuDNN-canonical vector
+    ops/rnn.py unpacks; only the single-direction, Y-consumed form is
+    supported. GRU requires linear_before_reset=1 — the default-0 ONNX
+    recurrence differs from the cuDNN variant the scan implements."""
+    direction = a.get("direction", "forward")
+    direction = (direction.decode() if isinstance(direction, bytes)
+                 else str(direction))
+    if direction != "forward":
+        raise ValueError(f"onnx2mx: {op} direction={direction!r} "
+                         "unsupported (forward only)")
+    if a.get("clip") is not None:
+        raise ValueError(f"onnx2mx: {op} cell clipping unsupported")
+    acts = [x.decode() if isinstance(x, bytes) else str(x)
+            for x in (a.get("activations") or [])]
+    if op == "LSTM":
+        if acts and acts != ["Sigmoid", "Tanh", "Tanh"]:
+            raise ValueError(f"onnx2mx: LSTM activations {acts} differ "
+                             "from the fixed cuDNN recurrence")
+        if len(ins) > 7 and ins[7]:
+            raise ValueError("onnx2mx: LSTM peephole input P unsupported")
+        mode = "lstm"
+    elif op == "GRU":
+        if not int(a.get("linear_before_reset", 0)):
+            raise ValueError(
+                "onnx2mx: GRU with linear_before_reset=0 uses a recurrence "
+                "the cuDNN-convention scan cannot reproduce")
+        if acts and acts != ["Sigmoid", "Tanh"]:
+            raise ValueError(f"onnx2mx: GRU activations {acts} differ "
+                             "from the fixed cuDNN recurrence")
+        mode = "gru"
+    else:
+        if acts and acts[0] not in ("Tanh", "Relu"):
+            raise ValueError(f"onnx2mx: RNN activation {acts[0]!r} "
+                             "unsupported")
+        mode = "rnn_relu" if acts and acts[0] == "Relu" else "rnn_tanh"
+    _, g, _, unperm_order = _RNN_ONNX[mode]
     if len(ins) > 4 and ins[4]:
-        raise ValueError("onnx2mx: LSTM sequence_lens input unsupported")
-    for missing in (1, 2):
-        if ins[missing] not in inits:
-            raise ValueError("onnx2mx: LSTM W/R must be initializers")
+        raise ValueError(f"onnx2mx: {op} sequence_lens input unsupported")
+    for pos in (1, 2):
+        if ins[pos] not in inits:
+            raise ValueError(f"onnx2mx: {op} W/R must be initializers")
     h = int(a.get("hidden_size"))
     W = np.asarray(inits.pop(ins[1]), np.float32)
     R = np.asarray(inits.pop(ins[2]), np.float32)
     if W.shape[0] != 1:
-        raise ValueError("onnx2mx: bidirectional LSTM import unsupported")
+        raise ValueError(f"onnx2mx: bidirectional {op} import unsupported")
     W, R = W[0], R[0]
     if len(ins) > 3 and ins[3]:
         if ins[3] not in inits:
-            raise ValueError("onnx2mx: LSTM B must be an initializer "
+            raise ValueError(f"onnx2mx: {op} B must be an initializer "
                              "(computed/graph-input biases unsupported)")
         B = np.asarray(inits.pop(ins[3]), np.float32)[0]
     else:
-        B = np.zeros(8 * h, np.float32)
+        B = np.zeros(2 * g * h, np.float32)
 
     def unperm(mat):
-        blocks = [mat[i * h:(i + 1) * h] for i in range(4)]
-        return np.concatenate([blocks[j] for j in _LSTM_GATE_UNPERM], axis=0)
+        return _gate_reorder(mat, h, unperm_order)
 
     flat = np.concatenate([unperm(W).reshape(-1), unperm(R).reshape(-1),
-                           unperm(B[:4 * h]), unperm(B[4 * h:])])
+                           unperm(B[:g * h]), unperm(B[g * h:])])
     pname = name + "_rnn_params"
     inits[pname] = flat
 
@@ -635,9 +692,11 @@ def _import_lstm(ins, outs, a, name, inits, sym_of, S):
         return S.tile(z, reps=(1, 1, h))
 
     h0 = (sym_of(ins[5]) if len(ins) > 5 and ins[5] else default_state())
-    c0 = (sym_of(ins[6]) if len(ins) > 6 and ins[6] else default_state())
-    rnn = S.RNN(sym_of(ins[0]), S.Variable(pname), h0, c0, state_size=h,
-                num_layers=1, mode="lstm", name=name)
+    rnn_args = [sym_of(ins[0]), S.Variable(pname), h0]
+    if mode == "lstm":
+        rnn_args.append(sym_of(ins[6]) if len(ins) > 6 and ins[6]
+                        else default_state())
+    rnn = S.RNN(*rnn_args, state_size=h, num_layers=1, mode=mode, name=name)
     # ONNX Y is (T, num_dir=1, N, h): restore the direction axis the mx
     # RNN output (T, N, h) lacks so downstream Squeeze/Slice nodes fit
     return S.expand_dims(rnn, axis=1, name=name + "_y4")
@@ -654,6 +713,8 @@ def _parse_attrs(node_fields):
             attrs[name] = P.float_of(f[2][0])
         elif 8 in f:
             attrs[name] = P.ints_of(f[8])
+        elif 9 in f:  # strings (e.g. RNN activations)
+            attrs[name] = [P.string_of(x) for x in f[9]]
         elif 4 in f:
             attrs[name] = P.string_of(f[4][0])
         elif 5 in f:
@@ -884,8 +945,8 @@ def import_model(model_file):
                 out = S.dot(sym_of(ins[0]), sym_of(ins[1]), name=name)
             else:
                 out = S.batch_dot(sym_of(ins[0]), sym_of(ins[1]), name=name)
-        elif op == "LSTM":
-            out = _import_lstm(ins, outs, a, name, inits, sym_of, S)
+        elif op in ("LSTM", "GRU", "RNN"):
+            out = _import_onnx_rnn(op, ins, outs, a, name, inits, sym_of, S)
             tensors[outs[0]] = out
             continue
         elif op == "Squeeze":

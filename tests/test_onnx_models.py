@@ -229,3 +229,106 @@ def test_lstm_default_state_import(tmp_path):
     assert got.shape == (T, N, H)
     # reference: same math via mx RNN with explicit zero state
     assert np.isfinite(got).all() and np.abs(got).max() > 0
+
+
+@pytest.mark.parametrize("mode", ["gru", "rnn_relu", "rnn_tanh"])
+def test_gru_vanilla_roundtrip(tmp_path, mode):
+    """GRU (linear_before_reset=1, the cuDNN recurrence) and vanilla RNN
+    export to ONNX GRU/RNN nodes and re-import with matching outputs."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rng = np.random.RandomState(4)
+    T, N, E, H = 4, 3, 5, 4
+    x = mx.sym.Variable("data")
+    h0 = mx.sym.Variable("h0")
+    p = mx.sym.Variable("rnn_params")
+    r = mx.sym.RNN(x, p, h0, state_size=H, num_layers=2, mode=mode,
+                   name="r")
+    n_p = rnn_param_size(mode, E, H, num_layers=2)
+    params = {"rnn_params": rng.randn(n_p).astype(np.float32) * 0.3}
+    feed = {"data": rng.randn(T, N, E).astype(np.float32),
+            "h0": rng.randn(2, N, H).astype(np.float32) * 0.1}
+    isym, _ = _roundtrip(r, params, [(T, N, E), (2, N, H)], feed, tmp_path,
+                         tol=2e-5)
+    ops = [n._op for n in isym._base()._topo() if n._op]
+    assert ops.count("RNN") == 2
+
+
+def test_gru_import_rejects_default_recurrence(tmp_path):
+    # linear_before_reset=0 (the ONNX default) is a DIFFERENT recurrence;
+    # importing it as the cuDNN scan would be silently wrong
+    from mxnet_tpu.contrib import _onnx_proto as P
+    from mxnet_tpu.contrib.onnx import _tensor, _node, _attr_int, _value_info
+
+    rng = np.random.RandomState(5)
+    H, E, T, N = 2, 3, 2, 1
+    W = rng.randn(1, 3 * H, E).astype(np.float32)
+    R = rng.randn(1, 3 * H, H).astype(np.float32)
+    gru = _node("GRU", ["x", "W", "R"], ["y4"], "g0",
+                _attr_int("hidden_size", H))
+    sq = _node("Squeeze", ["y4"], ["y"], "sq", b"")
+    inits = (P.field_message(5, _tensor("W", W))
+             + P.field_message(5, _tensor("R", R)))
+    graph = (gru + sq + P.field_string(2, "g") + inits
+             + P.field_message(11, _value_info("x", (T, N, E)))
+             + P.field_message(12, _value_info("y", ())))
+    model = (P.field_varint(1, 7) + P.field_message(7, graph)
+             + P.field_message(8, P.field_varint(2, 9)))
+    path = tmp_path / "g.onnx"
+    path.write_bytes(model)
+    with pytest.raises(ValueError, match="linear_before_reset"):
+        onnx_mx.import_model(str(path))
+
+
+def test_gru_gate_order_vs_spec_reference(tmp_path):
+    """Pin the [z,r,h] ONNX gate order against a numpy implementation of
+    the ONNX GRU spec formulas (linear_before_reset=1) — a wrong-but-
+    self-inverse permutation would survive the round-trip tests."""
+    from mxnet_tpu.contrib import _onnx_proto as P
+    from mxnet_tpu.contrib.onnx import (_attr_int, _node, _tensor,
+                                        _value_info)
+
+    rng = np.random.RandomState(6)
+    H, E, T, N = 2, 3, 3, 2
+    W = rng.randn(1, 3 * H, E).astype(np.float32) * 0.4
+    R = rng.randn(1, 3 * H, H).astype(np.float32) * 0.4
+    B = rng.randn(1, 6 * H).astype(np.float32) * 0.2
+    x = rng.randn(T, N, E).astype(np.float32)
+
+    # --- independent reference: ONNX spec, gate rows ordered [z, r, h] ---
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    Wz, Wr, Wh = W[0, :H], W[0, H:2 * H], W[0, 2 * H:]
+    Rz, Rr, Rh = R[0, :H], R[0, H:2 * H], R[0, 2 * H:]
+    Wbz, Wbr, Wbh = B[0, :H], B[0, H:2 * H], B[0, 2 * H:3 * H]
+    Rbz, Rbr, Rbh = B[0, 3 * H:4 * H], B[0, 4 * H:5 * H], B[0, 5 * H:]
+    h = np.zeros((N, H), np.float32)
+    ys = []
+    for t in range(T):
+        xt = x[t]
+        z = sigmoid(xt @ Wz.T + h @ Rz.T + Wbz + Rbz)
+        r = sigmoid(xt @ Wr.T + h @ Rr.T + Wbr + Rbr)
+        # linear_before_reset=1: ht = tanh(xt Wh + r*(h Rh + Rbh) + Wbh)
+        hh = np.tanh(xt @ Wh.T + r * (h @ Rh.T + Rbh) + Wbh)
+        h = (1 - z) * hh + z * h
+        ys.append(h.copy())
+    ref = np.stack(ys)
+
+    gru = _node("GRU", ["x", "W", "R", "B"], ["y4"], "g0",
+                _attr_int("hidden_size", H)
+                + _attr_int("linear_before_reset", 1))
+    sq = _node("Squeeze", ["y4"], ["y"], "sq", b"")
+    inits = (P.field_message(5, _tensor("W", W))
+             + P.field_message(5, _tensor("R", R))
+             + P.field_message(5, _tensor("B", B)))
+    graph = (gru + sq + P.field_string(2, "g") + inits
+             + P.field_message(11, _value_info("x", (T, N, E)))
+             + P.field_message(12, _value_info("y", ())))
+    model = (P.field_varint(1, 7) + P.field_message(7, graph)
+             + P.field_message(8, P.field_varint(2, 9)))
+    path = tmp_path / "gspec.onnx"
+    path.write_bytes(model)
+    sym, args, aux = onnx_mx.import_model(str(path))
+    got = _eval(sym, {"x": x, **args, **aux})
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)

