@@ -15,7 +15,7 @@ primitives; the schema field numbers are public). Covered surface:
 - transformer family: dot/batch_dot→MatMul (±Transpose), last-axis
   LayerNorm→opset-9 ReduceMean/Sub/Sqrt decomposition, erf-gelu→Erf
   decomposition, Exp/Log/Sqrt/Erf, Pow.
-Opset 9, fp32 tensors, single-direction RNN.
+Opset 9, fp32 tensors; RNN family covers forward and bidirectional.
 
 ``export_model`` and ``import_model`` round-trip through real ONNX bytes:
 tests/test_onnx.py re-imports an exported ResNet-style graph and checks
@@ -412,8 +412,8 @@ def _export_rnn(node, in_names, out_name, params, extra_inits, in_shapes):
     nm = node._name
     mode = a.get("mode", "rnn_tanh")
     onnx_op, g, perm, _ = _RNN_ONNX[mode]
-    if _flag(a.get("bidirectional", False)):
-        raise ValueError("mx2onnx: bidirectional RNN export not supported")
+    bidir = _flag(a.get("bidirectional", False))
+    dirs = 2 if bidir else 1
     h = int(a.get("state_size"))
     L = int(a.get("num_layers", 1))
     pname = node._inputs[1]._base()._name
@@ -425,42 +425,57 @@ def _export_rnn(node, in_names, out_name, params, extra_inits, in_shapes):
         raise ValueError("mx2onnx: RNN export needs input shape inference")
     input_size = int(in_shapes[0][-1])
     pvec = np.asarray(pvec, np.float32).reshape(-1)
+    # cuDNN-canonical layout: all (layer, direction) weights first, then
+    # all biases in the same order (ops/rnn.py rnn_unpack_params)
     off = 0
     Ws, Rs, Bs = [], [], []
     for layer in range(L):
-        isz = input_size if layer == 0 else h
-        Ws.append(pvec[off:off + g * h * isz].reshape(g * h, isz))
-        off += g * h * isz
-        Rs.append(pvec[off:off + g * h * h].reshape(g * h, h))
-        off += g * h * h
+        isz = input_size if layer == 0 else h * dirs
+        wd, rd = [], []
+        for _d in range(dirs):
+            wd.append(pvec[off:off + g * h * isz].reshape(g * h, isz))
+            off += g * h * isz
+            rd.append(pvec[off:off + g * h * h].reshape(g * h, h))
+            off += g * h * h
+        Ws.append(wd)
+        Rs.append(rd)
     for layer in range(L):
-        b_ih = pvec[off:off + g * h]
-        off += g * h
-        b_hh = pvec[off:off + g * h]
-        off += g * h
-        Bs.append((b_ih, b_hh))
+        bd = []
+        for _d in range(dirs):
+            b_ih = pvec[off:off + g * h]
+            off += g * h
+            b_hh = pvec[off:off + g * h]
+            off += g * h
+            bd.append((b_ih, b_hh))
+        Bs.append(bd)
     has_cell = mode == "lstm"
     attrs = _attr_int("hidden_size", h)
+    if bidir:
+        attrs += _attr_str("direction", "bidirectional")
     if mode == "gru":
         attrs += _attr_int("linear_before_reset", 1)
     elif mode == "rnn_relu":
-        attrs += _attr_strs("activations", ["Relu"])
+        attrs += _attr_strs("activations", ["Relu"] * dirs)
     nodes = b""
     x_name = in_names[0]
     h0_name = in_names[2]
     c0_name = in_names[3] if has_cell and len(in_names) > 3 else None
     for layer in range(L):
         wn, rn, bn = (f"{nm}_W{layer}", f"{nm}_R{layer}", f"{nm}_B{layer}")
-        extra_inits.append((wn, _gate_reorder(Ws[layer], h, perm)[None]))
-        extra_inits.append((rn, _gate_reorder(Rs[layer], h, perm)[None]))
-        extra_inits.append((bn, np.concatenate(
-            [_gate_reorder(Bs[layer][0], h, perm),
-             _gate_reorder(Bs[layer][1], h, perm)])[None]))
+        extra_inits.append((wn, np.stack(
+            [_gate_reorder(Ws[layer][d], h, perm) for d in range(dirs)])))
+        extra_inits.append((rn, np.stack(
+            [_gate_reorder(Rs[layer][d], h, perm) for d in range(dirs)])))
+        extra_inits.append((bn, np.stack(
+            [np.concatenate([_gate_reorder(Bs[layer][d][0], h, perm),
+                             _gate_reorder(Bs[layer][d][1], h, perm)])
+             for d in range(dirs)])))
         if L == 1:
             h0_l, c0_l = h0_name, c0_name
         else:
-            sl = (_attr_ints("axes", [0]) + _attr_ints("starts", [layer])
-                  + _attr_ints("ends", [layer + 1]))
+            sl = (_attr_ints("axes", [0])
+                  + _attr_ints("starts", [layer * dirs])
+                  + _attr_ints("ends", [(layer + 1) * dirs]))
             h0_l = f"{nm}_h0_{layer}"
             nodes += _node("Slice", [h0_name], [h0_l], h0_l, sl)
             c0_l = None
@@ -473,9 +488,19 @@ def _export_rnn(node, in_names, out_name, params, extra_inits, in_shapes):
             rnn_ins.append(c0_l)
         nodes += _node(onnx_op, rnn_ins, [y4], f"{nm}_l{layer}", attrs)
         y3 = out_name if layer == L - 1 else f"{nm}_l{layer}_y"
-        # ONNX Y is (T, num_dir, N, h); drop the direction axis
-        nodes += _node("Squeeze", [y4], [y3], y3 + "_sq",
-                       _attr_ints("axes", [1]))
+        if bidir:
+            # ONNX Y (T, 2, N, h) -> mx (T, N, 2h): swap dir/batch axes,
+            # then merge the direction axis into the feature dim
+            yt = f"{nm}_l{layer}_yt"
+            nodes += _node("Transpose", [y4], [yt], yt,
+                           _attr_ints("perm", (0, 2, 1, 3)))
+            shp = f"{nm}_l{layer}_yshape"
+            extra_inits.append((shp, np.asarray([0, 0, 2 * h], np.int64)))
+            nodes += _node("Reshape", [yt, shp], [y3], y3 + "_rs")
+        else:
+            # ONNX Y is (T, num_dir, N, h); drop the direction axis
+            nodes += _node("Squeeze", [y4], [y3], y3 + "_sq",
+                           _attr_ints("axes", [1]))
         x_name = y3
     return nodes, True
 
@@ -621,22 +646,26 @@ def _parse_tensor(raw):
 
 def _import_onnx_rnn(op, ins, outs, a, name, inits, sym_of, S):
     """ONNX LSTM/GRU/RNN node -> mx fused RNN symbol. W/R/B initializers
-    repack (gate reorder + flatten) into the cuDNN-canonical vector
-    ops/rnn.py unpacks; only the single-direction, Y-consumed form is
-    supported. GRU requires linear_before_reset=1 — the default-0 ONNX
-    recurrence differs from the cuDNN variant the scan implements."""
+    repack (gate reorder + per-direction flatten) into the cuDNN-canonical
+    vector ops/rnn.py unpacks; forward and bidirectional forms supported,
+    Y (the per-step output) must be the consumed leg. GRU requires
+    linear_before_reset=1 — the default-0 ONNX recurrence differs from the
+    cuDNN variant the scan implements."""
     direction = a.get("direction", "forward")
     direction = (direction.decode() if isinstance(direction, bytes)
                  else str(direction))
-    if direction != "forward":
+    if direction not in ("forward", "bidirectional"):
         raise ValueError(f"onnx2mx: {op} direction={direction!r} "
-                         "unsupported (forward only)")
+                         "unsupported (forward|bidirectional)")
+    bidir = direction == "bidirectional"
     if a.get("clip") is not None:
         raise ValueError(f"onnx2mx: {op} cell clipping unsupported")
     acts = [x.decode() if isinstance(x, bytes) else str(x)
             for x in (a.get("activations") or [])]
+    n_dir = 2 if bidir else 1
     if op == "LSTM":
-        if acts and acts != ["Sigmoid", "Tanh", "Tanh"]:
+        # spec: the activations list repeats per direction
+        if acts and acts != ["Sigmoid", "Tanh", "Tanh"] * n_dir:
             raise ValueError(f"onnx2mx: LSTM activations {acts} differ "
                              "from the fixed cuDNN recurrence")
         if len(ins) > 7 and ins[7]:
@@ -647,14 +676,16 @@ def _import_onnx_rnn(op, ins, outs, a, name, inits, sym_of, S):
             raise ValueError(
                 "onnx2mx: GRU with linear_before_reset=0 uses a recurrence "
                 "the cuDNN-convention scan cannot reproduce")
-        if acts and acts != ["Sigmoid", "Tanh"]:
+        if acts and acts != ["Sigmoid", "Tanh"] * n_dir:
             raise ValueError(f"onnx2mx: GRU activations {acts} differ "
                              "from the fixed cuDNN recurrence")
         mode = "gru"
     else:
-        if acts and acts[0] not in ("Tanh", "Relu"):
-            raise ValueError(f"onnx2mx: RNN activation {acts[0]!r} "
-                             "unsupported")
+        if acts and (acts[0] not in ("Tanh", "Relu")
+                     or acts != [acts[0]] * len(acts)
+                     or len(acts) not in (0, n_dir)):
+            raise ValueError(f"onnx2mx: RNN activations {acts} unsupported "
+                             "(both directions must share Tanh or Relu)")
         mode = "rnn_relu" if acts and acts[0] == "Relu" else "rnn_tanh"
     _, g, _, unperm_order = _RNN_ONNX[mode]
     if len(ins) > 4 and ins[4]:
@@ -665,41 +696,52 @@ def _import_onnx_rnn(op, ins, outs, a, name, inits, sym_of, S):
     h = int(a.get("hidden_size"))
     W = np.asarray(inits.pop(ins[1]), np.float32)
     R = np.asarray(inits.pop(ins[2]), np.float32)
-    if W.shape[0] != 1:
-        raise ValueError(f"onnx2mx: bidirectional {op} import unsupported")
-    W, R = W[0], R[0]
+    dirs = 2 if bidir else 1
+    if W.shape[0] != dirs:
+        raise ValueError(f"onnx2mx: {op} W num_directions {W.shape[0]} "
+                         f"does not match direction={direction!r}")
     if len(ins) > 3 and ins[3]:
         if ins[3] not in inits:
             raise ValueError(f"onnx2mx: {op} B must be an initializer "
                              "(computed/graph-input biases unsupported)")
-        B = np.asarray(inits.pop(ins[3]), np.float32)[0]
+        B = np.asarray(inits.pop(ins[3]), np.float32)
     else:
-        B = np.zeros(2 * g * h, np.float32)
+        B = np.zeros((dirs, 2 * g * h), np.float32)
 
     def unperm(mat):
         return _gate_reorder(mat, h, unperm_order)
 
-    flat = np.concatenate([unperm(W).reshape(-1), unperm(R).reshape(-1),
-                           unperm(B[:g * h]), unperm(B[g * h:])])
+    # cuDNN-canonical: weights for every direction first, then biases
+    parts = []
+    for d in range(dirs):
+        parts += [unperm(W[d]).reshape(-1), unperm(R[d]).reshape(-1)]
+    for d in range(dirs):
+        parts += [unperm(B[d][:g * h]), unperm(B[d][g * h:])]
+    flat = np.concatenate(parts)
     pname = name + "_rnn_params"
     inits[pname] = flat
 
     def default_state():
         # spec default is zeros with the INPUT's batch dim — build it from
-        # X so the shape stays symbolic: (1, N, 1) zeros tiled to (1, N, h)
+        # X so the shape stays symbolic: (1, N, 1) zeros tiled out
         t0 = S.slice_axis(sym_of(ins[0]), axis=0, begin=0, end=1)
         z = S.mean(t0, axis=-1, keepdims=True) * 0.0
-        return S.tile(z, reps=(1, 1, h))
+        return S.tile(z, reps=(dirs, 1, h))
 
     h0 = (sym_of(ins[5]) if len(ins) > 5 and ins[5] else default_state())
     rnn_args = [sym_of(ins[0]), S.Variable(pname), h0]
     if mode == "lstm":
         rnn_args.append(sym_of(ins[6]) if len(ins) > 6 and ins[6]
                         else default_state())
-    rnn = S.RNN(*rnn_args, state_size=h, num_layers=1, mode=mode, name=name)
-    # ONNX Y is (T, num_dir=1, N, h): restore the direction axis the mx
-    # RNN output (T, N, h) lacks so downstream Squeeze/Slice nodes fit
-    return S.expand_dims(rnn, axis=1, name=name + "_y4")
+    rnn = S.RNN(*rnn_args, state_size=h, num_layers=1, mode=mode,
+                bidirectional=bidir, name=name)
+    if not bidir:
+        # ONNX Y is (T, num_dir=1, N, h): restore the direction axis the
+        # mx RNN output (T, N, h) lacks so downstream Squeeze/Slice fit
+        return S.expand_dims(rnn, axis=1, name=name + "_y4")
+    # mx (T, N, 2h) -> ONNX Y (T, 2, N, h)
+    r4 = S.reshape(rnn, shape=(0, 0, 2, h), name=name + "_split")
+    return S.transpose(r4, axes=(0, 2, 1, 3), name=name + "_y4")
 
 
 def _parse_attrs(node_fields):

@@ -332,3 +332,30 @@ def test_gru_gate_order_vs_spec_reference(tmp_path):
     sym, args, aux = onnx_mx.import_model(str(path))
     got = _eval(sym, {"x": x, **args, **aux})
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode,layers", [("lstm", 1), ("lstm", 2),
+                                         ("gru", 1)])
+def test_bidirectional_rnn_roundtrip(tmp_path, mode, layers):
+    """Bidirectional LSTM/GRU: mx (T,N,2h) <-> ONNX Y (T,2,N,h) with
+    per-direction weight stacks."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rng = np.random.RandomState(7)
+    T, N, E, H = 4, 3, 5, 4
+    x = mx.sym.Variable("data")
+    h0 = mx.sym.Variable("h0")
+    args = [x, mx.sym.Variable("rnn_params"), h0]
+    if mode == "lstm":
+        args.append(mx.sym.Variable("c0"))
+    r = mx.sym.RNN(*args, state_size=H, num_layers=layers, mode=mode,
+                   bidirectional=True, name="br")
+    n_p = rnn_param_size(mode, E, H, num_layers=layers, bidirectional=True)
+    params = {"rnn_params": rng.randn(n_p).astype(np.float32) * 0.3}
+    feed = {"data": rng.randn(T, N, E).astype(np.float32),
+            "h0": rng.randn(2 * layers, N, H).astype(np.float32) * 0.1}
+    shapes = [(T, N, E), (2 * layers, N, H)]
+    if mode == "lstm":
+        feed["c0"] = rng.randn(2 * layers, N, H).astype(np.float32) * 0.1
+        shapes.append((2 * layers, N, H))
+    _roundtrip(r, params, shapes, feed, tmp_path, tol=2e-5)
