@@ -696,7 +696,7 @@ def _import_onnx_rnn(op, ins, outs, a, name, inits, sym_of, S):
     h = int(a.get("hidden_size"))
     W = np.asarray(inits.pop(ins[1]), np.float32)
     R = np.asarray(inits.pop(ins[2]), np.float32)
-    dirs = 2 if bidir else 1
+    dirs = n_dir
     if W.shape[0] != dirs:
         raise ValueError(f"onnx2mx: {op} W num_directions {W.shape[0]} "
                          f"does not match direction={direction!r}")

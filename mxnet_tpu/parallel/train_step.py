@@ -47,7 +47,8 @@ class ShardedTrainer:
                  optimizer: str = "sgd", optimizer_params: Optional[Dict] = None,
                  input_specs=P("dp"), label_specs=P("dp"), grad_clip: float = -1.0,
                  donate: bool = True, compute_dtype=None,
-                 preprocess: Optional[Callable] = None, remat: bool = False):
+                 preprocess: Optional[Callable] = None, remat: bool = False,
+                 grad_accum: int = 1):
         if optimizer not in _SUPPORTED:
             raise ValueError(f"optimizer {optimizer!r} not in {_SUPPORTED}")
         self.net = net
@@ -77,6 +78,15 @@ class ShardedTrainer:
         # (and for compile-side buffer pressure). Reference counterpart:
         # mxnet memonger / mirror mode (TBV).
         self._remat = bool(remat)
+        # Gradient accumulation: the global batch splits into `grad_accum`
+        # micro-batches scanned inside ONE jitted step (grads averaged, one
+        # optimizer update). The activation/compile footprint is that of a
+        # single micro-batch — the fallback for configs whose full-batch
+        # program crashes the compiler (bench seq-4096) or exceeds HBM.
+        # BatchNorm-style aux stats keep the LAST micro-batch's update.
+        self._grad_accum = int(grad_accum)
+        if self._grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
         self._t = 0
         self._in_sh = batch_sharding(mesh, input_specs if isinstance(input_specs, P)
@@ -174,23 +184,26 @@ class ShardedTrainer:
 
         pre = self._preprocess
 
+        accum = self._grad_accum
+
         def step_fn(param_vals, opt_state, lr, t, *batch):
             if pre is not None:
                 batch = tuple(pre(b) for b in batch[:-1]) + batch[-1:]
+            if cdt is not None:
+                batch_cast = tuple(_cast(b) for b in batch[:-1]) + batch[-1:]
+            else:
+                batch_cast = batch
 
-            def loss_f(grad_part):
+            def loss_f(grad_part, batch_c):
                 full = dict(param_vals)
                 full.update(grad_part)
                 if cdt is not None:
                     full = {k: (v if k in stat_names else _cast(v))
                             for k, v in full.items()}
-                    batch_c = tuple(_cast(b) for b in batch[:-1]) + batch[-1:]
-                else:
-                    batch_c = batch
                 out, aux = self._apply(full, *batch_c[:-1])
                 outs = out if isinstance(out, tuple) else (out,)
                 loss_nd = self.loss_fn(*[NDArray(o) for o in outs],
-                                       NDArray(batch[-1]))
+                                       NDArray(batch_c[-1]))
                 loss_val = jnp.mean(loss_nd._data)
                 return loss_val, aux
 
@@ -202,8 +215,30 @@ class ShardedTrainer:
                 policy = getattr(jax.checkpoint_policies,
                                  "dots_with_no_batch_dims_saveable", None)
                 loss_f_used = jax.checkpoint(loss_f, policy=policy)
-            (loss, aux), grads = jax.value_and_grad(loss_f_used,
-                                                    has_aux=True)(grad_part)
+            if accum > 1:
+                for b in batch_cast:
+                    if b.shape[0] % accum:
+                        raise ValueError(
+                            f"grad_accum={accum} does not divide batch "
+                            f"dim {b.shape[0]}")
+                micro = tuple(
+                    b.reshape((accum, b.shape[0] // accum) + b.shape[1:])
+                    for b in batch_cast)
+
+                def body(acc, mb):
+                    (l_, aux_), g_ = jax.value_and_grad(
+                        loss_f_used, has_aux=True)(grad_part, mb)
+                    return (jax.tree_util.tree_map(jnp.add, acc, g_),
+                            (l_, aux_))
+
+                zero = jax.tree_util.tree_map(jnp.zeros_like, grad_part)
+                grads, (losses, auxs) = jax.lax.scan(body, zero, micro)
+                grads = jax.tree_util.tree_map(lambda g_: g_ / accum, grads)
+                loss = jnp.mean(losses)
+                aux = jax.tree_util.tree_map(lambda ys: ys[-1], auxs)
+            else:
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_f_used, has_aux=True)(grad_part, batch_cast)
             new_params = dict(param_vals)
             new_state = {}
             for n in grad_names:
