@@ -18,9 +18,11 @@ import numpy as _onp
 
 from ..ndarray import NDArray
 from ..ndarray.ndarray import invoke_fn
+from . import linalg, random  # noqa: F401 — mx.np.random / mx.np.linalg
 from ._ops import *  # noqa: F401,F403
 
-__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange"]
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
+           "random", "linalg"]
 
 ndarray = NDArray
 

@@ -1,0 +1,108 @@
+"""``mx.np.linalg`` — numpy-frontend linear algebra.
+
+Reference: ``python/mxnet/numpy/linalg.py`` (TBV). Thin explicit wrappers
+over jnp.linalg that unwrap/rewrap :class:`NDArray` at the boundary and
+record on the autograd tape (the bare jnp.linalg module would reject
+NDArray arguments outright). 32-bit defaults throughout (x64 disabled).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from ..ndarray.ndarray import invoke_fn
+
+__all__ = ["norm", "svd", "cholesky", "inv", "det", "slogdet", "eig",
+           "eigh", "eigvals", "eigvalsh", "qr", "solve", "lstsq",
+           "matrix_rank", "matrix_power", "pinv", "multi_dot",
+           "tensorinv", "tensorsolve"]
+
+
+def _nd(x):
+    return x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+
+
+def _call(fn, arrays, **kwargs):
+    return invoke_fn(lambda *ts: fn(*ts, **kwargs), [_nd(a) for a in arrays])
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _call(jnp.linalg.norm, [x], ord=ord, axis=axis, keepdims=keepdims)
+
+
+def svd(a, full_matrices=True, compute_uv=True, hermitian=False):
+    return _call(jnp.linalg.svd, [a], full_matrices=full_matrices,
+                 compute_uv=compute_uv, hermitian=hermitian)
+
+
+def cholesky(a):
+    return _call(jnp.linalg.cholesky, [a])
+
+
+def inv(a):
+    return _call(jnp.linalg.inv, [a])
+
+
+def det(a):
+    return _call(jnp.linalg.det, [a])
+
+
+def slogdet(a):
+    return _call(jnp.linalg.slogdet, [a])
+
+
+def eig(a):
+    return _call(jnp.linalg.eig, [a])
+
+
+def eigh(a, UPLO="L"):
+    return _call(jnp.linalg.eigh, [a], UPLO=UPLO)
+
+
+def eigvals(a):
+    return _call(jnp.linalg.eigvals, [a])
+
+
+def eigvalsh(a, UPLO="L"):
+    return _call(jnp.linalg.eigvalsh, [a], UPLO=UPLO)
+
+
+def qr(a, mode="reduced"):
+    return _call(jnp.linalg.qr, [a], mode=mode)
+
+
+def solve(a, b):
+    return _call(jnp.linalg.solve, [a, b])
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond in ("warn", None) else rcond
+    return _call(jnp.linalg.lstsq, [a, b], rcond=rc)
+
+
+def matrix_rank(a, tol=None, hermitian=False):
+    if hermitian:
+        raise NotImplementedError(
+            "np.linalg.matrix_rank(hermitian=True) is not supported "
+            "(jnp.linalg.matrix_rank has no eigh path)")
+    return _call(jnp.linalg.matrix_rank, [a], tol=tol)
+
+
+def matrix_power(a, n):
+    return _call(jnp.linalg.matrix_power, [a], n=n)
+
+
+def pinv(a, rcond=1e-15, hermitian=False):
+    return _call(jnp.linalg.pinv, [a], rcond=rcond, hermitian=hermitian)
+
+
+def multi_dot(arrays):
+    return _call(lambda *ts: jnp.linalg.multi_dot(ts), list(arrays))
+
+
+def tensorinv(a, ind=2):
+    return _call(jnp.linalg.tensorinv, [a], ind=ind)
+
+
+def tensorsolve(a, b, axes=None):
+    return _call(jnp.linalg.tensorsolve, [a, b], axes=axes)

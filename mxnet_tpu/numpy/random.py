@@ -1,0 +1,175 @@
+"""``mx.np.random`` — numpy-frontend random sampling.
+
+Reference: ``python/mxnet/numpy/random.py`` (TBV). Draws ride the SAME
+framework RNG stream as ``mx.nd.random`` (``mxnet_tpu.random.next_key``),
+so ``mx.random.seed`` / MXNET_SEED govern both frontends and same-seed
+draws are platform-invariant (jax PRNG). Default float dtype is float32
+(the mxnet default float), never float64.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..ndarray import NDArray
+from ..random import next_key, seed  # noqa: F401  (re-export seed)
+
+__all__ = ["seed", "uniform", "normal", "randint", "rand", "randn",
+           "choice", "shuffle", "permutation", "exponential", "gamma",
+           "beta", "chisquare", "gumbel", "laplace", "logistic",
+           "lognormal", "pareto", "power", "rayleigh", "weibull",
+           "multinomial", "multivariate_normal"]
+
+
+def _size(size, *params):
+    """Draw shape: explicit ``size`` wins; otherwise the broadcast of the
+    distribution parameters' shapes (numpy semantics — each output element
+    gets an INDEPENDENT draw, not one scalar draw rescaled)."""
+    if size is None:
+        return jnp.broadcast_shapes(*(jnp.shape(_f(p)) for p in params)) \
+            if params else ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _wrap(x, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return NDArray(x)
+
+
+def _f(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None):
+    shape = _size(size, low, high)
+    u = jax.random.uniform(next_key(), shape, jnp.float32)
+    return _wrap(_f(low) + u * (_f(high) - _f(low)), dtype)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    shape = _size(size, loc, scale)
+    return _wrap(_f(loc) + _f(scale)
+                 * jax.random.normal(next_key(), shape, jnp.float32), dtype)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    r = jax.random.randint(next_key(), _size(size), int(low), int(high),
+                           jnp.int32)
+    return _wrap(r, dtype)
+
+
+def rand(*size):
+    return uniform(size=size or None)
+
+
+def randn(*size):
+    return normal(size=size or None)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    arr = a._data if isinstance(a, NDArray) else (
+        jnp.arange(a) if isinstance(a, int) else jnp.asarray(a))
+    pp = None if p is None else jnp.asarray(
+        p._data if isinstance(p, NDArray) else p, jnp.float32)
+    r = jax.random.choice(next_key(), arr, _size(size), replace=replace, p=pp)
+    return _wrap(r)
+
+
+def permutation(x):
+    arr = (jnp.arange(x) if isinstance(x, int)
+           else x._data if isinstance(x, NDArray) else jnp.asarray(x))
+    return _wrap(jax.random.permutation(next_key(), arr))
+
+
+def shuffle(x):
+    """In-place along axis 0 (reference semantics: mutates x)."""
+    if not isinstance(x, NDArray):
+        raise TypeError("np.random.shuffle needs an mx.np.ndarray")
+    x._set_data(jax.random.permutation(next_key(), x._data))
+
+
+def exponential(scale=1.0, size=None, ctx=None, out=None):
+    return _wrap(_f(scale) * jax.random.exponential(
+        next_key(), _size(size, scale), jnp.float32))
+
+
+def gamma(shape, scale=1.0, size=None, ctx=None, out=None):
+    return _wrap(_f(scale) * jax.random.gamma(
+        next_key(), _f(shape), _size(size, shape, scale), jnp.float32))
+
+
+def beta(a, b, size=None, ctx=None, out=None):
+    return _wrap(jax.random.beta(next_key(), _f(a), _f(b),
+                                 _size(size, a, b), jnp.float32))
+
+
+def chisquare(df, size=None, ctx=None, out=None):
+    return _wrap(jax.random.chisquare(next_key(), _f(df),
+                                      _size(size, df), jnp.float32))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    return _wrap(_f(loc) + _f(scale) * jax.random.gumbel(
+        next_key(), _size(size, loc, scale), jnp.float32))
+
+
+def laplace(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    return _wrap(_f(loc) + _f(scale) * jax.random.laplace(
+        next_key(), _size(size, loc, scale), jnp.float32))
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    return _wrap(_f(loc) + _f(scale) * jax.random.logistic(
+        next_key(), _size(size, loc, scale), jnp.float32))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, out=None):
+    return _wrap(jnp.exp(_f(mean) + _f(sigma) * jax.random.normal(
+        next_key(), _size(size, mean, sigma), jnp.float32)))
+
+
+def pareto(a, size=None, ctx=None, out=None):
+    return _wrap(jax.random.pareto(next_key(), _f(a), _size(size, a),
+                                   jnp.float32) - 1.0)
+
+
+def power(a, size=None, ctx=None, out=None):
+    # X = U^(1/a): numpy's power distribution
+    u = jax.random.uniform(next_key(), _size(size, a), jnp.float32)
+    return _wrap(u ** (1.0 / _f(a)))
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, out=None):
+    u = jax.random.uniform(next_key(), _size(size, scale), jnp.float32,
+                           minval=1e-12)
+    return _wrap(_f(scale) * jnp.sqrt(-2.0 * jnp.log(u)))
+
+
+def weibull(a, size=None, ctx=None, out=None):
+    return _wrap(jax.random.weibull_min(
+        next_key(), 1.0, _f(a), _size(size, a), jnp.float32))
+
+
+def multinomial(n, pvals, size=None):
+    shape = _size(size)
+    pv = jnp.asarray(pvals._data if isinstance(pvals, NDArray) else pvals,
+                     jnp.float32)
+    draws = jax.random.categorical(
+        next_key(), jnp.log(pv), shape=shape + (int(n),))
+    k = pv.shape[-1]
+    return _wrap(jax.nn.one_hot(draws, k, dtype=jnp.int32).sum(axis=-2))
+
+
+def multivariate_normal(mean, cov, size=None, check_valid="warn", tol=1e-8):
+    m = jnp.asarray(mean._data if isinstance(mean, NDArray) else mean,
+                    jnp.float32)
+    c = jnp.asarray(cov._data if isinstance(cov, NDArray) else cov,
+                    jnp.float32)
+    return _wrap(jax.random.multivariate_normal(
+        next_key(), m, c, _size(size) or None, jnp.float32))

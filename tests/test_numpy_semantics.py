@@ -476,3 +476,85 @@ def test_promoted_ops_nested_and_modes():
     assert mxnp.iscomplexobj(mxnp.array([1.0])) is False
     assert mxnp.isrealobj(mxnp.array([1.0])) is True
     assert isinstance(mxnp.array_equiv(a, a), bool)
+
+
+def test_np_random_namespace():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+
+    mx.random.seed(42)
+    u = mxnp.random.uniform(0, 2, (3, 4))
+    assert isinstance(u, mxnp.ndarray) and u.shape == (3, 4)
+    assert u.dtype == onp.float32
+    a = u.asnumpy()
+    assert (a >= 0).all() and (a < 2).all()
+    # same framework stream: reseeding reproduces the draw exactly
+    mx.random.seed(42)
+    onp.testing.assert_array_equal(mxnp.random.uniform(0, 2, (3, 4)).asnumpy(), a)
+
+    r = mxnp.random.randint(5, size=(10,))
+    assert r.dtype == onp.int32 and (r.asnumpy() < 5).all()
+    n = mxnp.random.normal(1.0, 0.0, (4,))
+    onp.testing.assert_allclose(n.asnumpy(), onp.ones(4), rtol=1e-6)
+    assert mxnp.random.randn(2, 3).shape == (2, 3)
+    c = mxnp.random.choice(4, size=(6,))
+    assert (c.asnumpy() < 4).all()
+    x = mxnp.arange(0, 8)
+    mxnp.random.shuffle(x)
+    assert sorted(x.asnumpy().tolist()) == list(range(8))
+    m = mxnp.random.multinomial(10, [0.25, 0.25, 0.5])
+    assert int(m.asnumpy().sum()) == 10
+    e = mxnp.random.exponential(2.0, (100,))
+    assert (e.asnumpy() >= 0).all()
+
+
+def test_np_linalg_namespace():
+    import numpy as onp
+
+    from mxnet_tpu import np as mxnp
+
+    a = mxnp.array([[4.0, 1.0], [1.0, 3.0]])
+    assert abs(float(mxnp.linalg.norm(mxnp.array([3.0, 4.0])).asnumpy())
+               - 5.0) < 1e-6
+    L = mxnp.linalg.cholesky(a)
+    onp.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T,
+                                a.asnumpy(), rtol=1e-5)
+    inv = mxnp.linalg.inv(a)
+    onp.testing.assert_allclose(inv.asnumpy() @ a.asnumpy(), onp.eye(2),
+                                atol=1e-5)
+    assert abs(float(mxnp.linalg.det(a).asnumpy()) - 11.0) < 1e-4
+    w, v = mxnp.linalg.eigh(a)
+    assert isinstance(w, mxnp.ndarray) and isinstance(v, mxnp.ndarray)
+    b = mxnp.array([1.0, 2.0])
+    x = mxnp.linalg.solve(a, b)
+    onp.testing.assert_allclose(a.asnumpy() @ x.asnumpy(), b.asnumpy(),
+                                rtol=1e-5)
+    sgn, logd = mxnp.linalg.slogdet(a)
+    onp.testing.assert_allclose(float(sgn.asnumpy())
+                                * onp.exp(float(logd.asnumpy())), 11.0,
+                                rtol=1e-4)
+    q, r = mxnp.linalg.qr(a)
+    onp.testing.assert_allclose(q.asnumpy() @ r.asnumpy(), a.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_np_random_param_broadcast_independent_draws():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mxnp
+
+    mx.random.seed(0)
+    # array-shaped params with size=None: numpy broadcasts and draws
+    # INDEPENDENTLY per element — a single rescaled scalar draw would make
+    # e/scale identical across elements
+    e = mxnp.random.exponential(mxnp.array([1.0, 2.0, 4.0]))
+    assert e.shape == (3,)
+    ratios = e.asnumpy() / onp.array([1.0, 2.0, 4.0])
+    assert len(set(onp.round(ratios, 6))) > 1, "correlated draws"
+    g = mxnp.random.gamma(mxnp.array([1.0, 2.0]))
+    assert g.shape == (2,)
+    n = mxnp.random.normal(mxnp.array([0.0, 100.0]), 1.0)
+    assert n.shape == (2,) and abs(float(n.asnumpy()[1]) - 100) < 10

@@ -83,8 +83,10 @@ def main():
         ws, gs, ms = carry
         new_m = [0.9 * m + g + 1e-4 * w for w, g, m in zip(ws, gs, ms)]
         new_w = [w - 0.1 * m for w, m in zip(ws, new_m)]
-        new_g = [g * 0.999 for g in gs]  # keep grads loop-variant
-        return (new_w, new_g, new_m)
+        # grads pass through UNCHANGED: still read each iteration (they
+        # feed new_m), but no third write — real SGD+momentum traffic is
+        # read w+g+m, write w+m
+        return (new_w, gs, new_m)
 
     carry0 = (list(leaves),
               [jnp.full_like(l_, 1e-4) for l_ in leaves],
