@@ -477,10 +477,45 @@ def bench_lm_long(platform):
 
 
 def main():
+    import threading
+
     import jax
 
-    platform = jax.devices()[0].platform
-    device_kind = jax.devices()[0].device_kind
+    # The axon tunnel can go fully unresponsive for hours (observed
+    # 2026-07-30: >3 h; jax.devices() then blocks forever). A hung bench
+    # looks like a driver-capture timeout with no artifact — fail loudly
+    # with one parseable JSON line instead.
+    devs = []
+    enum_exc = []
+
+    def _enum():
+        try:
+            devs.extend(jax.devices())
+        except Exception as e:  # noqa: BLE001 — reported distinctly below
+            enum_exc.append(f"{type(e).__name__}: {e}")
+
+    th = threading.Thread(target=_enum, daemon=True)
+    th.start()
+    th.join(timeout=float(os.environ.get("BENCH_DEVICE_TIMEOUT", 300)))
+    if not devs:
+        # a RAISE is a real init failure (plugin/config) and must not be
+        # triaged as the known tunnel hang
+        err = (f"device enumeration raised {enum_exc[0]}" if enum_exc
+               else "device enumeration timed out — axon tunnel "
+                    "unresponsive (not a framework failure; see "
+                    "BASELINE.md escalation log)")
+        print(json.dumps({
+            "metric": "resnet50_v1 fp32 train throughput (batch=64, "
+                      "224x224, 1 tpu chip)",
+            "value": None,
+            "unit": "images/sec",
+            "vs_baseline": None,
+            "error": err[:300],
+        }))
+        sys.exit(1)
+
+    platform = devs[0].platform
+    device_kind = devs[0].device_kind
 
     # Optional legs self-skip past this wall-clock budget so a cold compile
     # cache can never time the whole bench out of the driver's capture

@@ -20,6 +20,7 @@ Wire format (little-endian):
 """
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
@@ -361,6 +362,16 @@ class PSServer:
 
 def main():
     import argparse
+
+    # The PS is host-side by design (reference ps-lite servers are CPU
+    # processes): pin jax to cpu BEFORE any NDArray is created, or the
+    # optimizer's first _apply would initialize the accelerator backend —
+    # and hang forever when the axon tunnel is down (observed 2026-07-30:
+    # every push RPC then times out). MXNET_PS_PLATFORM overrides.
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("MXNET_PS_PLATFORM", "cpu"))
 
     ap = argparse.ArgumentParser(description="mxnet_tpu async parameter server")
     ap.add_argument("--port", type=int, default=9091)

@@ -51,8 +51,21 @@ def slogdet(a):
     return _call(jnp.linalg.slogdet, [a])
 
 
+def _cpu_call(fn, arrays, **kwargs):
+    """Nonsymmetric eigendecomposition has no TPU lowering in XLA — run on
+    the host CPU backend and wrap the results."""
+    import jax
+
+    cpu0 = jax.local_devices(backend="cpu")[0]
+    vals = [jax.device_put(_nd(a)._data, cpu0) for a in arrays]
+    res = fn(*vals, **kwargs)
+    if isinstance(res, (tuple, list)):
+        return tuple(NDArray(r) for r in res)
+    return NDArray(res)
+
+
 def eig(a):
-    return _call(jnp.linalg.eig, [a])
+    return _cpu_call(jnp.linalg.eig, [a])
 
 
 def eigh(a, UPLO="L"):
@@ -60,7 +73,7 @@ def eigh(a, UPLO="L"):
 
 
 def eigvals(a):
-    return _call(jnp.linalg.eigvals, [a])
+    return _cpu_call(jnp.linalg.eigvals, [a])
 
 
 def eigvalsh(a, UPLO="L"):
@@ -92,7 +105,9 @@ def matrix_power(a, n):
     return _call(jnp.linalg.matrix_power, [a], n=n)
 
 
-def pinv(a, rcond=1e-15, hermitian=False):
+def pinv(a, rcond=None, hermitian=False):
+    # default None -> jnp's dtype-aware cutoff; numpy's 1e-15 constant is
+    # a float64 epsilon and would invert fp32-noise singular values
     return _call(jnp.linalg.pinv, [a], rcond=rcond, hermitian=hermitian)
 
 

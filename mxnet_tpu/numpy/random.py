@@ -22,38 +22,59 @@ __all__ = ["seed", "uniform", "normal", "randint", "rand", "randn",
            "multinomial", "multivariate_normal"]
 
 
+def _pshape(p):
+    """Shape of a distribution parameter WITHOUT device conversion."""
+    if isinstance(p, NDArray):
+        return tuple(p.shape)
+    return _onp.shape(p)
+
+
 def _size(size, *params):
-    """Draw shape: explicit ``size`` wins; otherwise the broadcast of the
-    distribution parameters' shapes (numpy semantics — each output element
-    gets an INDEPENDENT draw, not one scalar draw rescaled)."""
+    """Draw shape: explicit ``size`` wins (and must be broadcast-compatible
+    with the parameter shapes, as in numpy); otherwise the broadcast of the
+    parameters' shapes (each output element gets an INDEPENDENT draw, not
+    one scalar draw rescaled)."""
+    pshapes = [_pshape(p) for p in params]
     if size is None:
-        return jnp.broadcast_shapes(*(jnp.shape(_f(p)) for p in params)) \
-            if params else ()
-    if isinstance(size, int):
-        return (size,)
-    return tuple(size)
+        return jnp.broadcast_shapes(*pshapes) if pshapes else ()
+    shape = (size,) if isinstance(size, int) else tuple(size)
+    if pshapes and jnp.broadcast_shapes(shape, *pshapes) != shape:
+        raise ValueError(
+            f"size {shape} is not broadcast-compatible with parameter "
+            f"shapes {pshapes} (numpy raises here too)")
+    return shape
 
 
-def _wrap(x, dtype=None):
+def _wrap(x, dtype=None, out=None):
     if dtype is not None:
         x = x.astype(dtype)
+    if out is not None:
+        if not isinstance(out, NDArray):
+            raise TypeError("out= must be an mx.np.ndarray")
+        out._set_data(x.astype(out.dtype))
+        return out
     return NDArray(x)
 
 
 def _f(x):
+    # unwrap NDArray FIRST: jnp.asarray on one would fall back to
+    # __iter__/__float__ — a device round-trip per element
+    if isinstance(x, NDArray):
+        x = x._data
     return jnp.asarray(x, jnp.float32)
 
 
 def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None):
     shape = _size(size, low, high)
     u = jax.random.uniform(next_key(), shape, jnp.float32)
-    return _wrap(_f(low) + u * (_f(high) - _f(low)), dtype)
+    return _wrap(_f(low) + u * (_f(high) - _f(low)), dtype, out)
 
 
 def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
     shape = _size(size, loc, scale)
     return _wrap(_f(loc) + _f(scale)
-                 * jax.random.normal(next_key(), shape, jnp.float32), dtype)
+                 * jax.random.normal(next_key(), shape, jnp.float32), dtype,
+                 out)
 
 
 def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
@@ -61,7 +82,7 @@ def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
         low, high = 0, low
     r = jax.random.randint(next_key(), _size(size), int(low), int(high),
                            jnp.int32)
-    return _wrap(r, dtype)
+    return _wrap(r, dtype, out)
 
 
 def rand(*size):
@@ -73,6 +94,9 @@ def randn(*size):
 
 
 def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.choice: out= is not supported; assign the result')
     arr = a._data if isinstance(a, NDArray) else (
         jnp.arange(a) if isinstance(a, int) else jnp.asarray(a))
     pp = None if p is None else jnp.asarray(
@@ -95,75 +119,116 @@ def shuffle(x):
 
 
 def exponential(scale=1.0, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.exponential: out= is not supported; assign the result')
     return _wrap(_f(scale) * jax.random.exponential(
         next_key(), _size(size, scale), jnp.float32))
 
 
 def gamma(shape, scale=1.0, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.gamma: out= is not supported; assign the result')
     return _wrap(_f(scale) * jax.random.gamma(
         next_key(), _f(shape), _size(size, shape, scale), jnp.float32))
 
 
 def beta(a, b, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.beta: out= is not supported; assign the result')
     return _wrap(jax.random.beta(next_key(), _f(a), _f(b),
                                  _size(size, a, b), jnp.float32))
 
 
 def chisquare(df, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.chisquare: out= is not supported; assign the result')
     return _wrap(jax.random.chisquare(next_key(), _f(df),
                                       _size(size, df), jnp.float32))
 
 
 def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.gumbel: out= is not supported; assign the result')
     return _wrap(_f(loc) + _f(scale) * jax.random.gumbel(
         next_key(), _size(size, loc, scale), jnp.float32))
 
 
 def laplace(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.laplace: out= is not supported; assign the result')
     return _wrap(_f(loc) + _f(scale) * jax.random.laplace(
         next_key(), _size(size, loc, scale), jnp.float32))
 
 
 def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.logistic: out= is not supported; assign the result')
     return _wrap(_f(loc) + _f(scale) * jax.random.logistic(
         next_key(), _size(size, loc, scale), jnp.float32))
 
 
 def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.lognormal: out= is not supported; assign the result')
     return _wrap(jnp.exp(_f(mean) + _f(sigma) * jax.random.normal(
         next_key(), _size(size, mean, sigma), jnp.float32)))
 
 
 def pareto(a, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.pareto: out= is not supported; assign the result')
     return _wrap(jax.random.pareto(next_key(), _f(a), _size(size, a),
                                    jnp.float32) - 1.0)
 
 
 def power(a, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.power: out= is not supported; assign the result')
     # X = U^(1/a): numpy's power distribution
     u = jax.random.uniform(next_key(), _size(size, a), jnp.float32)
     return _wrap(u ** (1.0 / _f(a)))
 
 
 def rayleigh(scale=1.0, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.rayleigh: out= is not supported; assign the result')
     u = jax.random.uniform(next_key(), _size(size, scale), jnp.float32,
                            minval=1e-12)
     return _wrap(_f(scale) * jnp.sqrt(-2.0 * jnp.log(u)))
 
 
 def weibull(a, size=None, ctx=None, out=None):
+    if out is not None:
+        raise NotImplementedError(
+            'np.random.weibull: out= is not supported; assign the result')
     return _wrap(jax.random.weibull_min(
         next_key(), 1.0, _f(a), _size(size, a), jnp.float32))
 
 
 def multinomial(n, pvals, size=None):
     shape = _size(size)
-    pv = jnp.asarray(pvals._data if isinstance(pvals, NDArray) else pvals,
-                     jnp.float32)
+    pv = _f(pvals)
+    k = pv.shape[-1]
     draws = jax.random.categorical(
         next_key(), jnp.log(pv), shape=shape + (int(n),))
-    k = pv.shape[-1]
-    return _wrap(jax.nn.one_hot(draws, k, dtype=jnp.int32).sum(axis=-2))
+    # O(n) counting via flattened bincount — a one_hot of size+(n,k)
+    # would allocate n*k device memory for an O(k) result
+    flat = draws.reshape(-1, int(n))
+    offsets = jnp.arange(flat.shape[0], dtype=draws.dtype)[:, None] * k
+    counts = jnp.bincount((flat + offsets).reshape(-1),
+                          length=flat.shape[0] * k)
+    return _wrap(counts.reshape(shape + (k,)).astype(jnp.int32))
 
 
 def multivariate_normal(mean, cov, size=None, check_valid="warn", tol=1e-8):

@@ -558,3 +558,22 @@ def test_np_random_param_broadcast_independent_draws():
     assert g.shape == (2,)
     n = mxnp.random.normal(mxnp.array([0.0, 100.0]), 1.0)
     assert n.shape == (2,) and abs(float(n.asnumpy()[1]) - 100) < 10
+
+
+def test_np_random_out_and_size_validation():
+    import numpy as onp
+    import pytest as _pt
+
+    from mxnet_tpu import np as mxnp
+
+    buf = mxnp.zeros((4,))
+    r = mxnp.random.uniform(0, 1, (4,), out=buf)
+    assert r is buf and buf.asnumpy().any()
+    with _pt.raises(ValueError, match="broadcast"):
+        mxnp.random.normal(mxnp.zeros((3, 1)), 1.0, size=(4,))
+    with _pt.raises(NotImplementedError):
+        mxnp.random.exponential(1.0, (3,), out=mxnp.zeros((3,)))
+    # complex eig runs on the CPU backend (no TPU lowering exists)
+    w = mxnp.linalg.eigvals(mxnp.array([[0.0, 1.0], [-1.0, 0.0]]))
+    vals = sorted(onp.asarray(w.asnumpy()).imag.tolist())
+    onp.testing.assert_allclose(vals, [-1.0, 1.0], atol=1e-5)
