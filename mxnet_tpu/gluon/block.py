@@ -280,6 +280,15 @@ class HybridBlock(Block):
         return out
 
     def forward(self, x, *args, **kwargs):
+        from ..symbol.symbol import Symbol
+
+        if isinstance(x, Symbol):
+            # symbolic tracing (reference: hybrid_forward receives F=symbol
+            # when called with Symbols): parameters become named Variables,
+            # children recurse through their own __call__ with Symbols.
+            # Works for graphs whose hybrid_forward is F-generic and does
+            # not inspect concrete .shape (the model-zoo CNN/MLP family).
+            return self._forward_symbolic(x, *args, **kwargs)
         self._ensure_init(x, *args)
         if self._active:
             if any(p._data is None and p._deferred_init is not None
@@ -292,6 +301,13 @@ class HybridBlock(Block):
                 self._cached_op = CachedOp(self)
             return self._cached_op(x, *args)
         return self._forward_eager(x, *args, **kwargs)
+
+    def _forward_symbolic(self, x, *args, **kwargs):
+        from .. import symbol as sym_mod
+
+        params = {attr: sym_mod.Variable(p.name)
+                  for attr, p in self._reg_params.items()}
+        return self.hybrid_forward(sym_mod, x, *args, **params, **kwargs)
 
     def _forward_eager(self, x, *args, **kwargs):
         from .. import ndarray as nd_mod
@@ -324,19 +340,18 @@ class HybridBlock(Block):
         Requires ``example_inputs`` (tuple of NDArrays, or one NDArray)
         fixing the input shapes/dtypes. Reload with
         :func:`mxnet_tpu.gluon.load_stablehlo`.
+        format="onnx": symbolically trace the block and write
+        ``{path}-{epoch}.onnx`` via contrib.onnx.export_model (requires
+        ``example_inputs`` for shapes; the block's graph must be in the
+        exporter's covered op surface).
         """
         import json
 
-        self.save_parameters(f"{path}-{epoch:04d}.params")
-        meta = {"format": "mxnet_tpu-hybrid", "class": self.__class__.__name__}
-        if format == "stablehlo":
-            import jax
-            from jax import export as jexport
-
-            from ..parallel.functional import functionalize
-
+        # validate BEFORE any file is written — a raise after
+        # save_parameters would leave a truncated checkpoint on disk
+        if format in ("onnx", "stablehlo"):
             if example_inputs is None:
-                raise ValueError("stablehlo export needs example_inputs")
+                raise ValueError(f"{format} export needs example_inputs")
             if not isinstance(example_inputs, (list, tuple)):
                 example_inputs = (example_inputs,)
             deferred = [p.name for p in self._iter_params()
@@ -347,6 +362,34 @@ class HybridBlock(Block):
                 raise ValueError(
                     f"cannot export: parameters {deferred} have deferred "
                     "shapes; run a forward pass before export")
+
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        meta = {"format": "mxnet_tpu-hybrid", "class": self.__class__.__name__}
+        if format == "onnx":
+            from .. import symbol as sym_mod
+            from ..contrib.onnx import export_model
+
+            data_syms = [sym_mod.Variable(f"data{i}" if i else "data")
+                         for i in range(len(example_inputs))]
+            sym = self(*data_syms)
+            if isinstance(sym, (list, tuple)):
+                raise ValueError(
+                    f"onnx export supports single-output blocks; this one "
+                    f"returns {len(sym)} outputs — export a wrapper that "
+                    "selects one")
+            params = {p.name: p.data() for p in self._iter_params()}
+            onnx_path = f"{path}-{epoch:04d}.onnx"
+            export_model(sym, params,
+                         [tuple(x.shape) for x in example_inputs],
+                         onnx_file_path=onnx_path)
+            meta["onnx"] = onnx_path
+            meta["input_shapes"] = [list(x.shape) for x in example_inputs]
+        if format == "stablehlo":
+            import jax
+            from jax import export as jexport
+
+            from ..parallel.functional import functionalize
+
             names, apply = functionalize(self, train=False)
             by_name = {p.name: p for p in self._iter_params()}
             param_vals = {n: by_name[n].data()._data for n in names}

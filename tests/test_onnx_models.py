@@ -359,3 +359,39 @@ def test_bidirectional_rnn_roundtrip(tmp_path, mode, layers):
         feed["c0"] = rng.randn(2 * layers, N, H).astype(np.float32) * 0.1
         shapes.append((2 * layers, N, H))
     _roundtrip(r, params, shapes, feed, tmp_path, tol=2e-5)
+
+
+def test_gluon_block_onnx_export(tmp_path):
+    """HybridBlock.export(format='onnx'): symbolic trace -> ONNX file ->
+    import matches the eager gluon forward."""
+    rng = np.random.RandomState(8)
+    net = mx.gluon.nn.HybridSequential()
+    net.add(mx.gluon.nn.Dense(16, activation="relu", in_units=8))
+    net.add(mx.gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    x = nd.array(rng.rand(5, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+
+    prefix = str(tmp_path / "mlp")
+    net.export(prefix, epoch=3, format="onnx", example_inputs=x)
+    isym, iargs, iaux = onnx_mx.import_model(prefix + "-0003.onnx")
+    got = _eval(isym, {"data": x.asnumpy(), **iargs, **iaux})
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_gluon_conv_block_onnx_export(tmp_path):
+    rng = np.random.RandomState(9)
+    net = mx.gluon.nn.HybridSequential()
+    from mxnet_tpu.gluon import nn as gnn
+    net.add(gnn.Conv2D(4, kernel_size=3, padding=1, in_channels=3,
+                       activation="relu"))
+    net.add(gnn.MaxPool2D(pool_size=2, strides=2))
+    net.add(gnn.Dense(6))
+    net.initialize()
+    x = nd.array(rng.rand(2, 3, 8, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "cnn")
+    net.export(prefix, format="onnx", example_inputs=x)
+    isym, iargs, iaux = onnx_mx.import_model(prefix + "-0000.onnx")
+    got = _eval(isym, {"data": x.asnumpy(), **iargs, **iaux})
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
