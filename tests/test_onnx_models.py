@@ -395,3 +395,68 @@ def test_gluon_conv_block_onnx_export(tmp_path):
     isym, iargs, iaux = onnx_mx.import_model(prefix + "-0000.onnx")
     got = _eval(isym, {"data": x.asnumpy(), **iargs, **iaux})
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_gru_vs_spec_reference(tmp_path):
+    """Pin the REVERSE direction semantics against a numpy implementation
+    of the ONNX spec (reverse direction processes t=T-1..0; Y[t,1] is the
+    state after consuming x[t..T-1]) — independent of the scan code."""
+    from mxnet_tpu.contrib import _onnx_proto as P
+    from mxnet_tpu.contrib.onnx import (_attr_int, _attr_str, _node,
+                                        _tensor, _value_info)
+
+    rng = np.random.RandomState(11)
+    H, E, T, N = 2, 3, 4, 2
+    W = rng.randn(2, 3 * H, E).astype(np.float32) * 0.4
+    R = rng.randn(2, 3 * H, H).astype(np.float32) * 0.4
+    B = rng.randn(2, 6 * H).astype(np.float32) * 0.2
+    x = rng.randn(T, N, E).astype(np.float32)
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def run_dir(Wd, Rd, Bd, xs):
+        Wz, Wr, Wh = Wd[:H], Wd[H:2 * H], Wd[2 * H:]
+        Rz, Rr, Rh = Rd[:H], Rd[H:2 * H], Rd[2 * H:]
+        Wbz, Wbr, Wbh = Bd[:H], Bd[H:2 * H], Bd[2 * H:3 * H]
+        Rbz, Rbr, Rbh = Bd[3 * H:4 * H], Bd[4 * H:5 * H], Bd[5 * H:]
+        h = np.zeros((N, H), np.float32)
+        ys = []
+        for xt in xs:
+            z = sigmoid(xt @ Wz.T + h @ Rz.T + Wbz + Rbz)
+            r = sigmoid(xt @ Wr.T + h @ Rr.T + Wbr + Rbr)
+            hh = np.tanh(xt @ Wh.T + r * (h @ Rh.T + Rbh) + Wbh)
+            h = (1 - z) * hh + z * h
+            ys.append(h.copy())
+        return np.stack(ys)
+
+    fwd = run_dir(W[0], R[0], B[0], list(x))
+    bwd = run_dir(W[1], R[1], B[1], list(x[::-1]))[::-1]  # spec alignment
+    ref = np.stack([fwd, bwd], axis=1)  # (T, 2, N, H)
+
+    gru = _node("GRU", ["x", "W", "R", "B"], ["y4"], "g0",
+                _attr_int("hidden_size", H)
+                + _attr_int("linear_before_reset", 1)
+                + _attr_str("direction", "bidirectional"))
+    # consume Y via Transpose->Reshape to (T, N, 2H) so the graph output
+    # is a single plain tensor
+    from mxnet_tpu.contrib.onnx import _attr_ints
+    tr = _node("Transpose", ["y4"], ["yt"], "tr",
+               _attr_ints("perm", (0, 2, 1, 3)))
+    rs_shape = np.asarray([0, 0, 2 * H], np.int64)
+    rs = _node("Reshape", ["yt", "rshape"], ["y"], "rs", b"")
+    inits = (P.field_message(5, _tensor("W", W))
+             + P.field_message(5, _tensor("R", R))
+             + P.field_message(5, _tensor("B", B))
+             + P.field_message(5, _tensor("rshape", rs_shape)))
+    graph = (gru + tr + rs + P.field_string(2, "g") + inits
+             + P.field_message(11, _value_info("x", (T, N, E)))
+             + P.field_message(12, _value_info("y", ())))
+    model = (P.field_varint(1, 7) + P.field_message(7, graph)
+             + P.field_message(8, P.field_varint(2, 9)))
+    path = tmp_path / "bigru.onnx"
+    path.write_bytes(model)
+    sym, args, aux = onnx_mx.import_model(str(path))
+    got = _eval(sym, {"x": x, **args, **aux})
+    want = ref.transpose(0, 2, 1, 3).reshape(T, N, 2 * H)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
