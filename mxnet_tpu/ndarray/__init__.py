@@ -38,7 +38,7 @@ def _make_dispatcher(name: str):
 
 
 def __getattr__(name: str):
-    if name in ("contrib", "sparse", "image"):
+    if name in ("contrib", "sparse", "image", "linalg"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

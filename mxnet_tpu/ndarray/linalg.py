@@ -1,0 +1,16 @@
+"""``mx.nd.linalg`` — linear-algebra op namespace (reference
+python/mxnet/ndarray/linalg.py: ``nd.linalg.gemm2`` etc. resolve to the
+``_linalg_*`` registrations the flat ``nd.linalg_gemm2`` aliases expose)."""
+from __future__ import annotations
+
+from ..ops import has_op
+from . import _make_dispatcher
+
+
+def __getattr__(name: str):
+    for cand in (f"_linalg_{name}", f"linalg_{name}", name):
+        if has_op(cand):
+            fn = _make_dispatcher(cand)
+            globals()[name] = fn
+            return fn
+    raise AttributeError(f"no linalg operator {name!r}")

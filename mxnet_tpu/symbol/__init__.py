@@ -10,7 +10,7 @@ from .symbol import _make_symbol_op
 
 
 def __getattr__(name):
-    if name in ("contrib", "image", "random"):
+    if name in ("contrib", "image", "random", "linalg"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -309,3 +309,23 @@ def test_symbol_contrib_image_random_namespaces():
     vals = u.eval()[0]
     assert vals.shape == (8,)
     assert 0.0 <= float(vals.asnumpy().min())
+
+
+def test_nd_and_sym_linalg_namespaces():
+    """Reference API form: nd.linalg.gemm2 / sym.linalg.potrf resolve to
+    the _linalg_* registrations (python/mxnet/ndarray/linalg.py — TBV)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    a = nd.array(np.array([[4.0, 1.0], [1.0, 3.0]], np.float32))
+    out = nd.linalg.gemm2(a, a, transpose_b=True)
+    np.testing.assert_allclose(out.asnumpy(), a.asnumpy() @ a.asnumpy().T,
+                               rtol=1e-6)
+    L = nd.linalg.potrf(a)
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, a.asnumpy(),
+                               rtol=1e-5)
+    s = mx.sym.Variable("x")
+    g = mx.sym.linalg.syrk(s)
+    assert g.list_arguments() == ["x"]
