@@ -425,3 +425,39 @@ def _cast_storage(data, stype="default"):
     dense jax.Arrays (ndarray/sparse.py); the wrapper layer rebuilds the
     requested stype view around this result."""
     return data
+
+
+@register("_linalg_potri", aliases=["linalg_potri"])
+def _linalg_potri(A, lower=True):
+    """Inverse of an SPD matrix from its Cholesky factor (reference
+    linalg.potri: input is the POTRF output L, result is (L L^T)^-1 =
+    L^-T L^-1 — TBV)."""
+    from jax.scipy.linalg import solve_triangular
+
+    L = A if lower else jnp.swapaxes(A, -1, -2)
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    Linv = solve_triangular(L, eye, lower=True)
+    return jnp.swapaxes(Linv, -1, -2) @ Linv
+
+
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def _linalg_sumlogdiag(A):
+    """sum(log(diag(A))) per matrix (reference linalg.sumlogdiag — the
+    log-determinant shortcut for Cholesky factors)."""
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2)
+def _linalg_gelqf(A):
+    """LQ factorization A = L·Q with Q orthonormal rows (reference
+    linalg.gelqf, m <= n — TBV): returns (Q, L)."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+@register("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2)
+def _linalg_syevd(A):
+    """Symmetric eigendecomposition A = U^T·diag(w)·U with eigenvector
+    ROWS in U (reference linalg.syevd convention — TBV): returns (U, w)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w

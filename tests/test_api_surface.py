@@ -329,3 +329,28 @@ def test_nd_and_sym_linalg_namespaces():
     s = mx.sym.Variable("x")
     g = mx.sym.linalg.syrk(s)
     assert g.list_arguments() == ["x"]
+
+
+def test_linalg_family_completion():
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(0)
+    m = rng.rand(3, 3).astype(np.float32)
+    spd = m @ m.T + 3 * np.eye(3, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd))
+    inv = nd.linalg.potri(L)
+    np.testing.assert_allclose(inv.asnumpy() @ spd, np.eye(3), atol=1e-4)
+    sld = nd.linalg.sumlogdiag(L)
+    _, logdet = np.linalg.slogdet(spd)
+    np.testing.assert_allclose(2 * float(sld.asnumpy()), logdet, rtol=1e-5)
+    a = nd.array(rng.rand(2, 4).astype(np.float32))
+    q, lo = nd.linalg.gelqf(a)
+    np.testing.assert_allclose((lo.asnumpy() @ q.asnumpy()), a.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(q.asnumpy() @ q.asnumpy().T, np.eye(2),
+                               atol=1e-5)
+    u, w = nd.linalg.syevd(nd.array(spd))
+    rec = u.asnumpy().T @ np.diag(w.asnumpy()) @ u.asnumpy()
+    np.testing.assert_allclose(rec, spd, rtol=1e-4, atol=1e-4)
