@@ -354,6 +354,20 @@ def _cases(rng):
         lambda nd, a: nd.linalg_extracttrian(a), [spd])
     add("linalg", "khatri_rao",
         lambda nd, a, b: nd.khatri_rao(a[:2], b[:3]), [x, x])
+    add("linalg", "potri",
+        lambda nd, a: nd.linalg_potri(nd.linalg_potrf(a)), [spd],
+        rtol=1e-3, atol=1e-4)
+    add("linalg", "sumlogdiag",
+        lambda nd, a: nd.linalg_sumlogdiag(nd.linalg_potrf(a)), [spd],
+        **LOG_BAND)
+    add("linalg", "gelqf_recon",
+        lambda nd, a: (lambda ql: nd.batch_dot(
+            ql[1].reshape((1, 2, 2)), ql[0].reshape((1, 2, 8))))(
+            nd.linalg_gelqf(a[:2])), [x], rtol=1e-3, atol=1e-4)
+    add("linalg", "syevd_recon",
+        lambda nd, a: (lambda uw: nd.dot(nd.dot(
+            uw[0].T, nd.diag(uw[1])), uw[0]))(nd.linalg_syevd(a)), [spd],
+        rtol=1e-3, atol=1e-4)
     add("linalg", "moments",
         lambda nd, a: nd.concat(*nd.moments(a, axes=(0,)), dim=0), [x])
 
