@@ -75,6 +75,7 @@ def __getattr__(name):
         "kv": ".kvstore",
         "module": ".module",
         "mod": ".module",
+        "rnn": ".rnn",
         "callback": ".callback",
         "profiler": ".profiler",
         "model": ".model",

@@ -1,0 +1,5 @@
+"""``mx.rnn`` — the legacy symbolic RNN cell API + bucketing iterator
+(reference ``python/mxnet/rnn/`` — TBV)."""
+from .io import BucketSentenceIter  # noqa: F401
+from .rnn_cell import (BaseRNNCell, DropoutCell, FusedRNNCell, GRUCell,  # noqa: F401
+                       LSTMCell, RNNCell, SequentialRNNCell)

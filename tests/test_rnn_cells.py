@@ -1,0 +1,167 @@
+"""Legacy mx.rnn cell API (reference python/mxnet/rnn/ — the
+BucketingModule companion): unfused cells vs the fused RNN op, and
+BucketSentenceIter bucketing."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ops.rnn import rnn_param_size
+
+
+def _pack_lstm(i2h_w, h2h_w, i2h_b, h2h_b):
+    return np.concatenate([i2h_w.reshape(-1), h2h_w.reshape(-1),
+                           i2h_b, h2h_b]).astype(np.float32)
+
+
+def test_lstm_cell_matches_fused():
+    """Unrolled LSTMCell == fused nd.RNN given packed weights (same cuDNN
+    gate order)."""
+    rng = np.random.RandomState(0)
+    T, N, C, H = 4, 2, 3, 5
+    i2h_w = rng.randn(4 * H, C).astype(np.float32) * 0.3
+    h2h_w = rng.randn(4 * H, H).astype(np.float32) * 0.3
+    i2h_b = rng.randn(4 * H).astype(np.float32) * 0.1
+    h2h_b = rng.randn(4 * H).astype(np.float32) * 0.1
+    x = rng.randn(N, T, C).astype(np.float32)
+
+    cell = mx.rnn.LSTMCell(H, prefix="l0_")
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(T, data, merge_outputs=True)
+    got = outs.eval(data=nd.array(x),
+                    l0_i2h_weight=nd.array(i2h_w),
+                    l0_h2h_weight=nd.array(h2h_w),
+                    l0_i2h_bias=nd.array(i2h_b),
+                    l0_h2h_bias=nd.array(h2h_b))
+    got0 = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+
+    params = _pack_lstm(i2h_w, h2h_w, i2h_b, h2h_b)
+    assert params.size == rnn_param_size("lstm", C, H)
+    fused = nd.RNN(nd.array(x.transpose(1, 0, 2)), nd.array(params),
+                   nd.zeros((1, N, H)), nd.zeros((1, N, H)),
+                   state_size=H, num_layers=1, mode="lstm")
+    np.testing.assert_allclose(got0, fused.asnumpy().transpose(1, 0, 2),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gru_cell_matches_fused():
+    rng = np.random.RandomState(1)
+    T, N, C, H = 3, 2, 4, 3
+    i2h_w = rng.randn(3 * H, C).astype(np.float32) * 0.3
+    h2h_w = rng.randn(3 * H, H).astype(np.float32) * 0.3
+    i2h_b = rng.randn(3 * H).astype(np.float32) * 0.1
+    h2h_b = rng.randn(3 * H).astype(np.float32) * 0.1
+    x = rng.randn(N, T, C).astype(np.float32)
+
+    cell = mx.rnn.GRUCell(H, prefix="g0_")
+    data = mx.sym.Variable("data")
+    outs, _ = cell.unroll(T, data, merge_outputs=True)
+    got = outs.eval(data=nd.array(x),
+                    g0_i2h_weight=nd.array(i2h_w),
+                    g0_h2h_weight=nd.array(h2h_w),
+                    g0_i2h_bias=nd.array(i2h_b),
+                    g0_h2h_bias=nd.array(h2h_b))
+    got0 = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+    params = _pack_lstm(i2h_w, h2h_w, i2h_b, h2h_b)
+    fused = nd.RNN(nd.array(x.transpose(1, 0, 2)), nd.array(params),
+                   nd.zeros((1, N, H)), state_size=H, num_layers=1,
+                   mode="gru")
+    np.testing.assert_allclose(got0, fused.asnumpy().transpose(1, 0, 2),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_sequential_and_dropout_cells():
+    cell = mx.rnn.SequentialRNNCell()
+    cell.add(mx.rnn.RNNCell(4, prefix="r0_"))
+    cell.add(mx.rnn.DropoutCell(0.0))
+    cell.add(mx.rnn.RNNCell(3, prefix="r1_"))
+    data = mx.sym.Variable("data")
+    outs, states = cell.unroll(3, data, merge_outputs=True)
+    args = set(outs.list_arguments())
+    assert {"r0_i2h_weight", "r1_i2h_weight"} <= args
+    assert len(states) == 2
+
+
+def test_fused_rnn_cell_unroll():
+    rng = np.random.RandomState(2)
+    T, N, C, H = 3, 2, 4, 5
+    cell = mx.rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="f_")
+    data = mx.sym.Variable("data")
+    out, _ = cell.unroll(T, data, layout="NTC")
+    n_p = rnn_param_size("lstm", C, H, num_layers=2)
+    x = rng.randn(N, T, C).astype(np.float32)
+    res = out.eval(data=nd.array(x),
+                   f_parameters=nd.array(rng.randn(n_p).astype(np.float32)
+                                         * 0.2))
+    r0 = (res[0] if isinstance(res, (list, tuple)) else res)
+    assert r0.shape == (N, T, H)
+    assert np.isfinite(r0.asnumpy()).all()
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(3)
+    sentences = [list(rng.randint(1, 50, rng.randint(2, 12)))
+                 for _ in range(200)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[4, 8, 12], invalid_label=-1)
+    seen = 0
+    for batch in it:
+        blen = batch.bucket_key
+        assert blen in (4, 8, 12)
+        assert batch.data[0].shape == (8, blen)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        # label is the next-token shift wherever data continues
+        mask = d[:, 1:] != -1
+        np.testing.assert_array_equal(l[:, :-1][mask], d[:, 1:][mask])
+        seen += 1
+    assert seen >= 3
+    it.reset()
+    assert next(iter(it)) is not None
+
+
+def test_manual_stepping_and_final_states():
+    # manual per-step pattern with None begin states must work
+    cell = mx.rnn.LSTMCell(3, prefix="m_")
+    x_t = mx.sym.Variable("x")
+    states = cell.begin_state()
+    out, states = cell(x_t, states)
+    out2, _ = cell(out, states)
+    assert "m_i2h_weight" in out2.list_arguments()
+
+    # FusedRNNCell returns REAL final states, not the zeros it started with
+    rng = np.random.RandomState(5)
+    T, N, C, H = 3, 2, 4, 3
+    f = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="ff_")
+    data = mx.sym.Variable("data")
+    out, states = f.unroll(T, data, layout="NTC")
+    n_p = rnn_param_size("lstm", C, H)
+    feed = dict(data=nd.array(rng.randn(N, T, C).astype(np.float32)),
+                ff_parameters=nd.array(rng.randn(n_p).astype(np.float32)
+                                       * 0.3))
+    h_final = states[0].eval(**feed)
+    h0 = (h_final[0] if isinstance(h_final, (list, tuple)) else h_final)
+    assert np.abs(h0.asnumpy()).max() > 0, "final states are the zero init"
+    # final h equals the last output step
+    y = out.eval(**feed)
+    y0 = (y[0] if isinstance(y, (list, tuple)) else y).asnumpy()
+    np.testing.assert_allclose(h0.asnumpy()[0], y0[:, -1], rtol=1e-5)
+
+
+def test_fused_cell_pack_unpack_roundtrip():
+    rng = np.random.RandomState(6)
+    C, H, L = 4, 3, 2
+    f = mx.rnn.FusedRNNCell(H, num_layers=L, mode="lstm", prefix="p_")
+    n_p = rnn_param_size("lstm", C, H, num_layers=L)
+    vec = rng.randn(n_p).astype(np.float32)
+    un = f.unpack_weights({"p_parameters": vec}, input_size=C)
+    assert un["p_l0_i2h_weight"].shape == (4 * H, C)
+    assert un["p_l1_i2h_weight"].shape == (4 * H, H)
+    re = f.pack_weights(un)
+    np.testing.assert_array_equal(re["p_parameters"], vec)
+
+
+def test_bucket_iter_empty_buckets_raises():
+    import pytest as _pt
+
+    with _pt.raises(ValueError, match="no buckets"):
+        mx.rnn.BucketSentenceIter([[1, 2, 3]], batch_size=8, buckets=None)
