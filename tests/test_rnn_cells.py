@@ -165,3 +165,53 @@ def test_bucket_iter_empty_buckets_raises():
 
     with _pt.raises(ValueError, match="no buckets"):
         mx.rnn.BucketSentenceIter([[1, 2, 3]], batch_size=8, buckets=None)
+
+
+def test_bucketing_lm_end_to_end():
+    """The classic reference workflow: mx.rnn cells + BucketSentenceIter +
+    BucketingModule.fit-style loop (example/rnn/bucketing — TBV)."""
+    from mxnet_tpu.module import BucketingModule
+
+    rng = np.random.RandomState(7)
+    V, E, H = 20, 6, 5
+    sentences = [list(rng.randint(1, V, rng.randint(3, 9)))
+                 for _ in range(120)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[4, 8],
+                                   invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        emb = mx.sym.Embedding(data, input_dim=V, output_dim=E,
+                               name="embed")
+        cell = mx.rnn.LSTMCell(H, prefix="l0_")
+        outputs, _ = cell.unroll(seq_len, emb, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.FullyConnected(outputs, num_hidden=V, flatten=False,
+                                     name="pred")
+        pred = mx.sym.reshape(pred, shape=(-1, V))
+        out = mx.sym.SoftmaxOutput(pred, mx.sym.reshape(label, shape=(-1,)),
+                                   name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind([("data", (4, 8))], [("softmax_label", (4, 8))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+
+    losses = []
+    for epoch in range(3):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            out = mod.get_outputs()[0].asnumpy()
+            lbl = batch.label[0].asnumpy().reshape(-1).astype(int)
+            p = out[np.arange(len(lbl)), lbl]
+            losses.append(float(-np.log(np.maximum(p, 1e-9)).mean()))
+            mod.backward()
+            mod.update()
+    assert np.isfinite(losses).all()
+    # training must actually reduce NLL on this toy corpus
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, \
+        f"no learning: first {np.mean(losses[:5]):.3f} " \
+        f"last {np.mean(losses[-5:]):.3f}"
