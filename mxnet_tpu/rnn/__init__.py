@@ -1,5 +1,6 @@
 """``mx.rnn`` — the legacy symbolic RNN cell API + bucketing iterator
 (reference ``python/mxnet/rnn/`` — TBV)."""
 from .io import BucketSentenceIter  # noqa: F401
-from .rnn_cell import (BaseRNNCell, DropoutCell, FusedRNNCell, GRUCell,  # noqa: F401
-                       LSTMCell, RNNCell, SequentialRNNCell)
+from .rnn_cell import (BaseRNNCell, BidirectionalCell, DropoutCell,  # noqa: F401
+                       FusedRNNCell, GRUCell, LSTMCell, ResidualCell,
+                       RNNCell, SequentialRNNCell, ZoneoutCell)

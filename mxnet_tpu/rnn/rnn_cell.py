@@ -15,7 +15,8 @@ from typing import List, Optional
 from .. import symbol as sym
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "FusedRNNCell"]
+           "SequentialRNNCell", "DropoutCell", "FusedRNNCell",
+           "BidirectionalCell", "ResidualCell", "ZoneoutCell"]
 
 
 class BaseRNNCell:
@@ -325,3 +326,96 @@ class FusedRNNCell(BaseRNNCell):
             out[f"{self._prefix}l{li}_h2h_bias"] = vec[off:off + g * h]
             off += g * h
         return out
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Runs l_cell forward and r_cell backward over the sequence and
+    concatenates per-step outputs on the feature axis (reference
+    BidirectionalCell; unroll-only, like the reference)."""
+
+    def __init__(self, l_cell, r_cell, output_prefix="bi_"):
+        super().__init__(output_prefix)
+        self._l, self._r = l_cell, r_cell
+
+    @property
+    def state_info(self):
+        return self._l.state_info + self._r.state_info
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell supports unroll() only (per-step calls "
+            "cannot see the future half of the sequence)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if not isinstance(inputs, (list, tuple)):
+            axis = layout.find("T")
+            inputs = [sym.squeeze(sym.slice_axis(inputs, axis=axis, begin=t,
+                                                 end=t + 1), axis=axis)
+                      for t in range(length)]
+        n_l = len(self._l.state_info)
+        bs_l = begin_state[:n_l] if begin_state is not None else None
+        bs_r = begin_state[n_l:] if begin_state is not None else None
+        l_out, l_states = self._l.unroll(length, list(inputs),
+                                         begin_state=bs_l, layout=layout,
+                                         merge_outputs=False)
+        r_out, r_states = self._r.unroll(length, list(inputs)[::-1],
+                                         begin_state=bs_r, layout=layout,
+                                         merge_outputs=False)
+        r_out = list(r_out)[::-1]
+        outputs = [sym.concat(lo, ro, dim=-1)
+                   for lo, ro in zip(l_out, r_out)]
+        if merge_outputs:
+            outputs = sym.stack(*outputs, axis=layout.find("T"))
+        return outputs, list(l_states) + list(r_states)
+
+
+class ResidualCell(BaseRNNCell):
+    """Adds the cell input to its output (reference modifier cell)."""
+
+    def __init__(self, base_cell):
+        super().__init__("")
+        self._base = base_cell
+
+    @property
+    def state_info(self):
+        return self._base.state_info
+
+    def begin_state(self, *a, **kw):
+        return self._base.begin_state(*a, **kw)
+
+    def __call__(self, inputs, states):
+        out, states = self._base(inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(BaseRNNCell):
+    """Zoneout regularization (reference modifier): with probability p a
+    state keeps its PREVIOUS value instead of updating. Inference form
+    (deterministic expectation) — the reference's training-time Bernoulli
+    masks require the dropout RNG stream; Dropout on outputs covers the
+    stochastic case."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__("")
+        self._base = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+
+    @property
+    def state_info(self):
+        return self._base.state_info
+
+    def begin_state(self, *a, **kw):
+        return self._base.begin_state(*a, **kw)
+
+    def __call__(self, inputs, states):
+        prev = self._base._materialize(inputs, states)
+        out, new_states = self._base(inputs, prev)
+        if self._zs:
+            new_states = [p * self._zs + n * (1.0 - self._zs)
+                          for p, n in zip(prev, new_states)]
+        if self._zo:
+            out = out * (1.0 - self._zo)
+        return out, new_states

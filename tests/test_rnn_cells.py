@@ -215,3 +215,33 @@ def test_bucketing_lm_end_to_end():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, \
         f"no learning: first {np.mean(losses[:5]):.3f} " \
         f"last {np.mean(losses[-5:]):.3f}"
+
+
+def test_modifier_and_bidirectional_cells():
+    rng = np.random.RandomState(8)
+    T, N, C, H = 3, 2, 5, 5
+    x = rng.randn(N, T, C).astype(np.float32)
+    data = mx.sym.Variable("data")
+
+    res = mx.rnn.ResidualCell(mx.rnn.RNNCell(H, prefix="res_"))
+    outs, _ = res.unroll(T, data, merge_outputs=True)
+    feed = {"data": nd.array(x),
+            "res_i2h_weight": nd.array(rng.randn(H, C).astype(np.float32) * 0.2),
+            "res_i2h_bias": nd.zeros((H,)),
+            "res_h2h_weight": nd.array(rng.randn(H, H).astype(np.float32) * 0.2),
+            "res_h2h_bias": nd.zeros((H,))}
+    got = outs.eval(**{k: v for k, v in feed.items()})
+    g0 = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+    assert g0.shape == (N, T, H) and np.isfinite(g0).all()
+
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(3, prefix="fw_"),
+                                  mx.rnn.RNNCell(3, prefix="bw_"))
+    outs, states = bi.unroll(T, data, merge_outputs=True)
+    assert len(states) == 2
+    args = set(outs.list_arguments())
+    assert {"fw_i2h_weight", "bw_i2h_weight"} <= args
+
+    zo = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(4, prefix="zo_"),
+                            zoneout_states=0.3)
+    outs, _ = zo.unroll(T, data, merge_outputs=True)
+    assert "zo_i2h_weight" in outs.list_arguments()
