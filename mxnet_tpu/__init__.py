@@ -80,6 +80,7 @@ def __getattr__(name):
         "profiler": ".profiler",
         "model": ".model",
         "runtime": ".runtime",
+        "registry": ".registry",
         "test_utils": ".test_utils",
         "executor": ".executor",
         "amp": ".amp",

@@ -354,3 +354,56 @@ def test_linalg_family_completion():
     u, w = nd.linalg.syevd(nd.array(spd))
     rec = u.asnumpy().T @ np.diag(w.asnumpy()) @ u.asnumpy()
     np.testing.assert_allclose(rec, spd, rtol=1e-4, atol=1e-4)
+
+
+def test_registry_module():
+    import mxnet_tpu as mx
+
+    class Base:
+        pass
+
+    reg = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @alias("a1", "a2")
+    class Foo(Base):
+        def __init__(self, x=1):
+            self.x = x
+
+    reg(Foo)
+    assert isinstance(create("foo"), Foo)
+    assert isinstance(create("a2"), Foo)
+    assert create("foo, x=3").x == 3
+    inst = Foo()
+    assert create(inst) is inst
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        create("nope")
+
+    class NotSub:
+        pass
+    with _pt.raises(TypeError):
+        reg(NotSub)
+
+
+def test_fused_cell_bidirectional_unroll():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    rng = np.random.RandomState(9)
+    T, N, C, H = 3, 2, 4, 3
+    cell = mx.rnn.FusedRNNCell(H, num_layers=1, mode="gru",
+                               bidirectional=True, prefix="bf_")
+    data = mx.sym.Variable("data")
+    out, _ = cell.unroll(T, data, layout="NTC")
+    n_p = rnn_param_size("gru", C, H, bidirectional=True)
+    res = out.eval(data=nd.array(rng.randn(N, T, C).astype(np.float32)),
+                   bf_parameters=nd.array(
+                       rng.randn(n_p).astype(np.float32) * 0.2))
+    r0 = (res[0] if isinstance(res, (list, tuple)) else res)
+    assert r0.shape == (N, T, 2 * H)
+    assert np.isfinite(r0.asnumpy()).all()
