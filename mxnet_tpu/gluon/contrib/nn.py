@@ -3,6 +3,7 @@ python/mxnet/gluon/contrib/nn/basic_layers.py — TBV).
 """
 from __future__ import annotations
 
+from ..block import HybridBlock
 from ..nn.basic_layers import BatchNorm
 
 __all__ = ["SyncBatchNorm"]
@@ -37,3 +38,32 @@ class SyncBatchNorm(BatchNorm):
 
     def _bn_op(self, F):
         return F.SyncBatchNorm, {"axis_name": self._axis_name}
+
+
+class Identity(HybridBlock):
+    """Passthrough block (reference gluon.contrib.nn.Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class HybridConcurrent(HybridBlock):
+    """Feed one input to every child and concat their outputs on ``axis``
+    (reference gluon.contrib.nn.HybridConcurrent — the Inception-branch
+    combinator)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def hybrid_forward(self, F, x):
+        outs = [b(x) for b in self._children.values()]
+        return F.concat(*outs, dim=self._axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias of HybridConcurrent (reference keeps both)."""

@@ -14,7 +14,7 @@ from ..parameter import Parameter
 __all__ = ["Sequential", "HybridSequential", "Dense", "Activation", "Dropout",
            "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "Embedding",
            "Flatten", "Lambda", "HybridLambda", "ELU", "SELU", "PReLU", "GELU",
-           "Swish", "LeakyReLU"]
+           "Swish", "SiLU", "LeakyReLU"]
 
 
 class Sequential(Block):
@@ -145,6 +145,13 @@ class Swish(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return x * F.sigmoid(self._beta * x)
+
+
+class SiLU(Swish):
+    """beta=1 Swish under its 2.x name."""
+
+    def __init__(self, **kwargs):
+        super().__init__(beta=1.0, **kwargs)
 
 
 class PReLU(HybridBlock):

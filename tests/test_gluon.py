@@ -303,3 +303,27 @@ def test_stablehlo_export_rejects_deferred_params(tmp_path):
         net.export(str(tmp_path / "m"), format="stablehlo", example_inputs=x)
     net(x)  # resolve shapes; export now succeeds
     net.export(str(tmp_path / "m"), format="stablehlo", example_inputs=x)
+
+
+def test_contrib_concurrent_identity_silu():
+    import numpy as np
+
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib import nn as cnn
+
+    branch = cnn.HybridConcurrent(axis=-1)
+    branch.add(nn.Dense(3, in_units=4), cnn.Identity())
+    branch.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+    out = branch(x)
+    assert out.shape == (2, 7)  # 3 (dense) + 4 (identity)
+    np.testing.assert_allclose(out.asnumpy()[:, 3:], x.asnumpy(), rtol=1e-6)
+
+    s = nn.SiLU()
+    y = s(x)
+    np.testing.assert_allclose(
+        y.asnumpy(), x.asnumpy() / (1 + np.exp(-x.asnumpy())), rtol=1e-5)
+
+    conc = cnn.Concurrent(axis=-1)
+    conc.add(cnn.Identity(), cnn.Identity())
+    assert conc(x).shape == (2, 8)
