@@ -10,7 +10,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss", "KLDivLoss",
            "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
-           "TripletLoss", "CosineEmbeddingLoss", "CTCLoss"]
+           "TripletLoss", "CosineEmbeddingLoss", "CTCLoss",
+           "PoissonNLLLoss", "SDMLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -264,3 +265,63 @@ class CTCLoss(Loss):
 
         loss = invoke_fn(ctc, [pred, label])
         return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (reference gluon.loss.PoissonNLLLoss):
+    pred is the predicted MEAN (or its log when from_logits=True)."""
+
+    def __init__(self, weight=1.0, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       epsilon=1e-08):
+        label = _reshape_like(F, label, pred)
+        if self._from_logits:
+            loss = F.exp(pred) - label * pred
+        else:
+            loss = pred - label * F.log(pred + epsilon)
+        if self._compute_full:
+            # Stirling approximation of log(label!) for label > 1
+            stirling = (label * F.log(label + epsilon) - label
+                        + 0.5 * F.log(2.0 * 3.141592653589793
+                                      * (label + epsilon)))
+            loss = loss + F.where(label > 1.0, stirling,
+                                  F.zeros_like(label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference gluon.loss.SDMLLoss):
+    row i scores x1[i] against every x2[j] by negative euclidean distance;
+    the matching pair j==i is the target class with label smoothing spread
+    over the non-matching candidates."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smooth = smoothing_parameter
+
+    def hybrid_forward(self, F, x1, x2, sample_weight=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import invoke_fn
+
+        def pure(a, b):
+            n = a.shape[0]
+            d = jnp.sqrt(jnp.sum((a[:, None, :] - b[None, :, :]) ** 2,
+                                 axis=-1) + 1e-12)
+            logits = -d                                    # (N, N)
+            logp = logits - jax.scipy.special.logsumexp(logits, axis=1,
+                                                        keepdims=True)
+            eye = jnp.eye(n, dtype=logits.dtype)
+            target = (eye * (1.0 - self._smooth)
+                      + (1.0 - eye) * (self._smooth / (n - 1)))
+            return -jnp.mean(jnp.sum(target * logp, axis=1))
+
+        return invoke_fn(pure, [x1, x2])

@@ -327,3 +327,35 @@ def test_contrib_concurrent_identity_silu():
     conc = cnn.Concurrent(axis=-1)
     conc.add(cnn.Identity(), cnn.Identity())
     assert conc(x).shape == (2, 8)
+
+
+def test_poisson_nll_and_sdml_losses():
+    import numpy as np
+
+    from mxnet_tpu.gluon import loss as gl
+
+    rng = np.random.RandomState(0)
+    # Poisson NLL: from_logits — loss = exp(pred) - label*pred
+    pred = nd.array(rng.randn(4, 3).astype(np.float32))
+    lbl = nd.array(rng.randint(0, 5, (4, 3)).astype(np.float32))
+    l = gl.PoissonNLLLoss(from_logits=True)(pred, lbl)
+    expect = np.mean(np.exp(pred.asnumpy()) - lbl.asnumpy() * pred.asnumpy())
+    np.testing.assert_allclose(float(l.asnumpy()), expect, rtol=1e-5)
+    l2 = gl.PoissonNLLLoss(from_logits=False, compute_full=True)(
+        nd.abs(pred) + 0.1, lbl)
+    assert np.isfinite(float(l2.asnumpy()))
+
+    # SDML: identical embeddings -> diagonal dominant -> lower loss than
+    # mismatched embeddings
+    emb = nd.array(rng.rand(6, 8).astype(np.float32))
+    same = gl.SDMLLoss()(emb, emb)
+    shuffled = nd.array(emb.asnumpy()[::-1].copy())
+    diff = gl.SDMLLoss()(emb, shuffled)
+    assert float(same.asnumpy()) < float(diff.asnumpy())
+
+    # gradients flow through SDML
+    emb.attach_grad()
+    with mx.autograd.record():
+        out = gl.SDMLLoss()(emb, nd.array(rng.rand(6, 8).astype(np.float32)))
+    out.backward()
+    assert np.abs(emb.grad.asnumpy()).max() > 0
