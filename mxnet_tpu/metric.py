@@ -6,8 +6,9 @@ import numpy as np
 from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "CrossEntropy", "Perplexity",
-           "F1", "MAE", "MSE", "RMSE", "PearsonCorrelation", "Loss",
-           "CompositeEvalMetric", "create"]
+           "F1", "MCC", "NegativeLogLikelihood", "MAE", "MSE", "RMSE",
+           "PearsonCorrelation", "Loss", "CompositeEvalMetric",
+           "MApMetric", "VOC07MApMetric", "create"]
 
 _REGISTRY = {}
 
@@ -428,3 +429,55 @@ class VOC07MApMetric(MApMetric):
             mask = rec >= t
             ap += (float(prec[mask].max()) if mask.any() else 0.0) / 11.0
         return ap
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient for binary classification
+    (reference metric.MCC — TBV): computed from accumulated confusion
+    counts so it composes across batches."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self._tp = self._tn = self._fp = self._fn = 0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred, label = _np(pred), _np(label).reshape(-1).astype(np.int64)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.reshape(-1).astype(np.int64)
+            self._tp += int(((pred == 1) & (label == 1)).sum())
+            self._tn += int(((pred == 0) & (label == 0)).sum())
+            self._fp += int(((pred == 1) & (label == 0)).sum())
+            self._fn += int(((pred == 0) & (label == 1)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        tp, tn, fp, fn = self._tp, self._tn, self._fp, self._fn
+        denom = np.sqrt(float(tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        mcc = (tp * tn - fp * fn) / denom if denom > 0 else 0.0
+        return self.name, float(mcc)
+
+
+@register
+class NegativeLogLikelihood(EvalMetric):
+    """Mean NLL of the labeled class (reference metric.NegativeLogLikelihood)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            label = _np(label).astype(np.int64).reshape(-1)
+            p = pred.reshape(-1, pred.shape[-1])[np.arange(len(label)), label]
+            self.sum_metric += float(-np.log(p + self.eps).sum())
+            self.num_inst += len(label)

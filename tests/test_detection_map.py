@@ -151,3 +151,23 @@ def test_map_difficult_not_consumed():
                  [0, 0.8, 0.5, 0.5, 0.9, 0.9]])
     m.update([gt], [det])
     assert m.get()[1] == 1.0  # both difficult-matches ignored, easy gt tp
+
+
+def test_mcc_and_nll_metrics():
+    import numpy as onp
+
+    m = mx.metric.MCC()
+    # perfect prediction -> MCC 1
+    m.update([onp.array([1, 0, 1, 0])], [onp.array([1, 0, 1, 0])])
+    assert abs(m.get()[1] - 1.0) < 1e-9
+    m.reset()
+    # inverted -> MCC -1
+    m.update([onp.array([1, 0, 1, 0])], [onp.array([0, 1, 0, 1])])
+    assert abs(m.get()[1] + 1.0) < 1e-9
+
+    n = mx.metric.NegativeLogLikelihood()
+    probs = onp.array([[0.9, 0.1], [0.2, 0.8]], onp.float32)
+    n.update([onp.array([0, 1])], [probs])
+    expect = -(onp.log(0.9) + onp.log(0.8)) / 2
+    assert abs(n.get()[1] - expect) < 1e-6
+    assert isinstance(mx.metric.create("mcc"), mx.metric.MCC)
