@@ -16,7 +16,8 @@ from .random import np_rng
 from .ndarray import NDArray, array
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal", "Orthogonal",
-           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "create", "register"]
+           "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "InitDesc",
+           "Load", "create", "register"]
 
 _REGISTRY = {}
 
@@ -195,3 +196,45 @@ class Mixed(Initializer):
                 init(name, arr)
                 return
         raise ValueError(f"parameter {name} did not match any pattern")
+
+
+class InitDesc(str):
+    """Parameter-name descriptor carrying init attrs (reference
+    mx.init.InitDesc: a str subclass so name-suffix dispatch keeps
+    working; ``attrs`` may carry __init__ overrides, ``global_init`` the
+    fallback initializer)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+@register
+class Load(Initializer):
+    """Init from a saved param dict, falling back to ``default_init`` for
+    missing names (reference mx.init.Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {}
+        for k, v in dict(param).items():
+            k = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
+            self.param[k] = v
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr: NDArray):
+        if name in self.param:
+            src = self.param[name]
+            src_np = src.asnumpy() if isinstance(src, NDArray) else src
+            if tuple(src_np.shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"Load: shape mismatch for {name!r}: saved "
+                    f"{src_np.shape} vs param {arr.shape}")
+            arr[:] = src_np
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise ValueError(f"Load: no saved value for {name!r} and no "
+                             "default_init")

@@ -1,9 +1,11 @@
 """Optimizer package (reference python/mxnet/optimizer/)."""
 from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, LAMB, RMSProp, AdaGrad,
-                        AdaDelta, Ftrl, FTML, Signum, create, register, Updater,
+                        AdaDelta, Ftrl, FTML, Signum, AdaMax, Adamax, Nadam,
+                        SGLD, DCASGD, LARS, create, register, Updater,
                         get_updater)
 from . import lr_scheduler  # noqa: F401
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp", "AdaGrad",
-           "AdaDelta", "Ftrl", "FTML", "Signum", "create", "register", "Updater",
+           "AdaDelta", "Ftrl", "FTML", "Signum", "AdaMax", "Adamax", "Nadam",
+           "SGLD", "DCASGD", "LARS", "create", "register", "Updater",
            "get_updater", "lr_scheduler"]

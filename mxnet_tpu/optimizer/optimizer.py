@@ -521,3 +521,42 @@ class DCASGD(Optimizer):
                 "wd": wd, "rescale_grad": self.rescale_grad,
                 "clip_gradient": self.clip_gradient,
                 "out": (weight, mom, prev)})
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference optimizer.LARS — the
+    large-batch SGD variant): per-tensor trust ratio
+    eta*||w|| / (||g|| + wd*||w|| + eps) scales the learning rate, then a
+    plain momentum update applies."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        import numpy as _np
+
+        lr, wd = self._common(index)
+        w_norm = float(_np.linalg.norm(weight.asnumpy()))
+        g = grad.asnumpy() * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = _np.clip(g, -self.clip_gradient, self.clip_gradient)
+        g_norm = float(_np.linalg.norm(g))
+        trust = 1.0
+        if w_norm > 0 and g_norm > 0:
+            trust = self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
+        invoke("sgd_mom_update", [weight, grad, state],
+               {"lr": lr * trust, "wd": wd, "momentum": self.momentum,
+                "rescale_grad": self.rescale_grad,
+                "clip_gradient": self.clip_gradient,
+                "out": (weight, state)})
+
+
+Adamax = AdaMax  # reference spelling alias
+_REGISTRY["adamax"] = AdaMax

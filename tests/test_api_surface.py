@@ -407,3 +407,44 @@ def test_fused_cell_bidirectional_unroll():
     r0 = (res[0] if isinstance(res, (list, tuple)) else res)
     assert r0.shape == (N, T, 2 * H)
     assert np.isfinite(r0.asnumpy()).all()
+
+
+def test_optimizer_family_exports_and_lars():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    for name in ["AdaMax", "Adamax", "Nadam", "SGLD", "DCASGD", "LARS"]:
+        assert hasattr(mx.optimizer, name), name
+    opt = mx.optimizer.create("lars", learning_rate=0.1, momentum=0.9)
+    w = nd.array(np.ones((4, 3), np.float32))
+    g = nd.array(np.full((4, 3), 0.1, np.float32))
+    st = opt.create_state(0, w)
+    before = w.asnumpy().copy()
+    opt.update(0, w, g, st)
+    assert not np.allclose(w.asnumpy(), before)
+    # trust ratio keeps the step finite and small relative to the weight
+    assert np.abs(w.asnumpy() - before).max() < 0.1
+
+
+def test_initializer_load_and_initdesc():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    saved = {"arg:w": nd.array(np.full((2, 2), 7.0, np.float32))}
+    init = mx.init.Load(saved, default_init=mx.init.Zero())
+    arr = nd.array(np.zeros((2, 2), np.float32))
+    init("w", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 7.0)
+    other = nd.array(np.ones((3,), np.float32))
+    init("missing_weight", other)   # falls back to Zero
+    np.testing.assert_allclose(other.asnumpy(), 0.0)
+    import pytest as _pt
+    with _pt.raises(ValueError, match="shape mismatch"):
+        init("w", nd.array(np.zeros((3, 3), np.float32)))
+
+    d = mx.init.InitDesc("fc_weight", attrs={"__init__": "zeros"})
+    assert d == "fc_weight" and d.attrs["__init__"] == "zeros"
