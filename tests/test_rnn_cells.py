@@ -172,6 +172,11 @@ def test_bucketing_lm_end_to_end():
     BucketingModule.fit-style loop (example/rnn/bucketing — TBV)."""
     from mxnet_tpu.module import BucketingModule
 
+    # seed EVERYTHING: init draws from the framework RNG and the bucket
+    # iterator shuffles via global numpy — full-suite ordering otherwise
+    # makes this toy 3-epoch convergence check flaky
+    mx.random.seed(11)
+    np.random.seed(11)
     rng = np.random.RandomState(7)
     V, E, H = 20, 6, 5
     sentences = [list(rng.randint(1, V, rng.randint(3, 9)))
@@ -200,7 +205,7 @@ def test_bucketing_lm_end_to_end():
     mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
 
     losses = []
-    for epoch in range(3):
+    for epoch in range(5):
         it.reset()
         for batch in it:
             mod.forward(batch, is_train=True)
