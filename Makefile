@@ -1,0 +1,22 @@
+# Developer entry points. The analyzer targets are what CI / future PRs
+# should run before binding anything (docs/ANALYSIS.md).
+
+PYTHON ?= python
+export JAX_PLATFORMS ?= cpu
+
+.PHONY: lint lint-tests test test-fast
+
+# repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
+lint:
+	$(PYTHON) tools/lint_repo.py mxnet_tpu
+
+# the static-analysis test subset (graph/trace/sharding/repo lint)
+lint-tests:
+	$(PYTHON) -m pytest tests/ -q -m lint -p no:cacheprovider
+
+# tier-1: everything but slow
+test:
+	$(PYTHON) -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
+
+test-fast: lint
+	$(PYTHON) -m pytest tests/test_analysis.py tests/test_repo_lint.py -q -p no:cacheprovider

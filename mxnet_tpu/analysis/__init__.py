@@ -1,0 +1,32 @@
+"""``mxnet_tpu.analysis`` — graph & trace static analysis (pre-flight lint).
+
+The reference front-loads graph validation in C++ NNVM passes
+(FInferShape/FInferType reject bad graphs before the Executor runs); this
+package is the TPU-native counterpart, catching both late crashes and
+*silent* perf bugs before any XLA compilation:
+
+- :class:`GraphLinter` — pass-based lint over Symbol graphs (shape/dtype
+  pre-flight with per-node attribution, dead nodes, duplicate names,
+  non-differentiable ops on the gradient path, numeric idioms, fan-out);
+- :class:`TraceLinter` — jit-trace hygiene for HybridBlocks (retrace
+  churn, concretization leaks, weak-dtype promotion);
+- :class:`ShardingLinter` — PartitionSpec rule tables vs the mesh
+  (unknown axes, indivisible dims, accidentally replicated large params);
+- repo self-lint (``tools/lint_repo.py``) — framework invariants over the
+  source tree itself.
+
+User surfaces: ``Symbol.lint(...)``, ``bind(..., lint="warn"|"error")``,
+``python -m mxnet_tpu.analysis graph.json``. See docs/ANALYSIS.md.
+"""
+from .findings import Finding, GraphAnalysisError, Report, Severity  # noqa: F401
+from .graph import GraphView, NodeInfo  # noqa: F401
+from .graph_passes import GraphLinter, LintContext, graph_pass, list_passes  # noqa: F401
+from .sharding import ShardingLinter  # noqa: F401
+from .trace import TraceLinter  # noqa: F401
+
+__all__ = [
+    "Finding", "GraphAnalysisError", "Report", "Severity",
+    "GraphView", "NodeInfo",
+    "GraphLinter", "LintContext", "graph_pass", "list_passes",
+    "ShardingLinter", "TraceLinter",
+]

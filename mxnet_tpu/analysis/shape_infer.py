@@ -1,0 +1,225 @@
+"""Shape/dtype pre-flight — the one inference engine for the whole stack.
+
+This is the NNVM ``InferShape``/``InferType`` analog: a topo-order walk
+that evaluates every node through ``jax.eval_shape`` over the same pure op
+functions the executor jits, deriving auto-created parameter shapes from
+the per-op rules in ``symbol.symbol._param_shape_rules``.
+
+Three consumers share it so they can never disagree:
+
+- ``Symbol.infer_shape``/``infer_type`` (raise mode: first failure raises a
+  node-attributed :class:`GraphAnalysisError`);
+- the ``shape-preflight`` lint pass (collect mode: failures become
+  ``Finding``s and the walk continues past them);
+- ``visualization.print_summary`` (per-node output shapes for the table).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+import numpy as np
+
+from ..base import GraphAnalysisError
+from .findings import Finding, Severity
+
+__all__ = ["InferResult", "infer_graph"]
+
+
+@dataclass
+class InferResult:
+    shapes: Dict[str, tuple] = field(default_factory=dict)
+    dtypes: Dict[str, Any] = field(default_factory=dict)
+    out_shapes: List[Optional[tuple]] = field(default_factory=list)
+    out_dtypes: List[Any] = field(default_factory=list)
+    node_out: Dict[int, Any] = field(default_factory=dict)    # id(node) -> shape|[shapes]
+    node_dtype: Dict[int, Any] = field(default_factory=dict)  # id(node) -> dtype|[dtypes]
+    node_in: Dict[int, List[Optional[tuple]]] = field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+    failed: Set[int] = field(default_factory=set)
+
+
+def _var_dtype(node, known_dtypes):
+    if known_dtypes and node._name in known_dtypes:
+        return np.dtype(known_dtypes[node._name])
+    d = node._attrs.get("__dtype__")
+    if d is not None:
+        try:
+            from ..base import dtype_np
+
+            return np.dtype(dtype_np(str(d)))
+        except Exception:
+            try:
+                return np.dtype(str(d))
+            except TypeError:
+                pass
+    return np.dtype(np.float32)
+
+
+def infer_graph(sym, known_shapes: Dict[str, tuple],
+                known_dtypes: Optional[Dict[str, Any]] = None,
+                collect: bool = False,
+                use_hint_cache: bool = False) -> InferResult:
+    """Walk ``sym`` in topo order inferring every node's output shape/dtype.
+
+    collect=False: raise :class:`GraphAnalysisError` at the first failure,
+    naming the node, its op, and its input shapes.
+    collect=True: record failures as error findings and keep walking
+    (downstream nodes with unknown inputs are skipped, not re-reported).
+    use_hint_cache: reuse/populate per-node ``_hint_shape`` memos. ONLY
+    valid when no explicit known_shapes/known_dtypes are given (the
+    ``Symbol.shape`` path): cached values are derived purely from
+    ``Variable(shape=...)`` hints, which are fixed at construction, so a
+    repeated walk — e.g. per-layer ``x.shape`` reads while tracing a deep
+    net — skips the eval_shape of every already-seen prefix node.
+    """
+    import jax
+
+    from ..ops import get_op, has_op
+    from ..ops.registry import coerce_kwargs
+    from ..symbol.symbol import _param_shape_rules, op_input_names
+
+    res = InferResult()
+    res.shapes = {k: tuple(v) for k, v in known_shapes.items()}
+    if known_dtypes:
+        res.dtypes = {k: np.dtype(v) for k, v in known_dtypes.items()}
+
+    def fail(rule_id, msg, node_name, op, in_shapes=None, fix_hint=None):
+        if not collect:
+            raise GraphAnalysisError(msg, node=node_name, op=op,
+                                     rule_id=rule_id, input_shapes=in_shapes)
+        res.findings.append(Finding(rule_id, Severity.ERROR, msg,
+                                    node=node_name, op=op, fix_hint=fix_hint,
+                                    details={"input_shapes": in_shapes}))
+
+    use_hint_cache = use_hint_cache and not known_shapes and not known_dtypes
+    for node in sym._topo():
+        if use_hint_cache and "_hint_shape" in node.__dict__:
+            s, d = node._hint_shape, node._hint_dtype
+            res.node_out[id(node)] = s
+            res.node_dtype[id(node)] = d
+            if node._op is None:
+                res.shapes[node._name] = s
+                res.dtypes[node._name] = d
+            continue
+        if node._op is None:
+            if node._name not in res.shapes and "__shape__" in node._attrs:
+                res.shapes[node._name] = tuple(node._attrs["__shape__"])
+            if node._name in res.shapes:
+                res.node_out[id(node)] = res.shapes[node._name]
+                dt = _var_dtype(node, known_dtypes)
+                res.dtypes[node._name] = dt
+                res.node_dtype[id(node)] = dt
+            continue
+        if node._op == "_group":
+            continue
+        inline_opdef = getattr(node, "_opdef", None)  # symbol.invoke_fn
+        if inline_opdef is None and not has_op(node._op):
+            fail("unknown-op",
+                 f"operator {node._op!r} is not in the op registry",
+                 node._name, node._op,
+                 fix_hint="check the op name / load a graph exported by "
+                          "this framework version")
+            res.failed.add(id(node))
+            continue
+        opdef = inline_opdef or get_op(node._op)
+        kwargs = coerce_kwargs({k2: v for k2, v in node._attrs.items()
+                                if not k2.startswith("__")})
+        input_names = op_input_names(opdef)
+        # primary input shape drives the parameter auto-shape rules
+        primary = None
+        for i in node._inputs:
+            s = res.node_out.get(id(i._base()))
+            if s is not None:
+                if i._index is not None and isinstance(s, list):
+                    s = s[i._index]
+                primary = s
+                break
+        in_shapes: List[Optional[tuple]] = []
+        in_dtypes: List[Any] = []
+        skip = False
+        for pos, i in enumerate(node._inputs):
+            base = i._base()
+            s = res.node_out.get(id(base))
+            d = res.node_dtype.get(id(base))
+            if s is not None and i._index is not None and isinstance(s, list):
+                s = s[i._index]
+                d = d[i._index] if isinstance(d, list) else d
+            if s is None and base._op is None:
+                arg = input_names[pos] if pos < len(input_names) else None
+                s = _param_shape_rules(node._op, primary, kwargs, arg) \
+                    if primary is not None and arg else None
+                if s is None:
+                    fail("missing-shape",
+                         f"cannot infer shape of {base._name!r} (input "
+                         f"{arg!r} of {node._op}); provide it explicitly",
+                         base._name, node._op,
+                         fix_hint=f"pass {base._name}=<shape> to infer_shape/"
+                                  "bind, or set shape= on the Variable")
+                    skip = True
+                    break
+                res.shapes[base._name] = tuple(s)
+                res.node_out[id(base)] = tuple(s)
+                d = _var_dtype(base, known_dtypes)
+                res.dtypes[base._name] = d
+                res.node_dtype[id(base)] = d
+            if s is None:
+                # upstream failure already reported; don't cascade
+                skip = True
+                break
+            in_shapes.append(tuple(s))
+            in_dtypes.append(np.dtype(d) if d is not None else
+                             np.dtype(np.float32))
+        res.node_in[id(node)] = in_shapes
+        if skip:
+            res.failed.add(id(node))
+            continue
+        avals = [jax.ShapeDtypeStruct(s, d)
+                 for s, d in zip(in_shapes, in_dtypes)]
+        try:
+            out = jax.eval_shape(lambda *a: opdef.fn(*a, **kwargs), *avals)
+        except Exception as e:
+            shown = ", ".join(f"{n}:{s}" for n, s in
+                              zip([i._base()._name for i in node._inputs],
+                                  in_shapes))
+            fail("shape-mismatch",
+                 f"shape inference failed at {node._op} ({node._name}): "
+                 f"inputs [{shown}] attrs {kwargs or '{}'}: {e}",
+                 node._name, node._op, in_shapes=in_shapes,
+                 fix_hint="fix the input shapes or the op attrs shown above")
+            res.failed.add(id(node))
+            continue
+        if isinstance(out, (list, tuple)):
+            res.node_out[id(node)] = [tuple(o.shape) for o in out]
+            res.node_dtype[id(node)] = [np.dtype(o.dtype) for o in out]
+        else:
+            res.node_out[id(node)] = tuple(out.shape)
+            res.node_dtype[id(node)] = np.dtype(out.dtype)
+
+    if use_hint_cache:
+        for node in sym._topo():
+            if id(node) in res.node_out and id(node) not in res.failed \
+                    and "_hint_shape" not in node.__dict__:
+                node._hint_shape = res.node_out[id(node)]
+                node._hint_dtype = res.node_dtype.get(id(node))
+
+    # ---- head outputs -------------------------------------------------
+    if sym._op == "_group":
+        heads = [(s._base(), s._index) for s in sym._inputs]
+    else:
+        heads = [(sym._base(), sym._index)]
+    for base, index in heads:
+        s = res.node_out.get(id(base))
+        d = res.node_dtype.get(id(base))
+        if isinstance(s, list):
+            if index is not None:
+                res.out_shapes.append(s[index])
+                res.out_dtypes.append(d[index] if isinstance(d, list) else d)
+            else:
+                res.out_shapes.extend(s)
+                res.out_dtypes.extend(d if isinstance(d, list)
+                                      else [d] * len(s))
+        else:
+            res.out_shapes.append(s)
+            res.out_dtypes.append(d)
+    return res

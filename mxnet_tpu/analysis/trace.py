@@ -1,0 +1,210 @@
+"""TraceLinter — jit-trace hygiene checks for HybridBlocks.
+
+Three classes of silent perf/correctness bugs the tracer can't flag itself:
+
+- ``retrace-churn``: every distinct (shapes, dtypes, train-mode) signature
+  recompiles the CachedOp; a loop feeding ragged shapes compiles forever.
+- ``concretization-leak``: ``float()``/``bool()``/``.asnumpy()`` on a traced
+  value either crashes under jit or silently forces a host sync per step.
+- ``weak-dtype-promotion``: mixed param/input float dtypes promote inside
+  the trace, upcasting the whole model (bf16 params + fp32 inputs run fp32).
+
+Usage::
+
+    report = TraceLinter().lint(net, example_x)      # static + cache checks
+    with TraceLinter().watch(net) as tl:             # observe a train loop
+        for batch in loader: net(batch)
+    report = tl.report()
+"""
+from __future__ import annotations
+
+import ast
+import contextlib
+import inspect
+import textwrap
+from typing import List, Optional
+
+from .findings import Finding, Report, Severity
+
+__all__ = ["TraceLinter"]
+
+# host-sync call forms flagged inside hybrid_forward/forward bodies
+_HOST_BUILTINS = {"float", "bool"}
+_HOST_NP_FUNCS = {"asarray", "array"}
+_HOST_METHODS = {"asnumpy", "item", "tolist"}
+_NP_MODULES = {"np", "numpy", "_np", "onp"}
+
+
+def _is_constant(node) -> bool:
+    return isinstance(node, (ast.Constant, ast.Num, ast.Str)) or \
+        (isinstance(node, ast.UnaryOp) and _is_constant(node.operand))
+
+
+class _HostCallScanner(ast.NodeVisitor):
+    def __init__(self, filename: str, lineno_base: int):
+        self.filename = filename
+        self.lineno_base = lineno_base
+        self.findings: List[Finding] = []
+
+    def _flag(self, node, what):
+        line = self.lineno_base + node.lineno - 1
+        self.findings.append(Finding(
+            "concretization-leak", Severity.WARNING,
+            f"{what} inside a traced forward: crashes under hybridize/jit "
+            "(ConcretizationTypeError) or forces a device->host sync every "
+            "call when eager",
+            location=f"{self.filename}:{line}",
+            fix_hint="keep the math in the graph (use ops / lax.cond), or "
+                     "compute it outside forward"))
+
+    def visit_Call(self, node):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _HOST_BUILTINS \
+                and node.args and not _is_constant(node.args[0]):
+            self._flag(node, f"{fn.id}(...)")
+        elif isinstance(fn, ast.Attribute):
+            if fn.attr in _HOST_METHODS and not node.args:
+                self._flag(node, f".{fn.attr}()")
+            elif fn.attr in _HOST_NP_FUNCS \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in _NP_MODULES \
+                    and node.args and not _is_constant(node.args[0]):
+                self._flag(node, f"{fn.value.id}.{fn.attr}(...)")
+        self.generic_visit(node)
+
+
+class TraceLinter:
+    """Static + cache-observing lint for HybridBlock tracing hygiene."""
+
+    def __init__(self, retrace_threshold: int = 3, **options):
+        self.retrace_threshold = int(retrace_threshold)
+        self.options = options
+        self._watch_baseline = None
+        self._watched = None
+
+    # ------------------------------------------------------------- static
+    def scan_source(self, block) -> List[Finding]:
+        """AST scan of every distinct forward/hybrid_forward in the tree."""
+        findings: List[Finding] = []
+        seen_fns = set()
+        for blk in self._walk_blocks(block):
+            for meth_name in ("hybrid_forward", "forward"):
+                meth = getattr(type(blk), meth_name, None)
+                if meth is None or meth in seen_fns:
+                    continue
+                seen_fns.add(meth)
+                if getattr(meth, "__module__", "").startswith(
+                        "mxnet_tpu.gluon.block"):
+                    continue  # framework dispatch glue, not user math
+                try:
+                    src = textwrap.dedent(inspect.getsource(meth))
+                    fname = inspect.getsourcefile(meth) or "<unknown>"
+                    base = inspect.getsourcelines(meth)[1]
+                except (OSError, TypeError):
+                    continue
+                try:
+                    tree = ast.parse(src)
+                except SyntaxError:
+                    continue
+                scanner = _HostCallScanner(fname, base)
+                scanner.visit(tree)
+                findings.extend(scanner.findings)
+        return findings
+
+    @staticmethod
+    def _walk_blocks(block):
+        yield block
+        for c in getattr(block, "_children", {}).values():
+            yield from TraceLinter._walk_blocks(c)
+
+    # ------------------------------------------------------------- dtypes
+    def check_dtypes(self, block, *example_inputs) -> List[Finding]:
+        import numpy as np
+
+        findings: List[Finding] = []
+        param_dts = set()
+        for p in getattr(block, "_iter_params", lambda: ())():
+            if p._data is not None:
+                param_dts.add(np.dtype(p.data().dtype))
+        float_params = {d for d in param_dts if d.kind == "f" or
+                        "bfloat" in d.name}
+        for i, x in enumerate(example_inputs):
+            dt = np.dtype(getattr(x, "dtype", np.float32))
+            if (dt.kind == "f" or "bfloat" in dt.name) and float_params \
+                    and dt not in float_params:
+                pd = ", ".join(sorted(d.name for d in float_params))
+                findings.append(Finding(
+                    "weak-dtype-promotion", Severity.WARNING,
+                    f"input #{i} is {dt.name} but parameters are {pd}; "
+                    "promotion inside the trace silently runs the model at "
+                    "the wider dtype (and retraces per input dtype)",
+                    node=f"input#{i}",
+                    fix_hint="cast inputs to the parameter dtype (or use "
+                             "amp/cast policy) before the traced call"))
+        return findings
+
+    # -------------------------------------------------------------- cache
+    @staticmethod
+    def _cache_keys(block):
+        keys = []
+        for blk in TraceLinter._walk_blocks(block):
+            op = getattr(blk, "_cached_op", None)
+            if op is not None:
+                keys.extend(op._cache.keys())
+        return keys
+
+    def check_cache(self, block, baseline: int = 0) -> List[Finding]:
+        keys = self._cache_keys(block)
+        n_new = len(keys) - baseline
+        findings: List[Finding] = []
+        if n_new <= self.retrace_threshold:
+            return findings
+        # diagnose which signature component varies
+        by_train = {}
+        for train, pav, iav in keys:
+            by_train.setdefault(train, []).append((pav, iav))
+        shapes = {tuple(s for s, _ in iav) for _t, _p, iav in keys}
+        dtypes = {tuple(d for _, d in iav) for _t, _p, iav in keys}
+        varying = []
+        if len(shapes) > 1:
+            varying.append(f"input shapes ({len(shapes)} distinct)")
+        if len(dtypes) > 1:
+            varying.append(f"input dtypes ({len(dtypes)} distinct)")
+        if len(by_train) > 1:
+            varying.append("train/eval mode (expected, costs one retrace)")
+        sample = ", ".join(str(s) for s in list(shapes)[:3])
+        findings.append(Finding(
+            "retrace-churn", Severity.WARNING,
+            f"{n_new} distinct jit signatures compiled (threshold "
+            f"{self.retrace_threshold}); varying: "
+            f"{'; '.join(varying) or 'unknown'}; e.g. shapes {sample}",
+            node=getattr(block, "name", None),
+            fix_hint="bucket/pad inputs to a fixed set of shapes and cast "
+                     "to one dtype so compiled programs are reused"))
+        return findings
+
+    # ------------------------------------------------------------- public
+    def lint(self, block, *example_inputs) -> Report:
+        report = Report(self.scan_source(block))
+        if example_inputs:
+            report.extend(self.check_dtypes(block, *example_inputs))
+        report.extend(self.check_cache(block))
+        return report
+
+    @contextlib.contextmanager
+    def watch(self, block):
+        """Observe a training/eval loop; ``report()`` afterwards."""
+        self._watched = block
+        self._watch_baseline = len(self._cache_keys(block))
+        try:
+            yield self
+        finally:
+            pass
+
+    def report(self) -> Report:
+        if self._watched is None:
+            raise RuntimeError("report() requires a completed watch() block")
+        rep = Report(self.scan_source(self._watched))
+        rep.extend(self.check_cache(self._watched,
+                                    baseline=self._watch_baseline))
+        return rep

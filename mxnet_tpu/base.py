@@ -30,6 +30,25 @@ class MXNetError(RuntimeError):
     """Error raised by the framework (parity with reference ``MXNetError``)."""
 
 
+class GraphAnalysisError(MXNetError, ValueError):
+    """Structured graph-analysis failure with node attribution.
+
+    Raised by shape/type inference and ``bind(lint="error")`` instead of an
+    opaque tracer exception: ``node``/``op``/``input_shapes`` name exactly
+    where the graph broke. Subclasses ValueError so callers that caught the
+    old ad-hoc inference ValueErrors keep working.
+    """
+
+    def __init__(self, message, node=None, op=None, rule_id=None,
+                 input_shapes=None, findings=None):
+        super().__init__(message)
+        self.node = node
+        self.op = op
+        self.rule_id = rule_id
+        self.input_shapes = input_shapes
+        self.findings = findings or []
+
+
 # Default real type, matching the reference's mshadow default_real_t = float32.
 mx_real_t = np.float32
 

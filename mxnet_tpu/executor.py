@@ -59,7 +59,8 @@ def _build_graph_fn(sym, train: bool):
                     continue
                 if node._op == "_group":
                     continue
-                opdef = get_op(node._op)
+                # invoke_fn nodes carry their OpDef inline (symbol.invoke_fn)
+                opdef = getattr(node, "_opdef", None) or get_op(node._op)
                 kwargs = coerce_kwargs({k: v for k, v in node._attrs.items()
                                         if not k.startswith("__")})
                 in_vals = []
@@ -103,11 +104,40 @@ class Executor:
     """Bound graph with argument/gradient/aux arrays (reference Executor)."""
 
     def __init__(self, symbol, ctx=None, grad_req="write", shapes=None,
-                 args=None, args_grad=None, aux_states=None):
+                 args=None, args_grad=None, aux_states=None, lint=None):
         self._symbol = symbol
         self._ctx = Context(ctx) if ctx is not None else current_context()
         self._grad_req = grad_req
         self.outputs_nd: List[NDArray] = []
+        self.lint_report = None
+
+        # Pre-flight static analysis BEFORE any inference/compilation:
+        # lint="error" rejects a bad graph with node attribution instead of
+        # an opaque tracer exception; "warn" reports and continues.
+        # Default comes from MXNET_GRAPH_LINT (off).
+        if lint is None:
+            import os
+
+            lint = os.environ.get("MXNET_GRAPH_LINT", "off")
+        if lint not in ("off", "warn", "error"):
+            raise ValueError(f"lint must be 'off'|'warn'|'error', got {lint!r}")
+        if lint != "off":
+            known = {k: tuple(v) for k, v in (shapes or {}).items()}
+            if not known and args is not None:
+                named = args.items() if isinstance(args, dict) \
+                    else zip(symbol.list_arguments(), args)
+                known = {k: tuple(v.shape) if isinstance(v, NDArray)
+                         else tuple(NDArray(v).shape) for k, v in named}
+            from .analysis import GraphLinter
+
+            self.lint_report = GraphLinter().lint(symbol, shapes=known)
+            if lint == "error":
+                self.lint_report.raise_if_errors()
+            elif self.lint_report:
+                import warnings
+
+                warnings.warn("graph lint: " + self.lint_report.format(),
+                              stacklevel=2)
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()

@@ -305,8 +305,16 @@ class HybridBlock(Block):
     def _forward_symbolic(self, x, *args, **kwargs):
         from .. import symbol as sym_mod
 
-        params = {attr: sym_mod.Variable(p.name)
-                  for attr, p in self._reg_params.items()}
+        def as_var(p):
+            # carry the declared shape when fully known so the shared shape
+            # pre-flight (analysis/shape_infer) — and hence Symbol.shape
+            # inside shape-inspecting forwards — can anchor inference
+            shape = getattr(p, "shape", None)
+            if shape and all(int(d) > 0 for d in shape):
+                return sym_mod.Variable(p.name, shape=tuple(shape))
+            return sym_mod.Variable(p.name)
+
+        params = {attr: as_var(p) for attr, p in self._reg_params.items()}
         return self.hybrid_forward(sym_mod, x, *args, **params, **kwargs)
 
     def _forward_eager(self, x, *args, **kwargs):
@@ -328,6 +336,48 @@ class HybridBlock(Block):
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError
+
+    def lint(self, shapes=None, passes=None, **shape_kwargs):
+        """Static-analyze this block before any compilation.
+
+        Runs the :class:`~mxnet_tpu.analysis.TraceLinter` source checks
+        (concretization leaks in forward bodies) and — when the block
+        traces symbolically — the full :class:`~mxnet_tpu.analysis.
+        GraphLinter` over its graph with the given input shapes::
+
+            report = net.lint(data=(2, 3, 32, 32))
+            report.raise_if_errors()
+
+        ``shapes`` maps input Variable names to shapes (one per positional
+        forward input, in order). Blocks whose forward is not F-generic
+        get an info-level ``not-symbolically-traceable`` finding and only
+        the source checks.
+        """
+        from ..analysis import Finding, GraphLinter, Report, Severity, TraceLinter
+        from .. import symbol as sym_mod
+
+        all_shapes = dict(shapes or {})
+        all_shapes.update({k: tuple(v) for k, v in shape_kwargs.items()})
+        report = Report(TraceLinter().scan_source(self))
+        ins = [sym_mod.Variable(n, shape=s) for n, s in all_shapes.items()]
+        try:
+            out = self(*ins) if ins else self(sym_mod.Variable("data"))
+            if isinstance(out, (list, tuple)):
+                out = sym_mod.Group(list(out))
+        except Exception as e:
+            report.add(Finding(
+                "not-symbolically-traceable", Severity.INFO,
+                f"block does not trace symbolically ({type(e).__name__}: "
+                f"{str(e)[:200]}); graph passes skipped",
+                node=getattr(self, "name", None),
+                fix_hint="make hybrid_forward F-generic (ops via F, "
+                         "F.split over tensor indexing) to enable graph "
+                         "lint"))
+            return report
+        param_names = {p.name for p in self._iter_params()}
+        report.extend(GraphLinter(passes=passes, param_names=param_names)
+                      .lint(out, shapes=all_shapes))
+        return report
 
     def export(self, path, epoch=0, format="json", example_inputs=None):
         """Save for deployment (reference HybridBlock.export — symbol.json +

@@ -41,9 +41,12 @@ class CrossAttention(HybridBlock):
         sk = memory.shape[1]
         h, d = self._heads, self._units // self._heads
         q = self.q_proj(x).reshape((b, sq, h, d)).transpose((0, 2, 1, 3))
-        kv = self.kv_proj(memory).reshape((b, sk, 2, h, d)).transpose(
-            (2, 0, 3, 1, 4))
-        k, v = kv[0], kv[1]
+        # split (not tensor indexing) keeps this F-generic: the same code
+        # traces eagerly and symbolically (Symbol has no tensor indexing)
+        kv = self.kv_proj(memory).reshape((b, sk, 2, h, d))
+        k, v = F.split(kv, num_outputs=2, axis=2, squeeze_axis=True)
+        k = k.transpose((0, 2, 1, 3))  # (B, H, Sk, D)
+        v = v.transpose((0, 2, 1, 3))
         out = invoke_fn(lambda qq, kk, vv: plain_attention(qq, kk, vv),
                         [q, k, v])
         out = out.transpose((0, 2, 1, 3)).reshape((b, sq, u))
@@ -105,12 +108,21 @@ class Seq2SeqTransformer(HybridBlock):
     def encode(self, src):
         return self.encoder(self.src_embed(src))
 
-    def decode(self, tgt, memory):
-        from .. import ndarray as F
+    def decode(self, tgt, memory, dec_pos=None):
+        """``dec_pos`` is the decoder position table: threaded through as a
+        hybrid_forward param when tracing (symbolic or cached), fetched
+        concretely when called standalone (beam search)."""
+        from ..symbol.symbol import Symbol
 
+        if isinstance(tgt, Symbol):
+            from .. import symbol as F
+        else:
+            from .. import ndarray as F
         b, s = tgt.shape[0], tgt.shape[1]
         x = self.tgt_embed(tgt)
-        pos = self.dec_pos.data()[:s].reshape((1, s, self._units))
+        w = dec_pos if dec_pos is not None else self.dec_pos.data()
+        pos = F.slice_axis(w, axis=0, begin=0,
+                           end=s).reshape((1, s, self._units))
         x = x + pos
         if self._dropout:
             x = F.Dropout(x, p=self._dropout)
@@ -118,9 +130,9 @@ class Seq2SeqTransformer(HybridBlock):
             x = cell(x, memory)
         return self.out_proj(x)
 
-    def hybrid_forward(self, F, src, tgt, **params):
+    def hybrid_forward(self, F, src, tgt, dec_pos=None):
         memory = self.encode(src)
-        return self.decode(tgt, memory)
+        return self.decode(tgt, memory, dec_pos)
 
 
 def label_smoothing_loss(logits, labels, epsilon=0.1, ignore_index=None):

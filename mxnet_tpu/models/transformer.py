@@ -48,8 +48,13 @@ class MultiHeadAttention(HybridBlock):
         b, s, u = x.shape
         h, d = self._heads, self._units // self._heads
         qkv = self.qkv(x)  # (B, S, 3U)
-        qkv = qkv.reshape((b, s, 3, h, d)).transpose((2, 0, 3, 1, 4))  # (3,B,H,S,D)
-        q, k, v = qkv[0], qkv[1], qkv[2]
+        # split (not tensor indexing) keeps this F-generic: the same code
+        # traces eagerly and symbolically (Symbol has no tensor indexing)
+        qkv = qkv.reshape((b, s, 3, h, d))
+        q, k, v = F.split(qkv, num_outputs=3, axis=2, squeeze_axis=True)
+        q = q.transpose((0, 2, 1, 3))  # (B, H, S, D)
+        k = k.transpose((0, 2, 1, 3))
+        v = v.transpose((0, 2, 1, 3))
 
         from .. import parallel as par
         from ..ndarray.ndarray import invoke_fn
@@ -143,7 +148,8 @@ class BERTEncoder(HybridBlock):
 
     def hybrid_forward(self, F, x, position_weight, mask=None):
         b, s, u = x.shape
-        pos = position_weight[:s].reshape((1, s, u))
+        pos = F.slice_axis(position_weight, axis=0, begin=0,
+                           end=s).reshape((1, s, u))
         x = x + pos
         if self._dropout:
             x = F.Dropout(x, p=self._dropout)

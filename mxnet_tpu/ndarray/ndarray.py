@@ -542,7 +542,14 @@ def invoke(op: Any, inputs: Sequence[NDArray], kwargs: dict):
 
 def invoke_fn(fn, inputs: Sequence[NDArray], kwargs=None):
     """Invoke an ad-hoc pure function as if it were an op (used by __getitem__
-    and contrib paths)."""
+    and contrib paths). Dispatches on input type: with Symbol inputs the
+    function is spliced into the graph as one inline-OpDef node
+    (symbol.invoke_fn), so F-generic hybrid_forward code using this escape
+    hatch stays symbolically traceable."""
+    from ..symbol.symbol import Symbol, invoke_fn as _sym_invoke_fn
+
+    if any(isinstance(x, Symbol) for x in inputs):
+        return _sym_invoke_fn(fn, inputs, kwargs)
     opdef = OpDef("<lambda>", fn, num_outputs=1)
     return invoke(opdef, inputs, kwargs or {})
 

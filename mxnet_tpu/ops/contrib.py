@@ -24,7 +24,7 @@ __all__ = []
 # multibox_prior — anchor generation
 # ---------------------------------------------------------------------------
 
-@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior", "multibox_prior"])
+@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior", "multibox_prior"], ndarray_inputs=['data'])
 def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
                     offsets=(0.5, 0.5)):
     """data (B, C, H, W) → anchors (1, H*W*(S+R-1), 4) in ltrb [0,1] coords."""
@@ -76,7 +76,7 @@ def _iou_matrix(a, b):
 # ---------------------------------------------------------------------------
 
 @register("_contrib_MultiBoxTarget", aliases=["MultiBoxTarget", "multibox_target"],
-          num_outputs=3)
+          num_outputs=3, ndarray_inputs=['anchor', 'label', 'cls_pred'])
 def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                      ignore_label=-1.0, negative_mining_ratio=-1.0,
                      negative_mining_thresh=0.5, minimum_negative_samples=0,
@@ -145,7 +145,7 @@ def _greedy_nms_keep(boxes, scores, valid, thresh):
     return keep
 
 
-@register("_contrib_box_nms", aliases=["box_nms", "_contrib_box_non_maximum_suppression"])
+@register("_contrib_box_nms", aliases=["box_nms", "_contrib_box_non_maximum_suppression"], ndarray_inputs=['data'])
 def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
              score_index=1, id_index=-1, background_id=-1, force_suppress=False,
              in_format="corner", out_format="corner"):
@@ -198,7 +198,7 @@ def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
 # ---------------------------------------------------------------------------
 
 @register("_contrib_MultiBoxDetection", aliases=["MultiBoxDetection",
-                                                 "multibox_detection"])
+                                                 "multibox_detection"], ndarray_inputs=['cls_prob', 'loc_pred', 'anchor'])
 def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
                         background_id=0, nms_threshold=0.5, force_suppress=False,
                         variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
@@ -242,7 +242,7 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
 # ROIAlign
 # ---------------------------------------------------------------------------
 
-@register("_contrib_ROIAlign", aliases=["ROIAlign", "roi_align"])
+@register("_contrib_ROIAlign", aliases=["ROIAlign", "roi_align"], ndarray_inputs=['data', 'rois'])
 def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2,
                position_sensitive=False, aligned=False):
     """data (B,C,H,W); rois (R,5) [batch_idx, x1, y1, x2, y2] → (R,C,ph,pw)."""
@@ -295,7 +295,7 @@ def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=2
 # misc contrib
 # ---------------------------------------------------------------------------
 
-@register("_contrib_boolean_mask", aliases=["boolean_mask"])
+@register("_contrib_boolean_mask", aliases=["boolean_mask"], ndarray_inputs=['data', 'index'])
 def _boolean_mask(data, index, axis=0):
     """Dynamic-shape op in the reference; TPU version keeps static shape by
     compacting selected rows to the front and zero-padding the tail (callers
@@ -312,19 +312,19 @@ def _boolean_mask(data, index, axis=0):
     return jnp.where(keep, gathered, 0).astype(data.dtype)
 
 
-@register("_contrib_index_copy", aliases=["index_copy"])
+@register("_contrib_index_copy", aliases=["index_copy"], ndarray_inputs=['old_tensor', 'index_vector', 'new_tensor'])
 def _index_copy(old_tensor, index_vector, new_tensor):
     idx = index_vector.astype(jnp.int32)
     return old_tensor.at[idx].set(new_tensor)
 
 
-@register("_contrib_allclose", aliases=["allclose"], differentiable=False)
+@register("_contrib_allclose", aliases=["allclose"], differentiable=False, ndarray_inputs=['a', 'b'])
 def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
     return jnp.allclose(a, b, rtol=rtol, atol=atol,
                         equal_nan=equal_nan).astype(jnp.float32).reshape(1)
 
 
-@register("_contrib_arange_like", differentiable=False)
+@register("_contrib_arange_like", differentiable=False, ndarray_inputs=['data'])
 def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     if axis is None:
         n = data.size
@@ -334,7 +334,7 @@ def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     return start + step * jnp.arange(n, dtype=jnp.float32)
 
 
-@register("_contrib_div_sqrt_dim")
+@register("_contrib_div_sqrt_dim", ndarray_inputs=['data'])
 def _div_sqrt_dim(data):
     return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
 
@@ -353,7 +353,7 @@ def _sync_bn_n_out(kwargs):
 
 
 @register("_contrib_SyncBatchNorm", aliases=["SyncBatchNorm", "sync_batch_norm"],
-          num_outputs=_sync_bn_n_out)
+          num_outputs=_sync_bn_n_out, ndarray_inputs=['data', 'gamma', 'beta', 'moving_mean', 'moving_var'])
 def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                      momentum=0.9, fix_gamma=True, use_global_stats=False,
                      output_mean_var=False, axis=1, ndev=1, key=None,
@@ -476,7 +476,7 @@ def _cols_matmul(cols, weight, bias, no_bias, num_filter, num_group, dtype):
 
 
 @register("_contrib_DeformableConvolution",
-          aliases=["DeformableConvolution", "deformable_convolution"])
+          aliases=["DeformableConvolution", "deformable_convolution"], ndarray_inputs=['data', 'offset', 'weight', 'bias'])
 def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
                             stride=(1, 1), dilate=(1, 1), pad=(0, 0),
                             num_filter=1, num_group=1, num_deformable_group=1,
@@ -490,7 +490,7 @@ def _deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
 
 
 @register("_contrib_ModulatedDeformableConvolution",
-          aliases=["ModulatedDeformableConvolution"])
+          aliases=["ModulatedDeformableConvolution"], ndarray_inputs=['data', 'offset', 'mask', 'weight', 'bias'])
 def _modulated_deformable_convolution(data, offset, mask, weight, bias=None,
                                       kernel=(3, 3), stride=(1, 1),
                                       dilate=(1, 1), pad=(0, 0), num_filter=1,
@@ -527,7 +527,7 @@ def _split_selfatt(qkv, heads):
 
 
 @register("_contrib_interleaved_matmul_selfatt_qk",
-          aliases=["interleaved_matmul_selfatt_qk"])
+          aliases=["interleaved_matmul_selfatt_qk"], ndarray_inputs=['queries_keys_values'])
 def _interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
     """(S, B, H*3*hd) → scaled q·kᵀ (B*H, S, S)."""
     q, k, _ = _split_selfatt(queries_keys_values, int(heads))
@@ -538,7 +538,7 @@ def _interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
 
 
 @register("_contrib_interleaved_matmul_selfatt_valatt",
-          aliases=["interleaved_matmul_selfatt_valatt"])
+          aliases=["interleaved_matmul_selfatt_valatt"], ndarray_inputs=['queries_keys_values', 'attention'])
 def _interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
     """attention (B*H, S, S) × v → (S, B, H*hd)."""
     _, _, v = _split_selfatt(queries_keys_values, int(heads))
@@ -560,7 +560,7 @@ def _split_kv(kv, heads):
 
 
 @register("_contrib_interleaved_matmul_encdec_qk",
-          aliases=["interleaved_matmul_encdec_qk"])
+          aliases=["interleaved_matmul_encdec_qk"], ndarray_inputs=['queries', 'keys_values'])
 def _interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
     """queries (Sq, B, H*hd); keys_values (Sk, B, H*2*hd) → (B*H, Sq, Sk)."""
     sq, b, e = queries.shape
@@ -576,7 +576,7 @@ def _interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
 
 
 @register("_contrib_interleaved_matmul_encdec_valatt",
-          aliases=["interleaved_matmul_encdec_valatt"])
+          aliases=["interleaved_matmul_encdec_valatt"], ndarray_inputs=['keys_values', 'attention'])
 def _interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
     _, v = _split_kv(keys_values, int(heads))
     out = jnp.einsum("nqk,nkd->nqd", attention.astype(v.dtype), v,
@@ -592,7 +592,7 @@ def _interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
 # contrib/adaptive_avg_pooling.* — TBV)
 # ---------------------------------------------------------------------------
 
-@register("_contrib_BilinearResize2D", aliases=["BilinearResize2D"])
+@register("_contrib_BilinearResize2D", aliases=["BilinearResize2D"], ndarray_inputs=['data'])
 def _bilinear_resize_2d(data, like=None, height=0, width=0, scale_height=None,
                         scale_width=None, mode="size"):
     B, C, H, W = data.shape
@@ -608,7 +608,7 @@ def _bilinear_resize_2d(data, like=None, height=0, width=0, scale_height=None,
     return out.astype(data.dtype)
 
 
-@register("_contrib_AdaptiveAvgPooling2D", aliases=["AdaptiveAvgPooling2D"])
+@register("_contrib_AdaptiveAvgPooling2D", aliases=["AdaptiveAvgPooling2D"], ndarray_inputs=['data'])
 def _adaptive_avg_pooling_2d(data, output_size=None):
     B, C, H, W = data.shape
     if output_size is None or output_size == ():
@@ -634,13 +634,13 @@ def _adaptive_avg_pooling_2d(data, output_size=None):
     return out.astype(data.dtype)
 
 
-@register("_contrib_quadratic", aliases=["quadratic"])
+@register("_contrib_quadratic", aliases=["quadratic"], ndarray_inputs=['data', 'a', 'b'])
 def _quadratic(data, a=0.0, b=0.0, c=0.0):
     """The reference's tutorial op (contrib/quadratic_op.* — TBV)."""
     return a * jnp.square(data) + b * data + c
 
 
-@register("_contrib_gradientmultiplier", aliases=["gradientmultiplier"])
+@register("_contrib_gradientmultiplier", aliases=["gradientmultiplier"], ndarray_inputs=['data'])
 def _gradientmultiplier(data, scalar=1.0):
     """Identity forward, grad scaled by ``scalar`` (gradient reversal when
     negative — contrib/gradient_multiplier_op.* TBV)."""
@@ -655,7 +655,7 @@ def _gradientmultiplier(data, scalar=1.0):
     return f(data)
 
 
-@register("_contrib_getnnz", differentiable=False)
+@register("_contrib_getnnz", differentiable=False, ndarray_inputs=['data'])
 def _getnnz(data, axis=None):
     nz = (data != 0)
     if axis is None:
@@ -663,6 +663,6 @@ def _getnnz(data, axis=None):
     return jnp.sum(nz, axis=int(axis)).astype(jnp.int64)
 
 
-@register("_contrib_dynamic_reshape")
+@register("_contrib_dynamic_reshape", ndarray_inputs=['data', 'shape_like'])
 def _dynamic_reshape(data, shape_like):
     return data.reshape(shape_like.shape)

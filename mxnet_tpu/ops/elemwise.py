@@ -72,22 +72,28 @@ _UNARY = {
     "isfinite": jnp.isfinite,
 }
 
+# analyzer tags: exp/log feed the numerics lint rules (log-of-softmax,
+# exp-on-raw-input); log1p is the stabilized form, deliberately untagged
+_UNARY_TAGS = {"exp": ("exp",), "log": ("log",), "log10": ("log",),
+               "log2": ("log",)}
+
 for _name, _f in _UNARY.items():
     if _name == "tanh_":
         continue
-    register(_name)(_f)
+    register(_name, ndarray_inputs=["x"],
+             tags=_UNARY_TAGS.get(_name, ()))(_f)
 
 alias("abs", "_abs")
 alias("negative", "_np_negative")
 
 
-@register("softrelu")
+@register("softrelu", ndarray_inputs=['x'])
 def _softrelu(x):
     # log(1+exp(x)), numerically stable
     return jnp.logaddexp(x, 0.0)
 
 
-@register("gelu", aliases=["_npx_gelu"])
+@register("gelu", aliases=["_npx_gelu"], ndarray_inputs=['x'])
 def _gelu(x, approximation="erf"):
     if approximation == "tanh":
         c = 0.7978845608028654  # sqrt(2/pi)
@@ -95,27 +101,27 @@ def _gelu(x, approximation="erf"):
     return 0.5 * x * (1.0 + lax.erf(x / 1.4142135623730951))
 
 
-@register("silu")
+@register("silu", ndarray_inputs=['x'])
 def _silu(x):
     return x * (1 / (1 + jnp.exp(-x)))
 
 
-@register("log_sigmoid")
+@register("log_sigmoid", ndarray_inputs=['x'])
 def _log_sigmoid(x):
     return -jnp.logaddexp(0.0, -x)
 
 
-@register("mish")
+@register("mish", ndarray_inputs=['x'])
 def _mish(x):
     return x * jnp.tanh(jnp.logaddexp(x, 0.0))
 
 
-@register("clip")
+@register("clip", ndarray_inputs=['data'])
 def _clip(data, a_min=None, a_max=None):
     return jnp.clip(data, a_min, a_max)
 
 
-@register("smooth_l1")
+@register("smooth_l1", ndarray_inputs=['data'])
 def _smooth_l1(data, scalar=1.0):
     # reference src/operator/tensor/elemwise_unary_op (smooth_l1, sigma=scalar)
     s2 = scalar * scalar
@@ -123,21 +129,21 @@ def _smooth_l1(data, scalar=1.0):
     return jnp.where(a < 1.0 / s2, 0.5 * s2 * data * data, a - 0.5 / s2)
 
 
-@register("Cast", aliases=["cast"])
+@register("Cast", aliases=["cast"], ndarray_inputs=['data'])
 def _cast(data, dtype="float32"):
     from ..base import dtype_np
 
     return data.astype(dtype_np(dtype))
 
 
-@register("amp_cast")
+@register("amp_cast", ndarray_inputs=['data'])
 def _amp_cast(data, dtype="float32"):
     from ..base import dtype_np
 
     return data.astype(dtype_np(dtype))
 
 
-@register("amp_multicast", num_outputs=lambda kw: int(kw.get("num_outputs", 1)))
+@register("amp_multicast", num_outputs=lambda kw: int(kw.get("num_outputs", 1)), ndarray_inputs="*")
 def _amp_multicast(*data, num_outputs=None, cast_narrow=False):
     dts = [d.dtype for d in data]
     widest = jnp.result_type(*dts) if not cast_narrow else min(dts, key=lambda d: jnp.dtype(d).itemsize)
@@ -145,37 +151,37 @@ def _amp_multicast(*data, num_outputs=None, cast_narrow=False):
     return out if len(out) > 1 else out[0]
 
 
-@register("zeros_like")
+@register("zeros_like", ndarray_inputs=['data'])
 def _zeros_like(data):
     return jnp.zeros_like(data)
 
 
-@register("ones_like")
+@register("ones_like", ndarray_inputs=['data'])
 def _ones_like(data):
     return jnp.ones_like(data)
 
 
-@register("shape_array", differentiable=False)
+@register("shape_array", differentiable=False, ndarray_inputs=['data'])
 def _shape_array(data):
     return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
 
 
-@register("size_array", differentiable=False)
+@register("size_array", differentiable=False, ndarray_inputs=['data'])
 def _size_array(data):
     return jnp.asarray([data.size], dtype=jnp.int32)
 
 
-@register("BlockGrad", aliases=["stop_gradient"])
+@register("BlockGrad", aliases=["stop_gradient"], ndarray_inputs=['data'])
 def _block_grad(data):
     return lax.stop_gradient(data)
 
 
-@register("identity", aliases=["_copy"])
+@register("identity", aliases=["_copy"], ndarray_inputs=['data'])
 def _identity(data):
     return data
 
 
-@register("MakeLoss", aliases=["make_loss"])
+@register("MakeLoss", aliases=["make_loss"], ndarray_inputs=['data'])
 def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
     # Forward is identity; grad_scale is applied by autograd via custom vjp-free
     # scaling: we fold it into the forward with stop_gradient trickery.
@@ -210,7 +216,7 @@ _BINARY = {
 }
 
 for _name, _f in _BINARY.items():
-    register("broadcast_" + _name)(_f)
+    register("broadcast_" + _name, ndarray_inputs=["a", "b"])(_f)
 
 # elemwise_* variants require same shape in the reference; broadcasting is a
 # superset, so they share implementations.
@@ -234,7 +240,7 @@ alias("broadcast_logical_xor", "_logical_xor")
 alias("broadcast_hypot", "_hypot")
 
 
-@register("_scatter_elemwise_div")
+@register("_scatter_elemwise_div", ndarray_inputs=['lhs', 'rhs'])
 def _scatter_div(lhs, rhs):
     return lhs / rhs
 
@@ -277,4 +283,4 @@ def _make_scalar_op(f):
 
 
 for _name, _f in _SCALAR.items():
-    register(_name)(_make_scalar_op(_f))
+    register(_name, ndarray_inputs=["data"])(_make_scalar_op(_f))

@@ -29,7 +29,7 @@ def _key():
     return next_key()
 
 
-@register("_image_to_tensor", aliases=["image_to_tensor"])
+@register("_image_to_tensor", aliases=["image_to_tensor"], ndarray_inputs=['data'])
 def _to_tensor(data):
     """uint8 HWC [0,255] → float32 CHW [0,1] (batched: NHWC→NCHW)."""
     x = data.astype(jnp.float32) / 255.0
@@ -38,7 +38,7 @@ def _to_tensor(data):
     return jnp.transpose(x, (2, 0, 1))
 
 
-@register("_image_normalize", aliases=["image_normalize"])
+@register("_image_normalize", aliases=["image_normalize"], ndarray_inputs=['data', 'mean'])
 def _normalize(data, mean=0.0, std=1.0):
     """CHW (or NCHW) float input; mean/std per-channel sequences."""
     mean = jnp.asarray(mean, data.dtype)
@@ -49,31 +49,31 @@ def _normalize(data, mean=0.0, std=1.0):
     return (data - mean.reshape(shape)) / std.reshape(shape)
 
 
-@register("_image_flip_left_right", aliases=["image_flip_left_right"])
+@register("_image_flip_left_right", aliases=["image_flip_left_right"], ndarray_inputs=['data'])
 def _flip_lr(data):
     return jnp.flip(data, axis=_hwc_axes(data)[1])
 
 
-@register("_image_flip_top_bottom", aliases=["image_flip_top_bottom"])
+@register("_image_flip_top_bottom", aliases=["image_flip_top_bottom"], ndarray_inputs=['data'])
 def _flip_tb(data):
     return jnp.flip(data, axis=_hwc_axes(data)[0])
 
 
 @register("_image_random_flip_left_right",
-          aliases=["image_random_flip_left_right"])
+          aliases=["image_random_flip_left_right"], ndarray_inputs=['data'])
 def _random_flip_lr(data, p=0.5):
     coin = jax.random.bernoulli(_key(), p)
     return jnp.where(coin, _flip_lr(data), data)
 
 
 @register("_image_random_flip_top_bottom",
-          aliases=["image_random_flip_top_bottom"])
+          aliases=["image_random_flip_top_bottom"], ndarray_inputs=['data'])
 def _random_flip_tb(data, p=0.5):
     coin = jax.random.bernoulli(_key(), p)
     return jnp.where(coin, _flip_tb(data), data)
 
 
-@register("_image_resize", aliases=["image_resize"])
+@register("_image_resize", aliases=["image_resize"], ndarray_inputs=['data'])
 def _resize(data, size=0, keep_ratio=False, interp=1):
     ha, wa, _ = _hwc_axes(data)
     h, w = data.shape[ha], data.shape[wa]
@@ -94,12 +94,14 @@ def _resize(data, size=0, keep_ratio=False, interp=1):
         .astype(data.dtype)
 
 
-@register("_image_crop", aliases=["image_crop"])
+@register("_image_crop", aliases=["image_crop"], ndarray_inputs=['data', 'x', 'y'])
 def _crop(data, x=0, y=0, width=1, height=1):
+    # x/y are host ints by contract (slice bounds must be concrete; the
+    # reference API passes python ints) — not traced tensors
     ha, wa, _ = _hwc_axes(data)
     idx = [slice(None)] * data.ndim
-    idx[ha] = slice(int(y), int(y) + int(height))
-    idx[wa] = slice(int(x), int(x) + int(width))
+    idx[ha] = slice(int(y), int(y) + int(height))  # lint: disable=host-call-in-op
+    idx[wa] = slice(int(x), int(x) + int(width))  # lint: disable=host-call-in-op
     return data[tuple(idx)]
 
 
@@ -108,7 +110,7 @@ def _blend(a, b, factor):
             + b * (1.0 - factor)).astype(a.dtype)
 
 
-@register("_image_random_brightness", aliases=["image_random_brightness"])
+@register("_image_random_brightness", aliases=["image_random_brightness"], ndarray_inputs=['data'])
 def _random_brightness(data, min_factor=0.0, max_factor=0.0):
     f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
                            float(max_factor))
@@ -125,7 +127,7 @@ def _grayscale(data):
     return g
 
 
-@register("_image_random_contrast", aliases=["image_random_contrast"])
+@register("_image_random_contrast", aliases=["image_random_contrast"], ndarray_inputs=['data'])
 def _random_contrast(data, min_factor=0.0, max_factor=0.0):
     f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
                            float(max_factor))
@@ -133,14 +135,14 @@ def _random_contrast(data, min_factor=0.0, max_factor=0.0):
     return _blend(data, mean, f)
 
 
-@register("_image_random_saturation", aliases=["image_random_saturation"])
+@register("_image_random_saturation", aliases=["image_random_saturation"], ndarray_inputs=['data'])
 def _random_saturation(data, min_factor=0.0, max_factor=0.0):
     f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
                            float(max_factor))
     return _blend(data, _grayscale(data), f)
 
 
-@register("_image_random_hue", aliases=["image_random_hue"])
+@register("_image_random_hue", aliases=["image_random_hue"], ndarray_inputs=['data'])
 def _random_hue(data, min_factor=0.0, max_factor=0.0):
     """YIQ-rotation hue shift (the reference's image_random.cc recipe)."""
     f = jax.random.uniform(_key(), (), jnp.float32, float(min_factor),
@@ -161,7 +163,7 @@ def _random_hue(data, min_factor=0.0, max_factor=0.0):
     return jnp.moveaxis(out, -1, ca).astype(data.dtype)
 
 
-@register("_image_random_color_jitter", aliases=["image_random_color_jitter"])
+@register("_image_random_color_jitter", aliases=["image_random_color_jitter"], ndarray_inputs=['data'])
 def _random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
                          hue=0.0):
     if brightness:
@@ -185,7 +187,7 @@ _EIGVEC = _np.asarray([[-0.5675, 0.7192, 0.4009],
                        [-0.5836, -0.6948, 0.4203]], _np.float32)
 
 
-@register("_image_adjust_lighting", aliases=["image_adjust_lighting"])
+@register("_image_adjust_lighting", aliases=["image_adjust_lighting"], ndarray_inputs=['data'])
 def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
     """AlexNet-style PCA lighting with fixed alpha (reference convention:
     RGB channel shift = eigvec @ (eigval * alpha))."""
@@ -198,7 +200,7 @@ def _adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
             + shift.reshape(shape)).astype(data.dtype)
 
 
-@register("_image_random_lighting", aliases=["image_random_lighting"])
+@register("_image_random_lighting", aliases=["image_random_lighting"], ndarray_inputs=['data'])
 def _random_lighting(data, alpha_std=0.05):
     alpha = jax.random.normal(_key(), (3,), jnp.float32) * alpha_std
     return _adjust_lighting(data, alpha)

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from .registry import register
 
 
-@register("take")
+@register("take", ndarray_inputs=['a', 'indices'])
 def _take(a, indices, axis=0, mode="clip"):
     idx = indices.astype(jnp.int32)
     ax = int(axis)
@@ -23,13 +23,13 @@ def _take(a, indices, axis=0, mode="clip"):
     return jnp.take(a, idx, axis=ax)
 
 
-@register("Embedding")
+@register("Embedding", ndarray_inputs=['data', 'weight'])
 def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
     idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
     return jnp.take(weight, idx, axis=0)
 
 
-@register("one_hot", differentiable=False)
+@register("one_hot", differentiable=False, ndarray_inputs=['indices'])
 def _one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
     from ..base import dtype_np
 
@@ -39,7 +39,7 @@ def _one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
     return jnp.where(oh, on_value, off_value).astype(dtype_np(dtype))
 
 
-@register("pick")
+@register("pick", ndarray_inputs=['data', 'index'])
 def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
     ax = int(axis) % data.ndim
     idx = index.astype(jnp.int32)
@@ -51,7 +51,7 @@ def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
     return picked if keepdims else jnp.squeeze(picked, axis=ax)
 
 
-@register("gather_nd")
+@register("gather_nd", ndarray_inputs=['data', 'indices'])
 def _gather_nd(data, indices):
     # indices: (M, ...) — first axis indexes the leading M dims of data
     idx = indices.astype(jnp.int32)
@@ -59,7 +59,7 @@ def _gather_nd(data, indices):
     return data[tuple(idx[i] for i in range(m))]
 
 
-@register("scatter_nd")
+@register("scatter_nd", ndarray_inputs=['data', 'indices'])
 def _scatter_nd(data, indices, shape=()):
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]
@@ -67,14 +67,14 @@ def _scatter_nd(data, indices, shape=()):
     return out.at[tuple(idx[i] for i in range(m))].set(data)
 
 
-@register("_scatter_set_nd")
+@register("_scatter_set_nd", ndarray_inputs=['lhs', 'rhs', 'indices'])
 def _scatter_set_nd(lhs, rhs, indices, shape=()):
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]
     return lhs.at[tuple(idx[i] for i in range(m))].set(rhs)
 
 
-@register("_backward_gather_nd", aliases=["gather_nd_grad"])
+@register("_backward_gather_nd", aliases=["gather_nd_grad"], ndarray_inputs=['data', 'indices'])
 def _gather_nd_accumulate(data, indices, shape=()):
     idx = indices.astype(jnp.int32)
     m = idx.shape[0]
@@ -82,27 +82,27 @@ def _gather_nd_accumulate(data, indices, shape=()):
     return out.at[tuple(idx[i] for i in range(m))].add(data)
 
 
-@register("take_along_axis")
+@register("take_along_axis", ndarray_inputs=['data', 'indices'])
 def _take_along_axis(data, indices, axis=0):
     return jnp.take_along_axis(data, indices.astype(jnp.int32), axis=int(axis))
 
 
-@register("_contrib_boolean_mask", aliases=["boolean_mask"], differentiable=False)
+@register("_contrib_boolean_mask", aliases=["boolean_mask"], differentiable=False, ndarray_inputs=['data', 'index'])
 def _boolean_mask(data, index, axis=0):
     # Data-dependent output shape: returns padded-to-count semantics is not
     # possible eagerly-traced; eager path computes concretely (host sync).
     import numpy as np
 
-    mask = np.asarray(index) != 0
+    mask = np.asarray(index) != 0  # lint: disable=host-call-in-op
     return jnp.compress(mask, data, axis=int(axis))
 
 
-@register("_contrib_index_copy")
+@register("_contrib_index_copy", ndarray_inputs=['old', 'index', 'new'])
 def _index_copy(old, index, new):
     return old.at[index.astype(jnp.int32)].set(new)
 
 
-@register("_contrib_index_array", differentiable=False)
+@register("_contrib_index_array", differentiable=False, ndarray_inputs=['data'])
 def _index_array(data, axes=None):
     shape = data.shape
     axes = tuple(axes) if axes is not None else tuple(range(len(shape)))
@@ -110,6 +110,6 @@ def _index_array(data, axes=None):
     return jnp.stack(grids, axis=-1).astype(jnp.int64 if False else jnp.int32)
 
 
-@register("_contrib_allclose", differentiable=False)
+@register("_contrib_allclose", differentiable=False, ndarray_inputs=['a', 'b'])
 def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
     return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=bool(equal_nan)).astype(jnp.float32).reshape(1)

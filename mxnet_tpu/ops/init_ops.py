@@ -11,22 +11,22 @@ from ..base import dtype_np
 from .registry import register
 
 
-@register("_zeros", aliases=["zeros"])
+@register("_zeros", aliases=["zeros"], ndarray_inputs=[])
 def _zeros(shape=(), dtype="float32", ctx=None):
     return jnp.zeros(shape, dtype=dtype_np(dtype))
 
 
-@register("_ones", aliases=["ones"])
+@register("_ones", aliases=["ones"], ndarray_inputs=[])
 def _ones(shape=(), dtype="float32", ctx=None):
     return jnp.ones(shape, dtype=dtype_np(dtype))
 
 
-@register("_full", aliases=["full"])
+@register("_full", aliases=["full"], ndarray_inputs=[])
 def _full(shape=(), value=0.0, dtype="float32", ctx=None):
     return jnp.full(shape, value, dtype=dtype_np(dtype))
 
 
-@register("_arange", aliases=["arange"])
+@register("_arange", aliases=["arange"], ndarray_inputs=[])
 def _arange(start=0, stop=None, step=1.0, repeat=1, infer_range=False, dtype="float32", ctx=None):
     r = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
     if int(repeat) > 1:
@@ -34,11 +34,11 @@ def _arange(start=0, stop=None, step=1.0, repeat=1, infer_range=False, dtype="fl
     return r
 
 
-@register("_linspace", aliases=["linspace"])
+@register("_linspace", aliases=["linspace"], ndarray_inputs=[])
 def _linspace(start=0, stop=1, num=50, endpoint=True, dtype="float32", ctx=None):
     return jnp.linspace(start, stop, int(num), endpoint=bool(endpoint), dtype=dtype_np(dtype))
 
 
-@register("_eye", aliases=["eye"])
+@register("_eye", aliases=["eye"], ndarray_inputs=[])
 def _eye(N=0, M=0, k=0, dtype="float32", ctx=None):
     return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=dtype_np(dtype))

@@ -71,7 +71,7 @@ def _ctc_n_out(kwargs):
 
 
 @register("ctc_loss", aliases=["CTCLoss", "_contrib_ctc_loss", "_contrib_CTCLoss"],
-          num_outputs=_ctc_n_out)
+          num_outputs=_ctc_n_out, ndarray_inputs=['data', 'label'])
 def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
               use_data_lengths=False, use_label_lengths=False,
               blank_label="first", _pad_value=0):

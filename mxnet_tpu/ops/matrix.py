@@ -74,7 +74,7 @@ def _infer_reshape(data_shape, shape, reverse=False):
     return tuple(out)
 
 
-@register("Reshape", aliases=["reshape"])
+@register("Reshape", aliases=["reshape"], ndarray_inputs=['data'])
 def _reshape(data, shape=None, reverse=False, target_shape=None, keep_highest=False):
     if shape is None and target_shape is not None:  # legacy param
         shape = target_shape
@@ -82,24 +82,24 @@ def _reshape(data, shape=None, reverse=False, target_shape=None, keep_highest=Fa
     return jnp.reshape(data, new_shape)
 
 
-@register("Flatten", aliases=["flatten"])
+@register("Flatten", aliases=["flatten"], ndarray_inputs=['data'])
 def _flatten(data):
     return jnp.reshape(data, (data.shape[0], -1))
 
 
-@register("transpose")
+@register("transpose", ndarray_inputs=['data'])
 def _transpose(data, axes=None):
     if axes is None or axes == ():
         axes = tuple(reversed(range(data.ndim)))
     return jnp.transpose(data, axes)
 
 
-@register("expand_dims")
+@register("expand_dims", ndarray_inputs=['data'])
 def _expand_dims(data, axis=0):
     return jnp.expand_dims(data, int(axis))
 
 
-@register("squeeze")
+@register("squeeze", ndarray_inputs=['data'])
 def _squeeze(data, axis=None):
     if axis is None:
         return jnp.squeeze(data)
@@ -107,28 +107,28 @@ def _squeeze(data, axis=None):
     return jnp.squeeze(data, axis=axis)
 
 
-@register("swapaxes", aliases=["SwapAxis"])
+@register("swapaxes", aliases=["SwapAxis"], ndarray_inputs=['data'])
 def _swapaxes(data, dim1=0, dim2=0):
     return jnp.swapaxes(data, int(dim1), int(dim2))
 
 
-@register("flip", aliases=["reverse"])
+@register("flip", aliases=["reverse"], ndarray_inputs=['data'])
 def _flip(data, axis=()):
     axis = (axis,) if isinstance(axis, int) else tuple(axis)
     return jnp.flip(data, axis=axis)
 
 
-@register("tile")
+@register("tile", ndarray_inputs=['data'])
 def _tile(data, reps=()):
     return jnp.tile(data, tuple(reps))
 
 
-@register("repeat")
+@register("repeat", ndarray_inputs=['data'])
 def _repeat(data, repeats=1, axis=None):
     return jnp.repeat(data, int(repeats), axis=None if axis is None else int(axis))
 
 
-@register("Pad", aliases=["pad"])
+@register("Pad", aliases=["pad"], ndarray_inputs=['data'])
 def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
     pw = tuple(pad_width)
     pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
@@ -138,12 +138,12 @@ def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
     return jnp.pad(data, pairs, mode=jmode)
 
 
-@register("Concat", aliases=["concat"])
+@register("Concat", aliases=["concat"], ndarray_inputs="*")
 def _concat(*data, dim=1, num_args=None):
     return jnp.concatenate(data, axis=int(dim))
 
 
-@register("stack")
+@register("stack", ndarray_inputs="*")
 def _stack(*data, axis=0, num_args=None):
     return jnp.stack(data, axis=int(axis))
 
@@ -153,7 +153,7 @@ def _split_n_out(kw):
     return 1 if kw.get("squeeze_axis") and n == 1 else n
 
 
-@register("SliceChannel", aliases=["split"], num_outputs=lambda kw: int(kw.get("num_outputs", 1)))
+@register("SliceChannel", aliases=["split"], num_outputs=lambda kw: int(kw.get("num_outputs", 1)), ndarray_inputs=['data'])
 def _split(data, num_outputs=1, axis=1, squeeze_axis=False):
     axis = int(axis)
     parts = jnp.split(data, int(num_outputs), axis=axis)
@@ -162,7 +162,7 @@ def _split(data, num_outputs=1, axis=1, squeeze_axis=False):
     return tuple(parts) if len(parts) > 1 else parts[0]
 
 
-@register("split_v2", num_outputs=lambda kw: _split_v2_n(kw))
+@register("split_v2", num_outputs=lambda kw: _split_v2_n(kw), ndarray_inputs=['data', 'indices'])
 def _split_v2(data, indices=(), axis=1, squeeze_axis=False, sections=0):
     axis = int(axis)
     if sections:
@@ -180,7 +180,7 @@ def _split_v2_n(kw):
     return len(tuple(kw.get("indices", ()))) + 1
 
 
-@register("slice", aliases=["crop"])
+@register("slice", aliases=["crop"], ndarray_inputs=['data'])
 def _slice(data, begin=(), end=(), step=None):
     ndim = data.ndim
     begin = tuple(begin) + (None,) * (ndim - len(begin))
@@ -190,14 +190,14 @@ def _slice(data, begin=(), end=(), step=None):
     return data[idx]
 
 
-@register("slice_axis")
+@register("slice_axis", ndarray_inputs=['data'])
 def _slice_axis(data, axis=0, begin=0, end=None):
     idx = [slice(None)] * data.ndim
     idx[int(axis)] = slice(begin, end)
     return data[tuple(idx)]
 
 
-@register("slice_like")
+@register("slice_like", ndarray_inputs=['data', 'shape_like'])
 def _slice_like(data, shape_like, axes=()):
     axes = tuple(axes) if axes else tuple(range(min(data.ndim, shape_like.ndim)))
     idx = [slice(None)] * data.ndim
@@ -206,19 +206,19 @@ def _slice_like(data, shape_like, axes=()):
     return data[tuple(idx)]
 
 
-@register("where")
+@register("where", ndarray_inputs=['condition', 'x', 'y'])
 def _where(condition, x, y):
     return jnp.where(condition != 0, x, y)
 
 
-@register("diag")
+@register("diag", ndarray_inputs=['data'])
 def _diag(data, k=0, axis1=0, axis2=1):
     if data.ndim == 1:
         return jnp.diag(data, k=int(k))
     return jnp.diagonal(data, offset=int(k), axis1=int(axis1), axis2=int(axis2))
 
 
-@register("depth_to_space")
+@register("depth_to_space", ndarray_inputs=['data'])
 def _depth_to_space(data, block_size=1):
     b = int(block_size)
     n, c, h, w = data.shape
@@ -227,7 +227,7 @@ def _depth_to_space(data, block_size=1):
     return x.reshape(n, c // (b * b), h * b, w * b)
 
 
-@register("space_to_depth")
+@register("space_to_depth", ndarray_inputs=['data'])
 def _space_to_depth(data, block_size=1):
     b = int(block_size)
     n, c, h, w = data.shape
@@ -241,7 +241,7 @@ def _space_to_depth(data, block_size=1):
 # fp32 uses default XLA precision (can be raised via jax.default_matmul_precision).
 # ---------------------------------------------------------------------------
 
-@register("dot")
+@register("dot", ndarray_inputs=['lhs', 'rhs'])
 def _dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
     a = lhs.T if transpose_a else lhs
     b = rhs.T if transpose_b else rhs
@@ -251,7 +251,7 @@ def _dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
     return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
 
 
-@register("batch_dot")
+@register("batch_dot", ndarray_inputs=['lhs', 'rhs'])
 def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
     a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
     b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
@@ -259,27 +259,27 @@ def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=Non
 
 
 # linalg subset (reference tensor/la_op*, TBV)
-@register("_linalg_gemm2", aliases=["linalg_gemm2"])
+@register("_linalg_gemm2", aliases=["linalg_gemm2"], ndarray_inputs=['A', 'B'])
 def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * jnp.matmul(a, b)
 
 
-@register("_linalg_gemm", aliases=["linalg_gemm"])
+@register("_linalg_gemm", aliases=["linalg_gemm"], ndarray_inputs=['A', 'B', 'C'])
 def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2):
     a = jnp.swapaxes(A, -1, -2) if transpose_a else A
     b = jnp.swapaxes(B, -1, -2) if transpose_b else B
     return alpha * jnp.matmul(a, b) + beta * C
 
 
-@register("_linalg_potrf", aliases=["linalg_potrf"])
+@register("_linalg_potrf", aliases=["linalg_potrf"], ndarray_inputs=['A'])
 def _linalg_potrf(A, lower=True):
     L = jnp.linalg.cholesky(A)
     return L if lower else jnp.swapaxes(L, -1, -2)
 
 
-@register("_linalg_trsm", aliases=["linalg_trsm"])
+@register("_linalg_trsm", aliases=["linalg_trsm"], ndarray_inputs=['A', 'B'])
 def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     from jax.scipy.linalg import solve_triangular
 
@@ -291,13 +291,13 @@ def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     return solve_triangular(a, alpha * B, lower=low)
 
 
-@register("_linalg_syrk", aliases=["linalg_syrk"])
+@register("_linalg_syrk", aliases=["linalg_syrk"], ndarray_inputs=['A'])
 def _linalg_syrk(A, transpose=False, alpha=1.0):
     a = jnp.swapaxes(A, -1, -2) if transpose else A
     return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
 
 
-@register("khatri_rao")
+@register("khatri_rao", ndarray_inputs="*")
 def _khatri_rao(*args):
     out = args[0]
     for m in args[1:]:
@@ -305,7 +305,7 @@ def _khatri_rao(*args):
     return out
 
 
-@register("moments", num_outputs=2)
+@register("moments", num_outputs=2, ndarray_inputs=['data'])
 def _moments(data, axes=None, keepdims=False):
     axes = tuple(axes) if axes is not None else None
     mean = jnp.mean(data, axis=axes, keepdims=bool(keepdims))
@@ -313,7 +313,7 @@ def _moments(data, axes=None, keepdims=False):
     return mean, var
 
 
-@register("histogram", num_outputs=2, differentiable=False)
+@register("histogram", num_outputs=2, differentiable=False, ndarray_inputs=['data'])
 def _histogram(data, bins=None, bin_cnt=None, range=None):
     if bin_cnt is not None:
         cnt, edges = jnp.histogram(data.reshape(-1), bins=int(bin_cnt), range=tuple(range))
@@ -322,23 +322,23 @@ def _histogram(data, bins=None, bin_cnt=None, range=None):
     return cnt, edges
 
 
-@register("_linalg_det", aliases=["linalg_det"])
+@register("_linalg_det", aliases=["linalg_det"], ndarray_inputs=['A'])
 def _linalg_det(A):
     return jnp.linalg.det(A)
 
 
-@register("_linalg_slogdet", aliases=["linalg_slogdet"], num_outputs=2)
+@register("_linalg_slogdet", aliases=["linalg_slogdet"], num_outputs=2, ndarray_inputs=['A'])
 def _linalg_slogdet(A):
     sign, logabsdet = jnp.linalg.slogdet(A)
     return sign, logabsdet
 
 
-@register("_linalg_inverse", aliases=["linalg_inverse"])
+@register("_linalg_inverse", aliases=["linalg_inverse"], ndarray_inputs=['A'])
 def _linalg_inverse(A):
     return jnp.linalg.inv(A)
 
 
-@register("_linalg_trmm", aliases=["linalg_trmm"])
+@register("_linalg_trmm", aliases=["linalg_trmm"], ndarray_inputs=['A', 'B'])
 def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     tri = jnp.tril(A) if lower else jnp.triu(A)
     if transpose:
@@ -347,12 +347,12 @@ def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
     return alpha * out
 
 
-@register("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"], ndarray_inputs=['A'])
 def _linalg_extractdiag(A, offset=0):
     return jnp.diagonal(A, offset=int(offset), axis1=-2, axis2=-1)
 
 
-@register("_linalg_makediag", aliases=["linalg_makediag"])
+@register("_linalg_makediag", aliases=["linalg_makediag"], ndarray_inputs=['A'])
 def _linalg_makediag(A, offset=0):
     def one(v):
         return jnp.diag(v, k=int(offset))
@@ -373,13 +373,13 @@ def _trian_indices(n, offset, lower):
     return jnp.tril_indices(n) if lower else jnp.triu_indices(n)
 
 
-@register("_linalg_extracttrian", aliases=["linalg_extracttrian"])
+@register("_linalg_extracttrian", aliases=["linalg_extracttrian"], ndarray_inputs=['A'])
 def _linalg_extracttrian(A, offset=0, lower=True):
     rows, cols = _trian_indices(A.shape[-1], offset, lower)
     return A[..., rows, cols]
 
 
-@register("_linalg_maketrian", aliases=["linalg_maketrian"])
+@register("_linalg_maketrian", aliases=["linalg_maketrian"], ndarray_inputs=['A'])
 def _linalg_maketrian(A, offset=0, lower=True):
     m = A.shape[-1]
     # recover n: packed length is a strictly increasing function of n
@@ -393,7 +393,7 @@ def _linalg_maketrian(A, offset=0, lower=True):
     return out.at[..., rows, cols].set(A)
 
 
-@register("cumsum", aliases=["_np_cumsum"])
+@register("cumsum", aliases=["_np_cumsum"], ndarray_inputs=['a'])
 def _cumsum(a, axis=None, dtype=None):
     if axis is None:
         a = a.reshape(-1)
@@ -402,7 +402,7 @@ def _cumsum(a, axis=None, dtype=None):
     return out.astype(dtype) if dtype else out
 
 
-@register("cumprod", aliases=["_np_cumprod"])
+@register("cumprod", aliases=["_np_cumprod"], ndarray_inputs=['a'])
 def _cumprod(a, axis=None, dtype=None):
     if axis is None:
         a = a.reshape(-1)
@@ -411,7 +411,7 @@ def _cumprod(a, axis=None, dtype=None):
     return out.astype(dtype) if dtype else out
 
 
-@register("batch_take", differentiable=False)
+@register("batch_take", differentiable=False, ndarray_inputs=['a', 'indices'])
 def _batch_take(a, indices):
     """a (N, ...) with indices (N,): per-row take (reference batch_take)."""
     return jnp.take_along_axis(
@@ -419,7 +419,7 @@ def _batch_take(a, indices):
         axis=1).reshape(indices.shape)
 
 
-@register("cast_storage")
+@register("cast_storage", ndarray_inputs=['data'])
 def _cast_storage(data, stype="default"):
     """Storage casts are identity on TPU — sparse NDArrays are emulated over
     dense jax.Arrays (ndarray/sparse.py); the wrapper layer rebuilds the
@@ -427,7 +427,7 @@ def _cast_storage(data, stype="default"):
     return data
 
 
-@register("_linalg_potri", aliases=["linalg_potri"])
+@register("_linalg_potri", aliases=["linalg_potri"], ndarray_inputs=['A'])
 def _linalg_potri(A, lower=True):
     """Inverse of an SPD matrix from its Cholesky factor (reference
     linalg.potri: input is the POTRF output L, result is (L L^T)^-1 =
@@ -440,14 +440,14 @@ def _linalg_potri(A, lower=True):
     return jnp.swapaxes(Linv, -1, -2) @ Linv
 
 
-@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"], ndarray_inputs=['A'])
 def _linalg_sumlogdiag(A):
     """sum(log(diag(A))) per matrix (reference linalg.sumlogdiag — the
     log-determinant shortcut for Cholesky factors)."""
     return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
 
 
-@register("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2)
+@register("_linalg_gelqf", aliases=["linalg_gelqf"], num_outputs=2, ndarray_inputs=['A'])
 def _linalg_gelqf(A):
     """LQ factorization A = L·Q with Q orthonormal rows (reference
     linalg.gelqf, m <= n — TBV): returns (Q, L)."""
@@ -455,7 +455,7 @@ def _linalg_gelqf(A):
     return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
 
 
-@register("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2)
+@register("_linalg_syevd", aliases=["linalg_syevd"], num_outputs=2, ndarray_inputs=['A'])
 def _linalg_syevd(A):
     """Symmetric eigendecomposition A = U^T·diag(w)·U with eigenvector
     ROWS in U (reference linalg.syevd convention — TBV): returns (U, w)."""

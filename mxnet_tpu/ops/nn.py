@@ -36,7 +36,7 @@ def _is_training():
 # FullyConnected — the MXU workhorse.
 # ---------------------------------------------------------------------------
 
-@register("FullyConnected")
+@register("FullyConnected", ndarray_inputs=['data', 'weight', 'bias'])
 def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
     x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
     out = jnp.matmul(x, weight.T)
@@ -49,7 +49,7 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, fl
 # Activations
 # ---------------------------------------------------------------------------
 
-@register("Activation")
+@register("Activation", ndarray_inputs=['data'])
 def _activation(data, act_type="relu"):
     if act_type == "relu":
         return jnp.maximum(data, 0)
@@ -70,7 +70,7 @@ def _activation(data, act_type="relu"):
     raise ValueError(f"unknown act_type {act_type!r}")
 
 
-@register("LeakyReLU")
+@register("LeakyReLU", ndarray_inputs=['data', 'gamma'])
 def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
                 upper_bound=0.334):
     if act_type == "leaky":
@@ -112,7 +112,7 @@ def _length_mask(data, length, axis):
     return pos < ln
 
 
-@register("softmax")
+@register("softmax", ndarray_inputs=['data'], tags=("softmax",))
 def _softmax(data, length=None, axis=-1, temperature=None, dtype=None, use_length=False):
     x = data
     if temperature is not None and temperature != 1.0:
@@ -131,7 +131,7 @@ def _softmax(data, length=None, axis=-1, temperature=None, dtype=None, use_lengt
     return out
 
 
-@register("log_softmax")
+@register("log_softmax", ndarray_inputs=['data'])
 def _log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
     x = data if not temperature or temperature == 1.0 else data / temperature
     out = jax.nn.log_softmax(x, axis=int(axis))
@@ -142,12 +142,12 @@ def _log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
     return out
 
 
-@register("softmin")
+@register("softmin", ndarray_inputs=['data'])
 def _softmin(data, axis=-1, temperature=None, dtype=None):
     return _softmax(-data, axis=axis, temperature=temperature, dtype=dtype)
 
 
-@register("SoftmaxActivation")
+@register("SoftmaxActivation", ndarray_inputs=['data'], tags=("softmax",))
 def _softmax_activation(data, mode="instance"):
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
@@ -211,7 +211,8 @@ def _so_bwd(grad_scale, ignore_label, multi_output, use_ignore, preserve_shape,
 _softmax_output_core.defvjp(_so_fwd, _so_bwd)
 
 
-@register("SoftmaxOutput", aliases=["Softmax"])
+@register("SoftmaxOutput", aliases=["Softmax"], ndarray_inputs=['data', 'label'],
+          tags=("softmax",))
 def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output=False,
                     use_ignore=False, preserve_shape=False, normalization="null",
                     out_grad=False, smooth_alpha=0.0):
@@ -221,7 +222,7 @@ def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0, multi_output
                                 normalization, bool(out_grad), float(smooth_alpha))
 
 
-@register("softmax_cross_entropy")
+@register("softmax_cross_entropy", ndarray_inputs=['data', 'label'])
 def _softmax_cross_entropy(data, label):
     logp = jax.nn.log_softmax(data, axis=-1)
     lab = label.astype(jnp.int32)
@@ -264,12 +265,15 @@ def _make_regression_output(err_grad):
     return op
 
 
-register("LinearRegressionOutput")(_make_regression_output("linear"))
-register("MAERegressionOutput")(_make_regression_output("mae"))
-register("LogisticRegressionOutput")(_make_regression_output("logistic"))
+register("LinearRegressionOutput", ndarray_inputs=["data", "label"])(
+    _make_regression_output("linear"))
+register("MAERegressionOutput", ndarray_inputs=["data", "label"])(
+    _make_regression_output("mae"))
+register("LogisticRegressionOutput", ndarray_inputs=["data", "label"])(
+    _make_regression_output("logistic"))
 
 
-@register("SVMOutput")
+@register("SVMOutput", ndarray_inputs=['data', 'label'])
 def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0, use_linear=False):
     return data
 
@@ -282,7 +286,7 @@ def _bn_n_out(kw):
     return 3 if kw.get("output_mean_var") else 1
 
 
-@register("BatchNorm", num_outputs=_bn_n_out)
+@register("BatchNorm", num_outputs=_bn_n_out, ndarray_inputs=['data', 'gamma', 'beta', 'moving_mean', 'moving_var'])
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                 fix_gamma=True, use_global_stats=False, output_mean_var=False, axis=1,
                 cudnn_off=False, min_calib_range=None, max_calib_range=None, _train=None):
@@ -318,7 +322,7 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     return out
 
 
-@register("LayerNorm")
+@register("LayerNorm", ndarray_inputs=['data', 'gamma', 'beta'])
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     ax = int(axis) % data.ndim
     mean = jnp.mean(data, axis=ax, keepdims=True)
@@ -328,7 +332,7 @@ def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     return (data - mean) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
-@register("InstanceNorm")
+@register("InstanceNorm", ndarray_inputs=['data', 'gamma', 'beta'])
 def _instance_norm(data, gamma, beta, eps=1e-3):
     red = tuple(range(2, data.ndim))
     mean = jnp.mean(data, axis=red, keepdims=True)
@@ -337,7 +341,7 @@ def _instance_norm(data, gamma, beta, eps=1e-3):
     return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
-@register("GroupNorm")
+@register("GroupNorm", ndarray_inputs=['data', 'gamma', 'beta'])
 def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     n, c = data.shape[0], data.shape[1]
     g = int(num_groups)
@@ -351,7 +355,7 @@ def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     return x * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
-@register("RMSNorm")
+@register("RMSNorm", ndarray_inputs=['data', 'gamma'])
 def _rms_norm(data, gamma, axis=-1, eps=1e-6):
     ax = int(axis) % data.ndim
     ms = jnp.mean(jnp.square(data), axis=ax, keepdims=True)
@@ -363,7 +367,7 @@ def _rms_norm(data, gamma, axis=-1, eps=1e-6):
 # Dropout
 # ---------------------------------------------------------------------------
 
-@register("Dropout")
+@register("Dropout", ndarray_inputs=['data'])
 def _dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, _train=None):
     train = _is_training() if _train is None else _train
     if (not train and mode != "always") or p <= 0.0:
@@ -389,7 +393,7 @@ def _conv_dims(ndim):
     return ("NC" + sp, "OI" + sp, "NC" + sp)
 
 
-@register("Convolution")
+@register("Convolution", ndarray_inputs=['data', 'weight', 'bias'])
 def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
                  num_filter=0, num_group=1, workspace=1024, no_bias=False,
                  cudnn_tune=None, cudnn_off=False, layout=None):
@@ -408,7 +412,7 @@ def _convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(
     return out
 
 
-@register("Deconvolution")
+@register("Deconvolution", ndarray_inputs=['data', 'weight', 'bias'])
 def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
                    adj=(), target_shape=(), num_filter=0, num_group=1, workspace=512,
                    no_bias=True, cudnn_tune=None, cudnn_off=False, layout=None):
@@ -445,7 +449,7 @@ def _deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad
     return out
 
 
-@register("Pooling")
+@register("Pooling", ndarray_inputs=['data'])
 def _pooling(data, kernel=(), stride=(), pad=(), pool_type="max", global_pool=False,
              pooling_convention="valid", cudnn_off=False, p_value=2,
              count_include_pad=True, layout=None):
@@ -506,7 +510,7 @@ def _pooling(data, kernel=(), stride=(), pad=(), pool_type="max", global_pool=Fa
     raise ValueError(f"unknown pool_type {pool_type!r}")
 
 
-@register("UpSampling")
+@register("UpSampling", ndarray_inputs="*")
 def _upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
                 multi_input_mode="concat", workspace=512):
     data = args[0]
@@ -527,7 +531,7 @@ def _upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
     raise ValueError(f"unknown sample_type {sample_type!r}")
 
 
-@register("BilinearSampler")
+@register("BilinearSampler", ndarray_inputs=['data', 'grid'])
 def _bilinear_sampler(data, grid, cudnn_off=False):
     # grid in [-1, 1], shape (N, 2, H, W) — reference bilinear_sampler.cc (TBV)
     n, c, hin, win = data.shape
@@ -551,7 +555,7 @@ def _bilinear_sampler(data, grid, cudnn_off=False):
     return out
 
 
-@register("GridGenerator")
+@register("GridGenerator", ndarray_inputs=['data'])
 def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
     h, w = int(target_shape[0]), int(target_shape[1])
     if transform_type == "affine":
@@ -565,14 +569,14 @@ def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
     return data  # warp type passes through
 
 
-@register("SpatialTransformer")
+@register("SpatialTransformer", ndarray_inputs=['data', 'loc'])
 def _spatial_transformer(data, loc, target_shape=(0, 0), transform_type="affine",
                          sampler_type="bilinear", cudnn_off=False):
     grid = _grid_generator(loc, "affine", target_shape)
     return _bilinear_sampler(data, grid)
 
 
-@register("Correlation", num_outputs=1)
+@register("Correlation", num_outputs=1, ndarray_inputs=['data1', 'data2'])
 def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
                  stride2=1, pad_size=0, is_multiply=True):
     """FlowNet correlation layer (reference src/operator/correlation-inl.h,
@@ -635,7 +639,7 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     return jnp.stack(chans, axis=1).astype(data1.dtype)
 
 
-@register("LRN")
+@register("LRN", ndarray_inputs=['data'])
 def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
     n = int(nsize)
     sq = jnp.square(data)

@@ -22,14 +22,14 @@ def _grad_prep(grad, wd, weight, rescale_grad, clip_gradient):
     return g + wd * weight
 
 
-@register("sgd_update")
+@register("sgd_update", ndarray_inputs=['weight', 'grad'])
 def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                 lazy_update=True):
     g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
     return weight - lr * g
 
 
-@register("sgd_mom_update", num_outputs=2)
+@register("sgd_mom_update", num_outputs=2, ndarray_inputs=['weight', 'grad', 'mom'])
 def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0, lazy_update=True):
     g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
@@ -37,7 +37,7 @@ def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_gr
     return weight + mom, mom
 
 
-@register("nag_mom_update", num_outputs=2)
+@register("nag_mom_update", num_outputs=2, ndarray_inputs=['weight', 'grad', 'mom'])
 def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                     clip_gradient=-1.0):
     g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
@@ -45,7 +45,7 @@ def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_gr
     return weight + momentum * mom - lr * g, mom
 
 
-@register("mp_sgd_update", num_outputs=2)
+@register("mp_sgd_update", num_outputs=2, ndarray_inputs=['weight', 'grad', 'weight32'])
 def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, lazy_update=True):
     g = _grad_prep(grad.astype(jnp.float32), wd, weight32, rescale_grad, clip_gradient)
@@ -53,7 +53,7 @@ def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
     return w32.astype(weight.dtype), w32
 
 
-@register("mp_sgd_mom_update", num_outputs=3)
+@register("mp_sgd_mom_update", num_outputs=3, ndarray_inputs=['weight', 'grad', 'mom', 'weight32'])
 def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
                        rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     g = _grad_prep(grad.astype(jnp.float32), wd, weight32, rescale_grad, clip_gradient)
@@ -62,7 +62,7 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.
     return w32.astype(weight.dtype), mom, w32
 
 
-@register("adam_update", num_outputs=3)
+@register("adam_update", num_outputs=3, ndarray_inputs=['weight', 'grad', 'mean', 'var'])
 def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
     g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
@@ -71,7 +71,7 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999, epsi
     return weight - lr * mean / (jnp.sqrt(var) + epsilon), mean, var
 
 
-@register("adamw_update", aliases=["_adamw_update", "_contrib_adamw_update"], num_outputs=3)
+@register("adamw_update", aliases=["_adamw_update", "_contrib_adamw_update"], num_outputs=3, ndarray_inputs=['weight', 'grad', 'mean', 'var'])
 def _adamw_update(weight, grad, mean, var, rescale_grad=None, lr=0.001, beta1=0.9,
                   beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0, clip_gradient=-1.0):
     rg = rescale_grad if not hasattr(rescale_grad, "shape") else rescale_grad.reshape(())
@@ -86,7 +86,7 @@ def _adamw_update(weight, grad, mean, var, rescale_grad=None, lr=0.001, beta1=0.
     return w, mean, var
 
 
-@register("rmsprop_update", num_outputs=2)
+@register("rmsprop_update", num_outputs=2, ndarray_inputs=['weight', 'grad', 'n'])
 def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
     g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
@@ -97,7 +97,7 @@ def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8, wd=0.0,
     return w, n
 
 
-@register("rmspropalex_update", num_outputs=4)
+@register("rmspropalex_update", num_outputs=4, ndarray_inputs=['weight', 'grad', 'n', 'g_', 'delta'])
 def _rmspropalex_update(weight, grad, n, g_, delta, lr=0.001, gamma1=0.95, gamma2=0.9,
                         epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                         clip_weights=-1.0):
@@ -111,7 +111,7 @@ def _rmspropalex_update(weight, grad, n, g_, delta, lr=0.001, gamma1=0.95, gamma
     return w, n, g_, delta
 
 
-@register("ftrl_update", num_outputs=3)
+@register("ftrl_update", num_outputs=3, ndarray_inputs=['weight', 'grad', 'z', 'n'])
 def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                  rescale_grad=1.0, clip_gradient=-1.0):
     g = grad * rescale_grad
@@ -127,7 +127,7 @@ def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
     return w.astype(weight.dtype), z, n_new
 
 
-@register("signsgd_update")
+@register("signsgd_update", ndarray_inputs=['weight', 'grad'])
 def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient > 0:
@@ -135,7 +135,7 @@ def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradie
     return weight - lr * (jnp.sign(g) + wd * weight)
 
 
-@register("signum_update", num_outputs=2)
+@register("signum_update", num_outputs=2, ndarray_inputs=['weight', 'grad', 'mom'])
 def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, wd_lh=0.0):
     g = grad * rescale_grad
@@ -155,7 +155,7 @@ def _lamb_states(grad, mean, var, beta1=0.9, beta2=0.999, rescale_grad=1.0,
     return beta1 * mean + (1 - beta1) * g, beta2 * var + (1 - beta2) * jnp.square(g)
 
 
-@register("lamb_update_phase1")
+@register("lamb_update_phase1", ndarray_inputs=['weight', 'grad', 'mean', 'var'])
 def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
                         t=1, bias_correction=True, wd=0.0, rescale_grad=1.0,
                         clip_gradient=-1.0):
@@ -167,7 +167,7 @@ def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon
     return m / (jnp.sqrt(v) + epsilon) + wd * weight
 
 
-@register("lamb_update_phase2")
+@register("lamb_update_phase2", ndarray_inputs=['weight', 'g', 'r1', 'r2'])
 def _lamb_update_phase2(weight, g, r1, r2, lr=0.01, lower_bound=-1.0, upper_bound=-1.0):
     r1v = r1.reshape(())
     r2v = r2.reshape(())
@@ -179,7 +179,7 @@ def _lamb_update_phase2(weight, g, r1, r2, lr=0.01, lower_bound=-1.0, upper_boun
     return weight - lr * ratio * g
 
 
-@register("adagrad_update", aliases=["_sparse_adagrad_update"], num_outputs=2)
+@register("adagrad_update", aliases=["_sparse_adagrad_update"], num_outputs=2, ndarray_inputs=['weight', 'grad', 'history'])
 def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
                     rescale_grad=1.0, clip_gradient=-1.0):
     g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
@@ -187,7 +187,7 @@ def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
     return weight - lr * g / (jnp.sqrt(history) + epsilon), history
 
 
-@register("adadelta_update", aliases=["adaalpha_update"], num_outputs=3)
+@register("adadelta_update", aliases=["adaalpha_update"], num_outputs=3, ndarray_inputs=['weight', 'grad', 'acc_g', 'acc_delta'])
 def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5, wd=0.0,
                      rescale_grad=1.0, clip_gradient=-1.0):
     g = _grad_prep(grad, wd, weight, rescale_grad, clip_gradient)
@@ -197,7 +197,7 @@ def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5, wd=0
     return weight - delta, acc_g, acc_delta
 
 
-@register("ftml_update", num_outputs=4)
+@register("ftml_update", num_outputs=4, ndarray_inputs=['weight', 'grad', 'd', 'v', 'z'])
 def _ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999, epsilon=1e-8,
                  t=1, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
     g = grad * rescale_grad + wd * weight
@@ -250,7 +250,7 @@ def _multi_n_out(n_in, n_out_per):
     return n
 
 
-@register("multi_sgd_update", num_outputs=_multi_n_out(2, 1))
+@register("multi_sgd_update", num_outputs=_multi_n_out(2, 1), ndarray_inputs="*")
 def _multi_sgd_update(*arrays, **kwargs):
     def step(i, w, g):
         return _sgd_update(w, g, lr=_per_group(kwargs, "lrs", i, 0.01),
@@ -260,7 +260,7 @@ def _multi_sgd_update(*arrays, **kwargs):
     return _multi(step, 2, 1, arrays, kwargs)
 
 
-@register("multi_sgd_mom_update", num_outputs=_multi_n_out(3, 2))
+@register("multi_sgd_mom_update", num_outputs=_multi_n_out(3, 2), ndarray_inputs="*")
 def _multi_sgd_mom_update(*arrays, **kwargs):
     def step(i, w, g, m):
         return _sgd_mom_update(w, g, m, lr=_per_group(kwargs, "lrs", i, 0.01),
@@ -271,7 +271,7 @@ def _multi_sgd_mom_update(*arrays, **kwargs):
     return _multi(step, 3, 2, arrays, kwargs)
 
 
-@register("multi_mp_sgd_update", num_outputs=_multi_n_out(3, 2))
+@register("multi_mp_sgd_update", num_outputs=_multi_n_out(3, 2), ndarray_inputs="*")
 def _multi_mp_sgd_update(*arrays, **kwargs):
     def step(i, w, g, w32):
         return _mp_sgd_update(w, g, w32, lr=_per_group(kwargs, "lrs", i, 0.01),
@@ -281,7 +281,7 @@ def _multi_mp_sgd_update(*arrays, **kwargs):
     return _multi(step, 3, 2, arrays, kwargs)
 
 
-@register("multi_mp_sgd_mom_update", num_outputs=_multi_n_out(4, 3))
+@register("multi_mp_sgd_mom_update", num_outputs=_multi_n_out(4, 3), ndarray_inputs="*")
 def _multi_mp_sgd_mom_update(*arrays, **kwargs):
     def step(i, w, g, m, w32):
         return _mp_sgd_mom_update(w, g, m, w32,
@@ -310,21 +310,21 @@ def _preloaded(base_fn, n_in, n_out_per):
 
 
 register("preloaded_multi_sgd_update",
-         num_outputs=_multi_n_out(2, 1))(
+         num_outputs=_multi_n_out(2, 1), ndarray_inputs="*")(
     _preloaded(_multi_sgd_update, 2, 1))
 register("preloaded_multi_sgd_mom_update",
-         num_outputs=_multi_n_out(3, 2))(
+         num_outputs=_multi_n_out(3, 2), ndarray_inputs="*")(
     _preloaded(_multi_sgd_mom_update, 3, 2))
 register("preloaded_multi_mp_sgd_update",
-         num_outputs=_multi_n_out(3, 2))(
+         num_outputs=_multi_n_out(3, 2), ndarray_inputs="*")(
     _preloaded(_multi_mp_sgd_update, 3, 2))
 register("preloaded_multi_mp_sgd_mom_update",
-         num_outputs=_multi_n_out(4, 3))(
+         num_outputs=_multi_n_out(4, 3), ndarray_inputs="*")(
     _preloaded(_multi_mp_sgd_mom_update, 4, 3))
 
 
 @register("multi_lamb_update_phase1", aliases=["_multi_lamb_update_phase1"],
-          num_outputs=_multi_n_out(4, 3))
+          num_outputs=_multi_n_out(4, 3), ndarray_inputs="*")
 def _multi_lamb_phase1(*arrays, **kwargs):
     def step(i, w, g, mean, var):
         b1 = kwargs.get("beta1", 0.9)
@@ -346,7 +346,7 @@ def _multi_lamb_phase1(*arrays, **kwargs):
 
 
 @register("multi_lamb_update_phase2", aliases=["_multi_lamb_update_phase2"],
-          num_outputs=_multi_n_out(4, 1))
+          num_outputs=_multi_n_out(4, 1), ndarray_inputs="*")
 def _multi_lamb_phase2(*arrays, **kwargs):
     def step(i, w, g, r1, r2):
         return _lamb_update_phase2(
@@ -357,7 +357,7 @@ def _multi_lamb_phase2(*arrays, **kwargs):
 
 
 @register("multi_adamw_update", aliases=["_multi_adamw_update"],
-          num_outputs=_multi_n_out(4, 3))
+          num_outputs=_multi_n_out(4, 3), ndarray_inputs="*")
 def _multi_adamw_update(*arrays, **kwargs):
     def step(i, w, g, mean, var):
         return _adamw_update(
@@ -372,7 +372,7 @@ def _multi_adamw_update(*arrays, **kwargs):
 
 
 @register("multi_mp_adamw_update", aliases=["_multi_mp_adamw_update"],
-          num_outputs=_multi_n_out(5, 4))
+          num_outputs=_multi_n_out(5, 4), ndarray_inputs="*")
 def _multi_mp_adamw_update(*arrays, **kwargs):
     def step(i, w, g, mean, var, w32):
         nw32, m, v = _adamw_update(
@@ -388,7 +388,7 @@ def _multi_mp_adamw_update(*arrays, **kwargs):
     return _multi(step, 5, 4, arrays, kwargs)
 
 
-@register("adamax_update", num_outputs=3)
+@register("adamax_update", num_outputs=3, ndarray_inputs=['weight', 'grad', 'mean', 'inf_norm'])
 def _adamax_update(weight, grad, mean, inf_norm, lr=0.002, beta1=0.9,
                    beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, t=1):
@@ -400,7 +400,7 @@ def _adamax_update(weight, grad, mean, inf_norm, lr=0.002, beta1=0.9,
     return weight - lr_t * mean / (inf_norm + epsilon), mean, inf_norm
 
 
-@register("nadam_update", num_outputs=3)
+@register("nadam_update", num_outputs=3, ndarray_inputs=['weight', 'grad', 'mean', 'var'])
 def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
                   epsilon=1e-8, schedule_decay=0.004, wd=0.0,
                   rescale_grad=1.0, clip_gradient=-1.0, t=1, m_schedule=1.0):
@@ -419,7 +419,7 @@ def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return weight - lr * m_bar / (jnp.sqrt(v_prime) + epsilon), mean, var
 
 
-@register("sgld_update", differentiable=False)
+@register("sgld_update", differentiable=False, ndarray_inputs=['weight', 'grad'])
 def _sgld_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
                  clip_gradient=-1.0):
     """Stochastic Gradient Langevin Dynamics: SGD step + N(0, lr) noise
@@ -434,7 +434,7 @@ def _sgld_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
     return weight - 0.5 * lr * g + noise
 
 
-@register("dcasgd_update", num_outputs=3)
+@register("dcasgd_update", num_outputs=3, ndarray_inputs=['weight', 'grad', 'mom', 'prev_weight'])
 def _dcasgd_update(weight, grad, mom, prev_weight, lr=0.01, momentum=0.0,
                    lamda=0.04, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     """Delay-compensated async SGD (reference optimizer.DCASGD): the delayed

@@ -17,7 +17,7 @@ def _topk_n_out(kw):
     return 2 if kw.get("ret_typ", "indices") == "both" else 1
 
 
-@register("topk", num_outputs=_topk_n_out, differentiable=False)
+@register("topk", num_outputs=_topk_n_out, differentiable=False, ndarray_inputs=['data'])
 def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     from ..base import dtype_np
 
@@ -50,14 +50,14 @@ def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32
     raise ValueError(f"unknown ret_typ {ret_typ!r}")
 
 
-@register("sort")
+@register("sort", ndarray_inputs=['data'])
 def _sort(data, axis=-1, is_ascend=True):
     ax = data.ndim - 1 if axis is None else int(axis)
     s = jnp.sort(data, axis=ax)
     return s if is_ascend else jnp.flip(s, axis=ax)
 
 
-@register("argsort", differentiable=False)
+@register("argsort", differentiable=False, ndarray_inputs=['data'])
 def _argsort(data, axis=-1, is_ascend=True, dtype="float32"):
     from ..base import dtype_np
 
@@ -68,13 +68,13 @@ def _argsort(data, axis=-1, is_ascend=True, dtype="float32"):
     return idx.astype(dtype_np(dtype))
 
 
-@register("_unravel_index", aliases=["unravel_index"], differentiable=False)
+@register("_unravel_index", aliases=["unravel_index"], differentiable=False, ndarray_inputs=['data'])
 def _unravel(data, shape=()):
     idx = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
     return jnp.stack(idx, axis=0).astype(jnp.float32)
 
 
-@register("_ravel_multi_index", aliases=["ravel_multi_index"], differentiable=False)
+@register("_ravel_multi_index", aliases=["ravel_multi_index"], differentiable=False, ndarray_inputs=['data'])
 def _ravel(data, shape=()):
     coords = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
     return jnp.asarray(jnp.ravel_multi_index(coords, tuple(shape), mode="clip")).astype(jnp.float32)

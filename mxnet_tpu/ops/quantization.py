@@ -30,7 +30,7 @@ def _range_scale(min_r, max_r, bits=8):
 
 
 @register("_contrib_quantize", aliases=["quantize"], num_outputs=3,
-          differentiable=False)
+          differentiable=False, ndarray_inputs=['data', 'min_range', 'max_range'])
 def _quantize(data, min_range, max_range, out_type="int8"):
     """f32 → int8 against a given calibration range. Returns
     (quantized, min_output, max_output)."""
@@ -45,7 +45,7 @@ def _q_v2_n_out(kwargs):
 
 
 @register("_contrib_quantize_v2", aliases=["quantize_v2"],
-          num_outputs=_q_v2_n_out, differentiable=False)
+          num_outputs=_q_v2_n_out, differentiable=False, ndarray_inputs=['data'])
 def _quantize_v2(data, out_type="int8", min_calib_range=None,
                  max_calib_range=None):
     """Like quantize, but the range comes from calibration kwargs or, when
@@ -62,7 +62,7 @@ def _quantize_v2(data, out_type="int8", min_calib_range=None,
     return q, -m.reshape(1), m.reshape(1)
 
 
-@register("_contrib_dequantize", aliases=["dequantize"], differentiable=False)
+@register("_contrib_dequantize", aliases=["dequantize"], differentiable=False, ndarray_inputs=['data', 'min_range', 'max_range'])
 def _dequantize(data, min_range, max_range, out_type="float32"):
     """(min_range, max_range) give the real value of the integer dtype's
     extremes — 127 for int8 inputs, 2^31-1 for the int32 accumulators the
@@ -74,7 +74,7 @@ def _dequantize(data, min_range, max_range, out_type="float32"):
 
 
 @register("_contrib_requantize", aliases=["requantize"], num_outputs=3,
-          differentiable=False)
+          differentiable=False, ndarray_inputs=['data', 'min_range', 'max_range'])
 def _requantize(data, min_range, max_range, min_calib_range=None,
                 max_calib_range=None, out_type="int8"):
     """int32 accumulator → int8. min/max_range describe the int32 value
@@ -105,7 +105,7 @@ def _int32_range(min_a, max_a, min_b, max_b):
 
 @register("_contrib_quantized_fully_connected",
           aliases=["quantized_fully_connected"], num_outputs=3,
-          differentiable=False)
+          differentiable=False, ndarray_inputs=['data', 'weight', 'bias', 'min_data', 'max_data', 'min_weight', 'max_weight'])
 def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
                   max_weight, min_bias=None, max_bias=None, num_hidden=1,
                   no_bias=False, flatten=True):
@@ -128,7 +128,7 @@ def _quantized_fc(data, weight, bias, min_data, max_data, min_weight,
 
 
 @register("_contrib_quantized_conv", aliases=["quantized_conv"],
-          num_outputs=3, differentiable=False)
+          num_outputs=3, differentiable=False, ndarray_inputs=['data', 'weight', 'bias', 'min_data', 'max_data', 'min_weight', 'max_weight'])
 def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                     max_weight, min_bias=None, max_bias=None, kernel=(1, 1),
                     stride=(1, 1), pad=(0, 0), dilate=(1, 1), num_filter=1,
@@ -154,7 +154,7 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
 
 
 @register("_contrib_quantized_pooling", aliases=["quantized_pooling"],
-          num_outputs=3, differentiable=False)
+          num_outputs=3, differentiable=False, ndarray_inputs=['data', 'min_data', 'max_data'])
 def _quantized_pooling(data, min_data, max_data, kernel=(2, 2),
                        stride=None, pad=(0, 0), pool_type="max",
                        global_pool=False):
@@ -170,6 +170,6 @@ def _quantized_pooling(data, min_data, max_data, kernel=(2, 2),
 
 
 @register("_contrib_quantized_flatten", aliases=["quantized_flatten"],
-          num_outputs=3, differentiable=False)
+          num_outputs=3, differentiable=False, ndarray_inputs=['data', 'min_data', 'max_data'])
 def _quantized_flatten(data, min_data, max_data):
     return data.reshape(data.shape[0], -1), min_data, max_data

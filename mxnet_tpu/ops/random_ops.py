@@ -42,40 +42,40 @@ def _shp(shape):
 # _random_*: scalar-parameter draws
 # ---------------------------------------------------------------------------
 
-@register("_random_uniform", aliases=["random_uniform"], differentiable=False)
+@register("_random_uniform", aliases=["random_uniform"], differentiable=False, ndarray_inputs=[])
 def _random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None):
     return jax.random.uniform(_key(), _shp(shape), _dt(dtype), low, high)
 
 
-@register("_random_normal", aliases=["random_normal"], differentiable=False)
+@register("_random_normal", aliases=["random_normal"], differentiable=False, ndarray_inputs=[])
 def _random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None):
     return loc + scale * jax.random.normal(_key(), _shp(shape), _dt(dtype))
 
 
-@register("_random_gamma", aliases=["random_gamma"], differentiable=False)
+@register("_random_gamma", aliases=["random_gamma"], differentiable=False, ndarray_inputs=[])
 def _random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
     return beta * jax.random.gamma(_key(), alpha, _shp(shape), _dt(dtype))
 
 
 @register("_random_exponential", aliases=["random_exponential"],
-          differentiable=False)
+          differentiable=False, ndarray_inputs=[])
 def _random_exponential(lam=1.0, shape=None, dtype="float32", ctx=None):
     return jax.random.exponential(_key(), _shp(shape), _dt(dtype)) / lam
 
 
-@register("_random_poisson", aliases=["random_poisson"], differentiable=False)
+@register("_random_poisson", aliases=["random_poisson"], differentiable=False, ndarray_inputs=[])
 def _random_poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
     return jax.random.poisson(_key(), lam, _shp(shape)).astype(_dt(dtype))
 
 
-@register("_random_randint", aliases=["random_randint"], differentiable=False)
+@register("_random_randint", aliases=["random_randint"], differentiable=False, ndarray_inputs=[])
 def _random_randint(low=0, high=1, shape=None, dtype="int32", ctx=None):
     return jax.random.randint(_key(), _shp(shape), int(low), int(high),
                               _dt(dtype))
 
 
 @register("_random_negative_binomial", aliases=["random_negative_binomial"],
-          differentiable=False)
+          differentiable=False, ndarray_inputs=[])
 def _random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32",
                               ctx=None):
     # NB(k, p) = Poisson(lam) with lam ~ Gamma(k, (1-p)/p)
@@ -85,7 +85,7 @@ def _random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32",
 
 @register("_random_generalized_negative_binomial",
           aliases=["random_generalized_negative_binomial"],
-          differentiable=False)
+          differentiable=False, ndarray_inputs=[])
 def _random_gen_negative_binomial(mu=1.0, alpha=1.0, shape=None,
                                   dtype="float32", ctx=None):
     if alpha == 0.0:
@@ -109,21 +109,21 @@ def _tensor_draw(draw, params, shape, dtype):
     return draw(out_shape, *broadcast).astype(_dt(dtype))
 
 
-@register("_sample_uniform", aliases=["sample_uniform"], differentiable=False)
+@register("_sample_uniform", aliases=["sample_uniform"], differentiable=False, ndarray_inputs=['low', 'high'])
 def _sample_uniform(low, high, shape=None, dtype="float32"):
     return _tensor_draw(
         lambda s, lo, hi: lo + (hi - lo) * jax.random.uniform(_key(), s),
         [low, high], shape, dtype)
 
 
-@register("_sample_normal", aliases=["sample_normal"], differentiable=False)
+@register("_sample_normal", aliases=["sample_normal"], differentiable=False, ndarray_inputs=['mu', 'sigma'])
 def _sample_normal(mu, sigma, shape=None, dtype="float32"):
     return _tensor_draw(
         lambda s, m, sd: m + sd * jax.random.normal(_key(), s),
         [mu, sigma], shape, dtype)
 
 
-@register("_sample_gamma", aliases=["sample_gamma"], differentiable=False)
+@register("_sample_gamma", aliases=["sample_gamma"], differentiable=False, ndarray_inputs=['alpha', 'beta'])
 def _sample_gamma(alpha, beta, shape=None, dtype="float32"):
     return _tensor_draw(
         lambda s, a, b: b * jax.random.gamma(_key(), a, s),
@@ -131,14 +131,14 @@ def _sample_gamma(alpha, beta, shape=None, dtype="float32"):
 
 
 @register("_sample_exponential", aliases=["sample_exponential"],
-          differentiable=False)
+          differentiable=False, ndarray_inputs=['lam'])
 def _sample_exponential(lam, shape=None, dtype="float32"):
     return _tensor_draw(
         lambda s, l: jax.random.exponential(_key(), s) / l,
         [lam], shape, dtype)
 
 
-@register("_sample_poisson", aliases=["sample_poisson"], differentiable=False)
+@register("_sample_poisson", aliases=["sample_poisson"], differentiable=False, ndarray_inputs=['lam'])
 def _sample_poisson(lam, shape=None, dtype="float32"):
     return _tensor_draw(
         lambda s, l: jax.random.poisson(_key(), l, s).astype(jnp.float32),
@@ -146,7 +146,7 @@ def _sample_poisson(lam, shape=None, dtype="float32"):
 
 
 @register("_sample_negative_binomial", aliases=["sample_negative_binomial"],
-          differentiable=False)
+          differentiable=False, ndarray_inputs=['k', 'p'])
 def _sample_negative_binomial(k, p, shape=None, dtype="float32"):
     def draw(s, kk, pp):
         lam = jax.random.gamma(_key(), kk, s) * ((1 - pp) / pp)
@@ -156,7 +156,7 @@ def _sample_negative_binomial(k, p, shape=None, dtype="float32"):
 
 @register("_sample_generalized_negative_binomial",
           aliases=["sample_generalized_negative_binomial"],
-          differentiable=False)
+          differentiable=False, ndarray_inputs=['mu', 'alpha'])
 def _sample_gen_negative_binomial(mu, alpha, shape=None, dtype="float32"):
     def draw(s, m, a):
         k = 1.0 / jnp.maximum(a, 1e-12)
@@ -169,7 +169,7 @@ def _sample_gen_negative_binomial(mu, alpha, shape=None, dtype="float32"):
 
 
 @register("_sample_multinomial", aliases=["sample_multinomial"],
-          differentiable=False)
+          differentiable=False, ndarray_inputs=['data'])
 def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
     """data (..., K) probabilities → draws of shape data.shape[:-1] + shape."""
     shape = _shp(shape)
@@ -194,7 +194,7 @@ def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
     return out
 
 
-@register("_shuffle", aliases=["shuffle"], differentiable=False)
+@register("_shuffle", aliases=["shuffle"], differentiable=False, ndarray_inputs=['data'])
 def _shuffle_op(data):
     """Shuffle along the first axis (reference shuffle_op.cc)."""
     return jax.random.permutation(_key(), data, axis=0)
@@ -204,7 +204,7 @@ def _shuffle_op(data):
 # _random_pdf_*: density evaluation (differentiable w.r.t. sample + params)
 # ---------------------------------------------------------------------------
 
-@register("_random_pdf_uniform", aliases=["random_pdf_uniform"])
+@register("_random_pdf_uniform", aliases=["random_pdf_uniform"], ndarray_inputs=['sample', 'low', 'high'])
 def _pdf_uniform(sample, low, high, is_log=False):
     low = low[..., None]
     high = high[..., None]
@@ -213,7 +213,7 @@ def _pdf_uniform(sample, low, high, is_log=False):
     return jnp.log(jnp.maximum(pdf, 1e-30)) if is_log else pdf
 
 
-@register("_random_pdf_normal", aliases=["random_pdf_normal"])
+@register("_random_pdf_normal", aliases=["random_pdf_normal"], ndarray_inputs=['sample', 'mu', 'sigma'])
 def _pdf_normal(sample, mu, sigma, is_log=False):
     mu = mu[..., None]
     sigma = sigma[..., None]
@@ -222,7 +222,7 @@ def _pdf_normal(sample, mu, sigma, is_log=False):
     return logp if is_log else jnp.exp(logp)
 
 
-@register("_random_pdf_gamma", aliases=["random_pdf_gamma"])
+@register("_random_pdf_gamma", aliases=["random_pdf_gamma"], ndarray_inputs=['sample', 'alpha', 'beta'])
 def _pdf_gamma(sample, alpha, beta, is_log=False):
     a = alpha[..., None]
     b = 1.0 / beta[..., None]  # reference: beta is a scale parameter
@@ -231,14 +231,14 @@ def _pdf_gamma(sample, alpha, beta, is_log=False):
     return logp if is_log else jnp.exp(logp)
 
 
-@register("_random_pdf_exponential", aliases=["random_pdf_exponential"])
+@register("_random_pdf_exponential", aliases=["random_pdf_exponential"], ndarray_inputs=['sample', 'lam'])
 def _pdf_exponential(sample, lam, is_log=False):
     lam = lam[..., None]
     logp = jnp.log(lam) - lam * sample
     return logp if is_log else jnp.exp(logp)
 
 
-@register("_random_pdf_poisson", aliases=["random_pdf_poisson"])
+@register("_random_pdf_poisson", aliases=["random_pdf_poisson"], ndarray_inputs=['sample', 'lam'])
 def _pdf_poisson(sample, lam, is_log=False):
     lam = lam[..., None]
     logp = (sample * jnp.log(jnp.maximum(lam, 1e-30)) - lam
@@ -247,7 +247,7 @@ def _pdf_poisson(sample, lam, is_log=False):
 
 
 @register("_random_pdf_negative_binomial",
-          aliases=["random_pdf_negative_binomial"])
+          aliases=["random_pdf_negative_binomial"], ndarray_inputs=['sample', 'k', 'p'])
 def _pdf_negative_binomial(sample, k, p, is_log=False):
     k = k[..., None]
     p = p[..., None]
@@ -259,7 +259,7 @@ def _pdf_negative_binomial(sample, k, p, is_log=False):
 
 
 @register("_random_pdf_generalized_negative_binomial",
-          aliases=["random_pdf_generalized_negative_binomial"])
+          aliases=["random_pdf_generalized_negative_binomial"], ndarray_inputs=['sample', 'mu', 'alpha'])
 def _pdf_gen_negative_binomial(sample, mu, alpha, is_log=False):
     mu = mu[..., None]
     alpha = alpha[..., None]
@@ -272,7 +272,7 @@ def _pdf_gen_negative_binomial(sample, mu, alpha, is_log=False):
     return logp if is_log else jnp.exp(logp)
 
 
-@register("_random_pdf_dirichlet", aliases=["random_pdf_dirichlet"])
+@register("_random_pdf_dirichlet", aliases=["random_pdf_dirichlet"], ndarray_inputs=['sample', 'alpha'])
 def _pdf_dirichlet(sample, alpha, is_log=False):
     a = alpha[..., None, :] if alpha.ndim == sample.ndim - 1 else alpha
     logp = (jnp.sum((a - 1) * jnp.log(sample), axis=-1)

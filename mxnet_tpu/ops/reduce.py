@@ -40,14 +40,18 @@ for _name, _jfn in {
     "nansum": jnp.nansum,
     "nanprod": jnp.nanprod,
 }.items():
-    register(_name)(_make_reduce(_jfn))
+    # the "reduction" tag drives the zero-size-reduction lint rule; sum/prod
+    # have a well-defined identity on empty axes and are deliberately untagged
+    register(_name, ndarray_inputs=["data"],
+             tags=("reduction",) if _name in ("mean", "max", "min") else ())(
+        _make_reduce(_jfn))
 
 alias("sum", "sum_axis", "_np_sum")
 alias("max", "max_axis")
 alias("min", "min_axis")
 
 
-@register("norm")
+@register("norm", ndarray_inputs=['data'])
 def _norm(data, ord=2, axis=None, keepdims=False, out_dtype=None):
     axes = None if axis is None else (_norm_axes(axis, data.ndim))
     if ord == 1:
@@ -77,16 +81,18 @@ def _make_arg_reduce(jfn):
     return op
 
 
-register("argmax", differentiable=False)(_make_arg_reduce(jnp.argmax))
-register("argmin", differentiable=False)(_make_arg_reduce(jnp.argmin))
+register("argmax", differentiable=False, ndarray_inputs=["data"],
+         tags=("reduction",))(_make_arg_reduce(jnp.argmax))
+register("argmin", differentiable=False, ndarray_inputs=["data"],
+         tags=("reduction",))(_make_arg_reduce(jnp.argmin))
 
 
-@register("argmax_channel", differentiable=False)
+@register("argmax_channel", differentiable=False, ndarray_inputs=['data'])
 def _argmax_channel(data):
     return jnp.argmax(data, axis=1).astype(jnp.float32)
 
 
-@register("broadcast_axis", aliases=["broadcast_axes"])
+@register("broadcast_axis", aliases=["broadcast_axes"], ndarray_inputs=['data'])
 def _broadcast_axis(data, axis=(), size=()):
     axis = (axis,) if isinstance(axis, int) else tuple(axis)
     size = (size,) if isinstance(size, int) else tuple(size)
@@ -96,14 +102,14 @@ def _broadcast_axis(data, axis=(), size=()):
     return jnp.broadcast_to(data, tuple(shape))
 
 
-@register("broadcast_to")
+@register("broadcast_to", ndarray_inputs=['data'])
 def _broadcast_to(data, shape=()):
     # reference allows 0 in target shape meaning "keep input dim"
     tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape))
     return jnp.broadcast_to(data, tgt)
 
 
-@register("broadcast_like")
+@register("broadcast_like", ndarray_inputs=['lhs', 'rhs'])
 def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
     if lhs_axes is None:
         return jnp.broadcast_to(lhs, rhs.shape)
@@ -113,7 +119,8 @@ def _broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
     return jnp.broadcast_to(lhs, tuple(shape))
 
 
-@register("logsumexp", aliases=["log_sum_exp"])
+@register("logsumexp", aliases=["log_sum_exp"], ndarray_inputs=['data'],
+          tags=("reduction",))
 def _logsumexp(data, axis=None, keepdims=False):
     from jax.scipy.special import logsumexp
 
@@ -121,7 +128,7 @@ def _logsumexp(data, axis=None, keepdims=False):
     return logsumexp(data, axis=axes, keepdims=bool(keepdims))
 
 
-@register("L2Normalization")
+@register("L2Normalization", ndarray_inputs=['data'])
 def _l2_normalization(data, eps=1e-10, mode="instance"):
     # reference src/operator/l2_normalization.cc (TBV)
     if mode == "instance":

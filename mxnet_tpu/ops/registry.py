@@ -32,20 +32,28 @@ class OpDef:
         name: canonical op name (reference op names kept, e.g. ``broadcast_add``).
         fn: pure function ``fn(*arrays, **kwargs) -> array | tuple(arrays)``.
         num_outputs: static int, or callable(kwargs)->int for ops like ``RNN``.
-        ndarray_inputs: names of positional tensor inputs (for symbol binding).
+        ndarray_inputs: names of positional tensor inputs (for symbol
+            binding), or the string ``"*"`` for variadic ops. Declared on
+            every registration (enforced by tools/lint_repo.py).
         differentiable: False disables autograd recording (e.g. ``argmax``).
+        tags: semantic labels consumed by the static analyzer
+            (mxnet_tpu.analysis), e.g. ``"reduction"``/``"softmax"``/
+            ``"exp"``/``"log"`` — they drive the zero-size-reduction and
+            numerics lint rules without name matching.
     """
 
-    __slots__ = ("name", "fn", "num_outputs", "ndarray_inputs", "differentiable", "param_types")
+    __slots__ = ("name", "fn", "num_outputs", "ndarray_inputs", "differentiable", "param_types",
+                 "tags")
 
     def __init__(self, name, fn, num_outputs=1, ndarray_inputs=None, differentiable=True,
-                 param_types=None):
+                 param_types=None, tags=()):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs
         self.ndarray_inputs = ndarray_inputs
         self.differentiable = differentiable
         self.param_types = param_types or {}
+        self.tags = tuple(tags)
 
     def n_out(self, kwargs) -> int:
         if callable(self.num_outputs):
@@ -60,11 +68,12 @@ _REGISTRY: Dict[str, OpDef] = {}
 
 
 def register(name: str, num_outputs=1, aliases: Optional[List[str]] = None,
-             ndarray_inputs=None, differentiable=True):
+             ndarray_inputs=None, differentiable=True, tags=()):
     """Decorator registering a pure-JAX op under a reference op name."""
 
     def deco(fn: Callable):
-        op = OpDef(name, fn, num_outputs, ndarray_inputs, differentiable)
+        op = OpDef(name, fn, num_outputs, ndarray_inputs, differentiable,
+                   tags=tags)
         _REGISTRY[name] = op
         for a in aliases or ():
             _REGISTRY[a] = op

@@ -125,7 +125,7 @@ def _rnn_n_out(kwargs):
     return 3 if kwargs.get("mode", "lstm") == "lstm" else 2
 
 
-@register("RNN", num_outputs=_rnn_n_out)
+@register("RNN", num_outputs=_rnn_n_out, ndarray_inputs=['data', 'parameters', 'state', 'state_cell'])
 def _rnn(data, parameters, state, state_cell=None, *, state_size, num_layers,
          mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
          projection_size=None, lstm_state_clip_min=None, lstm_state_clip_max=None,

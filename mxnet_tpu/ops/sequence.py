@@ -18,7 +18,7 @@ def _len_mask(seq_len, batch, length):
     return t < length.astype(jnp.int32)[None, :]
 
 
-@register("SequenceMask")
+@register("SequenceMask", ndarray_inputs=['data', 'sequence_length'])
 def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
     if not use_sequence_length or sequence_length is None:
         return data
@@ -30,7 +30,7 @@ def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=
     return jnp.swapaxes(out, 0, 1) if ax == 1 else out
 
 
-@register("SequenceLast")
+@register("SequenceLast", ndarray_inputs=['data', 'sequence_length'])
 def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
     ax = int(axis)
     x = jnp.swapaxes(data, 0, 1) if ax == 1 else data
@@ -40,7 +40,7 @@ def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0
     return jnp.take_along_axis(x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0)[0]
 
 
-@register("SequenceReverse")
+@register("SequenceReverse", ndarray_inputs=['data', 'sequence_length'])
 def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
     x = data  # reference only supports axis=0 (time-major)
     if not use_sequence_length or sequence_length is None:

@@ -3,7 +3,7 @@
 Reference: ``python/mxnet/symbol/`` over NNVM (SURVEY.md §2.1 L5, §2.3).
 """
 from .symbol import (Symbol, Variable, var, Group, load, load_json,  # noqa: F401
-                     zeros, ones)
+                     zeros, ones, invoke_fn)
 
 from ..ops import get_op, has_op, list_ops
 from .symbol import _make_symbol_op

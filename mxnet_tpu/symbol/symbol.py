@@ -23,7 +23,7 @@ from ..ops import get_op, has_op
 from ..ops.registry import OpDef, coerce_kwargs
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json", "zeros",
-           "ones"]
+           "ones", "invoke_fn"]
 
 # argument names treated as tensor inputs when inferring op signatures
 _TENSOR_ARGS = {
@@ -51,7 +51,14 @@ _NAMES = _NameManager()
 
 
 def op_input_names(opdef: OpDef) -> List[str]:
-    """Tensor-input argument names of an op, in signature order."""
+    """Tensor-input argument names of an op, in signature order.
+
+    ``ndarray_inputs="*"`` marks a variadic op (``def op(*data, ...)``):
+    positional symbols fill the slots, so the single placeholder name is
+    only used when an input must be auto-created.
+    """
+    if opdef.ndarray_inputs == "*":
+        return ["data"]
     if opdef.ndarray_inputs:
         return list(opdef.ndarray_inputs)
     names = []
@@ -150,7 +157,7 @@ class Symbol:
             return len(self.list_outputs())
         if self._index is not None:
             return 1
-        opdef = get_op(self._op)
+        opdef = getattr(self, "_opdef", None) or get_op(self._op)
         try:
             return opdef.n_out(coerce_kwargs(dict(self._attrs))) or 1
         except Exception:
@@ -224,6 +231,76 @@ class Symbol:
         return self._binop("broadcast_mul", -1.0)
 
     # ---------------------------------------------------------- inference
+    @property
+    def shape(self) -> tuple:
+        """Static output shape of this node, via the shared analysis engine.
+
+        Works when every upstream Variable carries a shape hint
+        (``Variable(name, shape=...)``) or is an auto-shaped parameter —
+        which makes shape-inspecting ``hybrid_forward`` code (``b, s, u =
+        x.shape``) traceable symbolically, like concrete shapes inside a
+        jax trace. Raises a node-attributed
+        :class:`~mxnet_tpu.base.GraphAnalysisError` when under-hinted.
+        """
+        from ..analysis.shape_infer import infer_graph
+
+        res = infer_graph(self, {}, collect=False, use_hint_cache=True)
+        base = self._base()
+        s = res.node_out.get(id(base))
+        if isinstance(s, list):
+            s = s[self._index or 0]
+        if s is None:
+            from ..base import GraphAnalysisError
+
+            raise GraphAnalysisError(
+                f"shape of {self.name!r} is not statically known; give the "
+                "input Variables shape hints (Variable(name, shape=...))",
+                node=self.name, op=self._op, rule_id="missing-shape")
+        return tuple(s)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    # ------------------------------------------------------ method aliases
+    # (mirror NDArray's method surface so F-generic hybrid_forward code —
+    # x.reshape(...), x.transpose(...) — traces symbolically too)
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _apply_op("reshape", [self], {"shape": shape, **kwargs})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _apply_op("transpose", [self], {"axes": axes or None})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def astype(self, dtype):
+        return _apply_op("cast", [self], {"dtype": str(np.dtype(dtype))})
+
+    def flatten(self):
+        return _apply_op("Flatten", [self], {})
+
+    def expand_dims(self, axis):
+        return _apply_op("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _apply_op("squeeze", [self], {"axis": axis})
+
+    def slice_axis(self, axis, begin, end):
+        return _apply_op("slice_axis", [self],
+                         {"axis": axis, "begin": begin, "end": end})
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _apply_op("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _apply_op("mean", [self], {"axis": axis, "keepdims": keepdims})
+
     def infer_shape(self, **kwargs):
         """Returns (arg_shapes, out_shapes, aux_shapes) (reference API).
         Parameter shapes are derived from data shapes like the reference's
@@ -235,23 +312,61 @@ class Symbol:
                 [shapes[a] for a in auxs])
 
     def infer_type(self, **kwargs):
+        """Returns (arg_types, out_types, aux_types). With enough shape
+        hints (Variable(shape=...) or prior infer), dtypes come from the
+        same eval_shape engine as infer_shape; otherwise the reference
+        default (everything float32) is reported. Failures raise a
+        node-attributed :class:`~mxnet_tpu.base.GraphAnalysisError`."""
         args = self.list_arguments()
+        auxs = self.list_auxiliary_states()
+        try:
+            from ..analysis.shape_infer import infer_graph
+
+            res = infer_graph(self, {}, known_dtypes=kwargs or None)
+            if all(d is not None for d in res.out_dtypes) and \
+                    all(a in res.dtypes for a in args):
+                np_t = lambda d: np.dtype(d).type  # noqa: E731
+                return ([np_t(res.dtypes[a]) for a in args],
+                        [np_t(d) for d in res.out_dtypes],
+                        [np_t(res.dtypes[a]) for a in auxs])
+        except ValueError as e:
+            # not enough shape hints -> reference default; a real graph
+            # inconsistency (shape-mismatch) propagates with attribution
+            if getattr(e, "rule_id", None) not in (None, "missing-shape"):
+                raise
         return ([np.float32] * len(args),
                 [np.float32] * len(self.list_outputs()),
-                [np.float32] * len(self.list_auxiliary_states()))
+                [np.float32] * len(auxs))
+
+    # ----------------------------------------------------------- analysis
+    def lint(self, shapes: Optional[Dict[str, tuple]] = None, passes=None,
+             **shape_kwargs):
+        """Run the static analyzer over this graph (no compilation).
+
+        Returns an :class:`mxnet_tpu.analysis.Report`. Pass input shapes
+        (``sym.lint(data=(2, 3, 32, 32))``) to enable the shape/dtype
+        pre-flight; without them only structural passes run. ``passes``
+        selects a subset by name (see ``mxnet_tpu.analysis.list_passes``).
+        """
+        from ..analysis import GraphLinter
+
+        return GraphLinter(passes=passes).lint(self, shapes=shapes,
+                                               **shape_kwargs)
 
     # ---------------------------------------------------------- execution
-    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+    def simple_bind(self, ctx=None, grad_req="write", lint=None, **shapes):
         from ..executor import Executor
 
-        return Executor(self, ctx=ctx, grad_req=grad_req, shapes=shapes)
+        return Executor(self, ctx=ctx, grad_req=grad_req, shapes=shapes,
+                        lint=lint)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, **kwargs):
+             aux_states=None, lint=None, **kwargs):
         from ..executor import Executor
 
         return Executor(self, ctx=ctx, grad_req=grad_req, args=args,
-                        args_grad=args_grad, aux_states=aux_states)
+                        args_grad=args_grad, aux_states=aux_states,
+                        lint=lint)
 
     def optimize_for(self, backend, args=None, aux=None, **kwargs):
         """Apply a registered graph pass (reference Symbol.optimize_for /
@@ -380,6 +495,23 @@ def _make_symbol_op(op_name: str):
     sym_op.__name__ = op_name
     sym_op.__doc__ = (opdef.fn.__doc__ or "") + f"\n\n(symbolic op {op_name!r})"
     return sym_op
+
+
+def invoke_fn(fn, inputs: Sequence[Symbol], kwargs=None,
+              num_outputs=1, name=None) -> Symbol:
+    """Symbolic counterpart of ``ndarray.invoke_fn``: splice an ad-hoc pure
+    function into the graph as one node.
+
+    The node carries its :class:`OpDef` inline (``_opdef``) instead of a
+    registry name, so the executor and the shape pre-flight evaluate it
+    like any other op. Such graphs are in-memory only: ``tojson()`` emits
+    the ``_invoke_fn`` placeholder, which cannot be loaded back.
+    """
+    node = _apply_op("_invoke_fn", list(inputs), dict(kwargs or {}),
+                     name=name)
+    node._opdef = OpDef("_invoke_fn", fn, num_outputs=num_outputs,
+                        ndarray_inputs="*")
+    return node
 
 
 def Variable(name: str, shape=None, dtype=None, init=None, **attrs) -> Symbol:
@@ -511,73 +643,11 @@ def infer_node_shapes(sym: Symbol, known: Dict[str, tuple]):
 
 
 def _infer_shapes_full(sym: Symbol, known: Dict[str, tuple]):
-    import jax
+    """Delegates to the shared analysis engine (analysis/shape_infer.py) so
+    infer_shape, the lint pre-flight, and print_summary can never disagree.
+    Failures raise a node-attributed GraphAnalysisError (a ValueError
+    subclass, so pre-existing handlers keep working)."""
+    from ..analysis.shape_infer import infer_graph
 
-    shapes: Dict[str, tuple] = {k: tuple(v) for k, v in known.items()}
-    node_out: Dict[int, Any] = {}  # node id -> shape or tuple of shapes
-
-    for node in sym._topo():
-        if node._op is None:
-            if node._name not in shapes and "__shape__" in node._attrs:
-                shapes[node._name] = tuple(node._attrs["__shape__"])
-            if node._name in shapes:
-                node_out[id(node)] = shapes[node._name]
-            continue
-        if node._op == "_group":
-            continue
-        opdef = get_op(node._op)
-        kwargs = coerce_kwargs({k2: v for k2, v in node._attrs.items()
-                                if not k2.startswith("__")})
-        input_names = op_input_names(opdef)
-        # primary input shape
-        primary = None
-        for i in node._inputs:
-            s = node_out.get(id(i._base()))
-            if s is not None:
-                if i._index is not None and isinstance(s, list):
-                    s = s[i._index]
-                primary = s
-                break
-        in_shapes = []
-        for pos, i in enumerate(node._inputs):
-            base = i._base()
-            s = node_out.get(id(base))
-            if s is not None and i._index is not None and isinstance(s, list):
-                s = s[i._index]
-            if s is None and base._op is None:
-                arg = input_names[pos] if pos < len(input_names) else None
-                s = _param_shape_rules(node._op, primary, kwargs, arg) \
-                    if primary is not None and arg else None
-                if s is None:
-                    raise ValueError(
-                        f"cannot infer shape of {base._name!r} (input "
-                        f"{arg!r} of {node._op}); provide it explicitly")
-                shapes[base._name] = tuple(s)
-                node_out[id(base)] = tuple(s)
-            in_shapes.append(s)
-        avals = [jax.ShapeDtypeStruct(s, np.float32) for s in in_shapes]
-        try:
-            out = jax.eval_shape(lambda *a: opdef.fn(*a, **kwargs), *avals)
-        except Exception as e:
-            raise ValueError(f"shape inference failed at {node._op} "
-                             f"({node._name}): {e}") from e
-        if isinstance(out, (list, tuple)):
-            node_out[id(node)] = [tuple(o.shape) for o in out]
-        else:
-            node_out[id(node)] = tuple(out.shape)
-
-    if sym._op == "_group":
-        heads = [(s._base(), s._index) for s in sym._inputs]
-    else:
-        heads = [(sym._base(), sym._index)]
-    out_shapes = []
-    for base, index in heads:
-        s = node_out[id(base)]
-        if isinstance(s, list):
-            if index is not None:
-                out_shapes.append(s[index])
-            else:
-                out_shapes.extend(s)
-        else:
-            out_shapes.append(s)
-    return shapes, out_shapes, node_out
+    res = infer_graph(sym, known, collect=False)
+    return res.shapes, res.out_shapes, res.node_out

@@ -18,13 +18,22 @@ def _sym_nodes(symbol):
 
 
 def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
-    """Layer-table summary of a Symbol graph (reference mx.viz.print_summary)."""
-    nodes, _ = _sym_nodes(symbol)
+    """Layer-table summary of a Symbol graph (reference mx.viz.print_summary).
+
+    Shapes come from the same analysis engine the linter and
+    ``Symbol.infer_shape`` use (``analysis/shape_infer.py``), so the table,
+    the lint report, and bind-time errors always agree — including per-op
+    output shapes, which the reference table also shows.
+    """
+    topo = symbol._topo()
+    node_shapes = {}
     shapes = {}
     if shape is not None:
-        arg_shapes, out_shapes, _aux = symbol.infer_shape(**shape)
-        arg_names = symbol.list_arguments()
-        shapes = dict(zip(arg_names, arg_shapes or []))
+        from .analysis.shape_infer import infer_graph
+
+        res = infer_graph(symbol, {k: tuple(v) for k, v in shape.items()})
+        shapes = res.shapes
+        node_shapes = {id(n): res.node_out.get(id(n)) for n in topo}
     positions = [int(line_length * p) for p in positions]
     header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
 
@@ -36,9 +45,9 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
 
     out = ["_" * line_length, line(header), "=" * line_length]
     total = 0
-    for n in nodes:
-        if n["op"] == "null":
-            name = n["name"]
+    for n in topo:
+        if n._op is None:
+            name = n._name
             cnt = 0
             shp = shapes.get(name, "")
             if name in shapes:
@@ -50,8 +59,13 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
                 total += cnt
                 out.append(line([f"{name} (Parameter)", shp, cnt, ""]))
             continue
-        prevs = ",".join(nodes[i[0]]["name"] for i in n["inputs"][:2])
-        out.append(line([f"{n['name']} ({n['op']})", "", 0, prevs]))
+        if n._op == "_group":
+            continue
+        out_shp = node_shapes.get(id(n), "")
+        if isinstance(out_shp, list):
+            out_shp = ", ".join(str(s) for s in out_shp)
+        prevs = ",".join(i._base()._name for i in n._inputs[:2])
+        out.append(line([f"{n._name} ({n._op})", out_shp or "", 0, prevs]))
     out.append("=" * line_length)
     out.append(f"Total params: {total}")
     out.append("_" * line_length)

@@ -20,6 +20,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis tests; run standalone via "
+        "`pytest -m lint` or `make lint-tests`")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     """Reference with_seed() decorator analog: seed numpy + framework RNG per

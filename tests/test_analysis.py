@@ -1,0 +1,485 @@
+"""mxnet_tpu.analysis — static analyzer tests.
+
+Every GraphLinter rule has a positive test (fires on a minimal bad graph)
+and the negative direction is covered by the model-zoo / models/ sweeps
+(zero error findings on real networks). TraceLinter, ShardingLinter, the
+bind-time integration, the structured infer_shape errors, print_summary
+consistency, and the CLI are covered below.
+"""
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.analysis import (Finding, GraphAnalysisError, GraphLinter,
+                                Report, Severity, ShardingLinter, TraceLinter,
+                                list_passes)
+from mxnet_tpu.base import GraphAnalysisError as BaseGraphAnalysisError
+from mxnet_tpu.module import Module
+
+pytestmark = pytest.mark.lint
+
+
+def _rules(report):
+    return {f.rule_id for f in report}
+
+
+def _mlp(hidden=8, classes=3):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+# ---------------------------------------------------------------------------
+# GraphLinter rules — positive (each fires on a minimal bad graph)
+# ---------------------------------------------------------------------------
+
+def test_rule_duplicate_name():
+    x = sym.Variable("data")
+    a = sym.relu(x, name="same")
+    b = sym.sigmoid(x, name="same")
+    rep = sym.Group([a, b]).lint()
+    assert "duplicate-name" in _rules(rep)
+    assert any(f.severity == Severity.ERROR for f in rep.by_rule("duplicate-name"))
+
+
+def test_rule_dead_node_and_unused_argument():
+    # serialize a two-head graph, then drop one head: its op becomes dead,
+    # and a variable consumed only by the dead op becomes unused
+    x = sym.Variable("data")
+    y = sym.Variable("other")
+    keep = sym.relu(x, name="keep")
+    dead = sym.broadcast_add(sym.sigmoid(y, name="dead_op"), keep,
+                             name="dead_add")
+    graph = json.loads(sym.Group([keep, dead]).tojson())
+    graph["heads"] = [graph["heads"][0]]
+    rep = GraphLinter().lint(graph)
+    assert "dead-node" in _rules(rep)
+    dead_names = {f.node for f in rep.by_rule("dead-node")}
+    assert {"dead_op", "dead_add"} <= dead_names
+    assert "keep" not in dead_names
+    # 'other' feeds only dead nodes -> unused in the live graph
+    assert {f.node for f in rep.by_rule("unused-argument")} == {"other"}
+
+
+def test_rule_unknown_op():
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "bogus_op_xyz", "name": "b", "inputs": [[0, 0, 0]]},
+        ],
+        "heads": [[1, 0, 0]],
+    }
+    rep = GraphLinter().lint(graph)
+    finding = rep.by_rule("unknown-op").findings[0]
+    assert finding.severity == Severity.ERROR
+    assert finding.op == "bogus_op_xyz"
+
+
+def test_rule_shape_mismatch_attributed():
+    s = _mlp()
+    rep = s.lint(data=(4,))  # rank-1 data cannot feed FullyConnected
+    errs = rep.by_rule("shape-mismatch").findings
+    assert errs and errs[0].node == "fc1" and errs[0].op == "FullyConnected"
+    # clean shapes -> clean report
+    assert not _mlp().lint(data=(4, 6)).findings
+
+
+def test_rule_missing_shape():
+    x = sym.Variable("data")
+    w = sym.Variable("w")  # dot has no auto-shape rule for its rhs
+    rep = sym.dot(x, w, name="d").lint(data=(2, 3))
+    assert "missing-shape" in _rules(rep)
+
+
+def test_rule_zero_size_reduction():
+    x = sym.Variable("data")
+    rep = sym.mean(x, axis=1, name="m").lint(data=(2, 0))
+    f = rep.by_rule("zero-size-reduction").findings[0]
+    assert f.severity == Severity.ERROR and f.node == "m"
+    # non-empty axis is fine
+    assert not sym.mean(x, axis=1).lint(data=(2, 3)).has_errors
+    # sum/prod have a well-defined identity on empty axes: NOT flagged
+    assert not sym.sum(x, axis=1).lint(data=(2, 0)).has_errors
+    assert not sym.prod(x, axis=1).lint(data=(2, 0)).has_errors
+
+
+def test_rule_nondiff_on_grad_path():
+    s = _mlp()
+    top = sym.argmax(s, axis=-1, name="pred")
+    rep = top.lint(data=(2, 6))
+    f = rep.by_rule("nondiff-on-grad-path").findings[0]
+    assert f.op == "argmax" and f.node == "pred"
+    # argmax over a raw input (no params upstream) is fine
+    assert not sym.argmax(sym.Variable("data"), axis=-1).lint(
+        data=(2, 6)).findings
+
+
+def test_rule_log_of_softmax():
+    x = sym.Variable("data")
+    bad = sym.log(sym.softmax(x, name="sm"), name="lg")
+    rep = bad.lint()
+    f = rep.by_rule("log-of-softmax").findings[0]
+    assert f.node == "lg" and f.severity == Severity.WARNING
+    # the stabilized idiom is clean
+    assert not sym.log_softmax(x).lint().findings
+
+
+def test_rule_exp_on_raw_input():
+    rep = sym.exp(sym.Variable("data"), name="e").lint()
+    assert "exp-on-raw-input" in _rules(rep)
+    # exp of a normalized intermediate is not flagged
+    assert not sym.exp(sym.log_softmax(sym.Variable("data"))).lint().findings
+
+
+def test_rule_high_fanout():
+    x = sym.relu(sym.Variable("data"), name="hub")
+    heads = [sym.sigmoid(x, name=f"c{i}") for i in range(9)]
+    rep = sym.Group(heads).lint()
+    f = rep.by_rule("high-fanout").findings[0]
+    assert f.node == "hub"
+    # configurable threshold
+    assert not GraphLinter(fanout_threshold=20).lint(
+        sym.Group(heads)).findings
+
+
+def test_pass_selection_and_disable():
+    x = sym.Variable("data")
+    bad = sym.log(sym.softmax(x, name="sm"), name="lg")
+    assert not GraphLinter(passes=["structure"]).lint(bad).findings
+    assert not GraphLinter(disable={"log-of-softmax"}).lint(bad).findings
+    with pytest.raises(ValueError, match="unknown lint passes"):
+        GraphLinter(passes=["nope"])
+    assert len(list_passes()) >= 6
+
+
+def test_report_api():
+    rep = Report([Finding("a", Severity.INFO, "m"),
+                  Finding("b", Severity.ERROR, "m", node="n", op="o")])
+    assert rep.has_errors and len(rep) == 2
+    assert rep.sorted().findings[0].rule_id == "b"
+    assert "1 error(s)" in rep.summary()
+    parsed = json.loads(rep.to_json())
+    assert parsed["findings"][1]["node"] == "n"
+    with pytest.raises(GraphAnalysisError) as ei:
+        rep.raise_if_errors()
+    assert ei.value.node == "n" and ei.value.rule_id == "b"
+
+
+# ---------------------------------------------------------------------------
+# bind-time integration
+# ---------------------------------------------------------------------------
+
+def test_bind_lint_error_rejects_bad_graph():
+    s = _mlp()
+    with pytest.raises(GraphAnalysisError) as ei:
+        s.simple_bind(grad_req="null", lint="error", data=(4,))
+    assert ei.value.node == "fc1"
+    assert "fc1" in str(ei.value)
+    # ValueError-compatible for pre-existing handlers
+    assert isinstance(ei.value, ValueError)
+
+
+def test_bind_lint_warn_and_off():
+    x = sym.Variable("data")
+    noisy = sym.log(sym.softmax(x, name="sm"), name="lg")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        exe = noisy.simple_bind(grad_req="null", lint="warn", data=(2, 3))
+    assert any("log-of-softmax" in str(x.message) for x in w)
+    out = exe.forward(data=nd.ones((2, 3)))
+    assert out[0].shape == (2, 3)
+    # default is off: bad graph binds without lint and report stays None
+    exe2 = _mlp().simple_bind(grad_req="null", data=(4, 6))
+    assert exe2.lint_report is None
+    with pytest.raises(ValueError, match="lint must be"):
+        _mlp().simple_bind(grad_req="null", lint="loud", data=(4, 6))
+
+
+def test_bind_lint_list_args():
+    # list-form args must reach the shape pre-flight too (not only dicts)
+    a, b = sym.Variable("a"), sym.Variable("b")
+    out = sym.dot(a, b, name="d")
+    with pytest.raises(GraphAnalysisError) as ei:
+        out.bind(args=[nd.ones((2, 3)), nd.ones((5, 7))], lint="error")
+    assert ei.value.node == "d"
+    exe = out.bind(args=[nd.ones((2, 3)), nd.ones((3, 7))], lint="error")
+    assert not exe.lint_report.has_errors
+
+
+def test_module_bind_lint():
+    mod = Module(_mlp(hidden=8, classes=3), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))], lint="error")
+    assert mod._exec.lint_report is not None
+    assert not mod._exec.lint_report.has_errors
+
+    bad = Module(_mlp(), context=mx.cpu())
+    with pytest.raises(GraphAnalysisError):
+        bad.bind(data_shapes=[("data", (4,))], lint="error")
+
+
+def test_symbol_lint_on_json_graph():
+    js = _mlp().tojson()
+    rep = GraphLinter().lint(js, shapes={"data": (4, 6)})
+    assert not rep.findings
+
+
+# ---------------------------------------------------------------------------
+# structured shape/type inference errors
+# ---------------------------------------------------------------------------
+
+def test_infer_shape_structured_error():
+    s = _mlp()
+    with pytest.raises(BaseGraphAnalysisError) as ei:
+        s.infer_shape(data=(4,))
+    e = ei.value
+    assert e.node == "fc1" and e.op == "FullyConnected"
+    assert e.rule_id == "shape-mismatch"
+    assert tuple(e.input_shapes[0]) == (4,)
+    assert "fc1" in str(e)
+    assert isinstance(e, ValueError)  # backward-compatible
+
+
+def test_infer_shape_missing_input_names_variable():
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    with pytest.raises(BaseGraphAnalysisError) as ei:
+        sym.dot(x, w).infer_shape(data=(2, 3))
+    assert ei.value.node == "w"
+
+
+def test_infer_type_from_hints():
+    x = sym.Variable("data", shape=(2, 3), dtype="float32")
+    s = sym.cast(x, dtype="float16", name="c")
+    _arg_t, out_t, _aux = s.infer_type()
+    assert out_t == [np.float16]
+
+
+# ---------------------------------------------------------------------------
+# TraceLinter
+# ---------------------------------------------------------------------------
+
+class _LeakyBlock(mx.gluon.HybridBlock):
+    def hybrid_forward(self, F, x):
+        scale = float(x.sum())  # concretization leak (flagged by source scan)
+        arr = x.asnumpy()  # ditto
+        return x * (scale + arr.shape[0])
+
+
+def test_trace_lint_concretization_leak():
+    rep = TraceLinter().lint(_LeakyBlock())
+    leaks = rep.by_rule("concretization-leak").findings
+    assert len(leaks) >= 2
+    assert all("test_analysis.py" in f.location for f in leaks)
+
+
+def test_trace_lint_clean_block():
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    assert not TraceLinter().lint(net).findings
+
+
+def test_trace_lint_weak_dtype_promotion():
+    net = mx.gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    rep = Report(TraceLinter().check_dtypes(
+        net, nd.ones((2, 3), dtype=np.float16)))
+    assert "weak-dtype-promotion" in _rules(rep)
+    assert not TraceLinter().check_dtypes(net, nd.ones((2, 3)))
+
+
+def test_trace_lint_retrace_churn():
+    net = mx.gluon.nn.Dense(2, in_units=3, flatten=False)
+    net.initialize()
+    net.hybridize()
+    with TraceLinter(retrace_threshold=3).watch(net) as tl:
+        for b in range(1, 6):  # 5 distinct input shapes -> 5 signatures
+            net(nd.ones((b, 3)))
+    rep = tl.report()
+    f = rep.by_rule("retrace-churn").findings[0]
+    assert "5 distinct jit signatures" in f.message
+    # steady shapes don't trip it
+    with TraceLinter(retrace_threshold=3).watch(net) as tl2:
+        for _ in range(5):
+            net(nd.ones((2, 3)))
+    assert not tl2.report().by_rule("retrace-churn").findings
+
+
+# ---------------------------------------------------------------------------
+# ShardingLinter
+# ---------------------------------------------------------------------------
+
+def _mesh_rules():
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel import ShardingRules, make_mesh
+
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    rules = ShardingRules([
+        (r"rank_bad", P("dp", "tp")),
+        (r"typo", P("zz")),
+        (r"ragged", P(None, "tp")),
+        (r"sharded", P("tp", None)),
+    ])
+    return mesh, rules
+
+
+def test_sharding_lint_rules():
+    mesh, rules = _mesh_rules()
+    linter = ShardingLinter(mesh, rules, large_param_threshold=1000)
+    rep = linter.lint({
+        "rank_bad_weight": (8,),        # spec rank 2 > param rank 1
+        "typo_weight": (8, 8),          # unknown mesh axis 'zz'
+        "ragged_weight": (8, 6),        # 6 % tp(4) != 0
+        "sharded_weight": (64, 64),     # properly sharded, large: clean
+        "plain_weight": (64, 64),       # replicated and large: flagged
+        "small_bias": (8,),             # replicated but tiny: clean
+    })
+    by_node = {f.node: f.rule_id for f in rep}
+    assert by_node["rank_bad_weight"] == "spec-rank-mismatch"
+    assert by_node["typo_weight"] == "unknown-mesh-axis"
+    assert by_node["ragged_weight"] == "indivisible-dim"
+    assert by_node["plain_weight"] == "replicated-large-param"
+    assert "sharded_weight" not in by_node and "small_bias" not in by_node
+    assert rep.by_rule("spec-rank-mismatch").findings[0].severity == \
+        Severity.ERROR
+
+
+def test_sharding_lint_params_iterable():
+    mesh, rules = _mesh_rules()
+    net = mx.gluon.nn.Dense(64, in_units=64)
+    net.initialize()
+    rep = ShardingLinter(mesh, rules, large_param_threshold=1000).lint_params(
+        net.collect_params().values())
+    assert "replicated-large-param" in _rules(rep)
+
+
+# ---------------------------------------------------------------------------
+# negative sweeps: real networks lint clean
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet18_v2", "mobilenet0.25", "mobilenetv2_0.25",
+    "squeezenet1.1", "alexnet", "vgg11_bn", "densenet121",
+])
+def test_model_zoo_lints_clean(name):
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    net = get_model(name, classes=10)
+    rep = net.lint(data=(1, 3, 224, 224))
+    assert not rep.errors, rep.format()
+    assert "not-symbolically-traceable" not in _rules(rep), rep.format()
+
+
+def test_models_transformer_lints_clean():
+    from mxnet_tpu.models.transformer import bert_tiny
+
+    rep = bert_tiny().lint(data=(2, 8))
+    assert not rep.errors, rep.format()
+    assert "not-symbolically-traceable" not in _rules(rep), rep.format()
+
+
+def test_models_seq2seq_lints_clean():
+    from mxnet_tpu.models.seq2seq import Seq2SeqTransformer
+
+    net = Seq2SeqTransformer(src_vocab=50, tgt_vocab=60, units=16,
+                             hidden_size=32, num_layers=1, num_heads=2,
+                             max_length=16, dropout=0.0)
+    rep = net.lint(src=(2, 5), tgt=(2, 6))
+    assert not rep.errors, rep.format()
+    assert "not-symbolically-traceable" not in _rules(rep), rep.format()
+
+
+def test_models_ssd_lints_clean():
+    from mxnet_tpu.models.ssd import ssd_300
+
+    rep = ssd_300(num_classes=3).lint(data=(1, 3, 64, 64))
+    assert not rep.errors, rep.format()
+    assert "not-symbolically-traceable" not in _rules(rep), rep.format()
+
+
+def test_models_still_run_eagerly():
+    """The F-generic rewrites (split over tensor-indexing, slice_axis)
+    keep the eager forward numerically sane."""
+    from mxnet_tpu.models.seq2seq import Seq2SeqTransformer
+
+    net = Seq2SeqTransformer(src_vocab=50, tgt_vocab=60, units=16,
+                             hidden_size=32, num_layers=1, num_heads=2,
+                             max_length=16, dropout=0.0)
+    net.initialize()
+    out = net(nd.array(np.ones((2, 5)), dtype=np.int32),
+              nd.array(np.ones((2, 6)), dtype=np.int32))
+    assert out.shape == (2, 6, 60)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+# ---------------------------------------------------------------------------
+# symbolic invoke_fn + Symbol.shape (the tracing substrate)
+# ---------------------------------------------------------------------------
+
+def test_symbol_shape_property():
+    x = sym.Variable("x", shape=(2, 3, 4))
+    assert x.shape == (2, 3, 4) and x.ndim == 3
+    y = x.reshape((2, 12)).transpose((1, 0))
+    assert y.shape == (12, 2)
+    with pytest.raises(BaseGraphAnalysisError):
+        _ = sym.Variable("nohint").shape
+
+
+def test_symbolic_invoke_fn_executes_and_lints():
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray.ndarray import invoke_fn
+
+    x = sym.Variable("x", shape=(2, 3))
+    w = invoke_fn(lambda a: jnp.tanh(a) * 2.0, [x * 1.0])
+    assert w.shape == (2, 3)
+    assert not w.lint(x=(2, 3)).findings  # inline OpDef is not unknown-op
+    exe = w.simple_bind(grad_req="null", x=(2, 3))
+    out = exe.forward(x=nd.ones((2, 3)))
+    np.testing.assert_allclose(out[0].asnumpy(), np.tanh(1.0) * 2.0,
+                               rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# print_summary shares the engine
+# ---------------------------------------------------------------------------
+
+def test_print_summary_matches_lint_shapes(capsys):
+    from mxnet_tpu.visualization import print_summary
+
+    s = _mlp(hidden=8, classes=3)
+    print_summary(s, shape={"data": (4, 6)})
+    table = capsys.readouterr().out
+    assert "(4, 8)" in table   # fc1 output shape appears per-op
+    assert "(4, 3)" in table   # fc2 output
+    # and a broken graph raises the same attributed error as infer_shape
+    with pytest.raises(BaseGraphAnalysisError):
+        print_summary(s, shape={"data": (4,)})
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_graph_lint(tmp_path):
+    from mxnet_tpu.analysis.cli import main
+
+    good = tmp_path / "good.json"
+    good.write_text(_mlp().tojson())
+    assert main([str(good), "--shape", "data=4,6"]) == 0
+    assert main([str(good), "--shape", "data=4"]) == 1
+    assert main(["--list-rules"]) == 0
+
+    bad = tmp_path / "unknown.json"
+    bad.write_text(json.dumps({
+        "nodes": [{"op": "null", "name": "data", "inputs": []},
+                  {"op": "bogus", "name": "b", "inputs": [[0, 0, 0]]}],
+        "heads": [[1, 0, 0]]}))
+    assert main([str(bad)]) == 1
+    assert main([str(bad), "--json", "--disable", "unknown-op"]) == 0

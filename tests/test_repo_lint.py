@@ -1,0 +1,97 @@
+"""Repo self-lint (tools/lint_repo.py): the framework's own source obeys
+the op-purity invariants, and each rule fires on a minimal violation."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis.repo_lint import lint_paths, lint_source
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule_id for f in findings}
+
+
+def test_mxnet_tpu_source_is_clean():
+    report = lint_paths([os.path.join(REPO, "mxnet_tpu")])
+    assert not report.findings, report.format()
+
+
+def test_rule_bare_except():
+    src = "try:\n    pass\nexcept:\n    pass\n"
+    assert _rules(lint_source(src)) == {"bare-except"}
+    ok = "try:\n    pass\nexcept Exception:\n    pass\n"
+    assert not lint_source(ok)
+
+
+def test_rule_op_missing_ndarray_inputs():
+    src = (
+        "from .registry import register\n"
+        "@register('myop')\n"
+        "def _myop(data, alpha=1.0):\n"
+        "    return data * alpha\n"
+    )
+    assert "op-missing-ndarray-inputs" in _rules(lint_source(src))
+    declared = src.replace("@register('myop')",
+                           "@register('myop', ndarray_inputs=['data'])")
+    assert not lint_source(declared)
+
+
+def test_rule_host_call_in_op():
+    src = (
+        "import numpy as np\n"
+        "from .registry import register\n"
+        "@register('myop', ndarray_inputs=['data'])\n"
+        "def _myop(data):\n"
+        "    return float(data) + np.asarray(data).sum() + data.item()\n"
+    )
+    findings = [f for f in lint_source(src)
+                if f.rule_id == "host-call-in-op"]
+    assert len(findings) == 3
+    # host call on a non-tensor kwarg is fine
+    ok = (
+        "from .registry import register\n"
+        "@register('myop', ndarray_inputs=['data'])\n"
+        "def _myop(data, alpha=1.0):\n"
+        "    return data * float(alpha)\n"
+    )
+    assert not lint_source(ok)
+
+
+def test_rule_suppression_comment():
+    src = (
+        "from .registry import register\n"
+        "@register('myop', ndarray_inputs=['data'])\n"
+        "def _myop(data):\n"
+        "    return float(data)  # lint: disable=host-call-in-op\n"
+    )
+    assert not lint_source(src)
+    other = src.replace("disable=host-call-in-op", "disable=bare-except")
+    assert lint_source(other)  # suppressing a different rule doesn't help
+
+
+def test_register_outside_op_registry_not_flagged():
+    # register() from an unrelated registry (e.g. mxnet_tpu.registry
+    # metric/initializer registration) must not demand ndarray_inputs
+    src = (
+        "from ..registry import register\n"
+        "@register('accuracy')\n"
+        "def _acc(labels, preds):\n"
+        "    return labels, preds\n"
+    )
+    assert not lint_source(src)
+
+
+def test_lint_repo_cli_entry():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_repo.py"),
+         os.path.join(REPO, "mxnet_tpu", "analysis")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Pre-flight lint for a serialized Symbol graph (no binding, no XLA).
+
+Thin launcher for ``python -m mxnet_tpu.analysis`` — see that module (and
+docs/ANALYSIS.md) for the pass/rule catalog::
+
+    python tools/graph_lint.py model-symbol.json --shape data=1,3,224,224
+    python tools/graph_lint.py --list-rules
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

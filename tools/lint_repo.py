@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Repo self-lint: framework invariants over mxnet_tpu/ source.
+
+Thin launcher for ``mxnet_tpu.analysis.repo_lint`` (rules: every registered
+op declares ndarray_inputs, no host calls on tensor inputs in op bodies, no
+bare ``except:``). Exit status 1 on findings::
+
+    python tools/lint_repo.py               # lint mxnet_tpu/
+    python tools/lint_repo.py path/to/file.py --json
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu.analysis.repo_lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
