@@ -1,10 +1,11 @@
 # Developer entry points. The analyzer targets are what CI / future PRs
-# should run before binding anything (docs/ANALYSIS.md).
+# should run before binding anything (docs/ANALYSIS.md); `make chaos` is the
+# fault-injection suite (docs/ROBUSTNESS.md).
 
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast
+.PHONY: lint lint-tests test test-fast chaos
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -20,3 +21,8 @@ test:
 
 test-fast: lint
 	$(PYTHON) -m pytest tests/test_analysis.py tests/test_repo_lint.py -q -p no:cacheprovider
+
+# fault-injection suite: SIGKILL/resume bitwise-resume proof, RPC drop/dup
+# exactly-once checks, CRC corruption fallback (docs/ROBUSTNESS.md)
+chaos:
+	$(PYTHON) -m pytest tests/ -q -m chaos -p no:cacheprovider

@@ -1,0 +1,21 @@
+"""Deterministic fault injection for robustness testing (``pytest -m chaos``).
+
+Two injector families, both env-gated so production code paths cost one dict
+lookup when chaos is off:
+
+- :mod:`mxnet_tpu.chaos.rpc` — drop / delay / duplicate parameter-server
+  RPCs at exact occurrence counts (``MXNET_CHAOS_RPC`` or programmatic
+  rules). Hooks live in ``kvstore/ps_client.py``.
+- :mod:`mxnet_tpu.chaos.proc` — SIGKILL the current process at named code
+  points (``MXNET_CHAOS_KILL``, e.g. the checkpoint writer mid-rename), and
+  helpers to run a training subprocess and kill it at a chosen step.
+
+Determinism is the point: a chaos test that flakes is worse than no test.
+Every injector fires on a counted occurrence of a named event, never on a
+timer or a random draw.
+"""
+from __future__ import annotations
+
+from . import proc, rpc
+
+__all__ = ["rpc", "proc"]

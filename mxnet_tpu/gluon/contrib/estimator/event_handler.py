@@ -188,8 +188,17 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
             self.logger.info(msg)
 
 
-class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
-    """Save params (+trainer states) per epoch; keep the best by monitor."""
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
+    """Save params (+trainer states) per epoch; keep the best by monitor.
+
+    Two layers (docs/ROBUSTNESS.md): the legacy per-tag ``.params`` /
+    ``.states`` files (now written atomically), and a full-training-state
+    :class:`~mxnet_tpu.checkpoint.CheckpointManager` under the same
+    directory — atomic rename commits, per-array CRC32, keep-last-N GC.
+    ``resume_from_checkpoint=True`` restores the newest *valid* full-state
+    checkpoint (net params + optimizer slots and counters + RNG streams) at
+    ``train_begin``; corrupt checkpoints are skipped.
+    """
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  verbose=0, save_best=False, mode="auto", epoch_period=1,
@@ -200,15 +209,52 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.save_best = save_best
         self.epoch_period = epoch_period
         self.batch_period = batch_period
+        self.resume_from_checkpoint = resume_from_checkpoint
         self.current_epoch = 0
         self.current_batch = 0
         self.saved = []
+        self.resumed_from = None
         if mode == "auto" and monitor is not None:
             name = monitor.get()[0]
             mode = "max" if "acc" in name or "f1" in name else "min"
         self._cmp = (np.greater if mode == "max" else np.less)
         self.best = -np.inf if mode == "max" else np.inf
         os.makedirs(model_dir, exist_ok=True)
+        from ....checkpoint import CheckpointManager
+
+        self._manager = CheckpointManager(model_dir, prefix=model_prefix,
+                                          keep_last=max_checkpoints)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if not self.resume_from_checkpoint:
+            return
+        from ....checkpoint.state import restore_rng
+        from ....ndarray import NDArray
+
+        state = self._manager.load_latest()
+        if state is None:
+            return
+        # structural names (as save_parameters uses), NOT p.name: the gluon
+        # auto-prefix counter differs in a fresh process, so dense0_weight
+        # would never match the restarted net's dense1_weight
+        params = estimator.net._collect_params_with_prefix()
+        for name, arr in state.arg_params().items():
+            if name in params:
+                p = params[name]
+                if p._data is None:
+                    p.shape = arr.shape
+                    p.initialize()
+                p.set_data(NDArray(arr))
+        if estimator.trainer is not None:
+            estimator.trainer.set_checkpoint_state(
+                {"arrays": state.arrays, "optimizer":
+                 state.meta.get("optimizer", {})})
+        restore_rng(state)
+        self.current_epoch = state.meta.get("epochs_done", 0)
+        self.current_batch = state.meta.get("batches_done", 0)
+        self.resumed_from = state.global_step
+        logging.info("CheckpointHandler: resumed from step %d "
+                     "(%d epochs done)", state.global_step, self.current_epoch)
 
     def _save(self, estimator, tag):
         path = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}.params")
@@ -220,20 +266,41 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             except Exception:
                 pass
 
+    def _save_full(self, estimator):
+        from ....checkpoint.state import capture_training_state
+
+        trainer = estimator.trainer
+        updater = trainer._updaters[0] if trainer is not None else None
+        optimizer = trainer._optimizer if trainer is not None else None
+        state = capture_training_state(
+            arg_params={name: p.data() for name, p in
+                        estimator.net._collect_params_with_prefix().items()
+                        if p._data is not None},
+            updater=updater, optimizer=optimizer,
+            global_step=self.current_batch,
+            extra_meta={"epochs_done": self.current_epoch,
+                        "batches_done": self.current_batch})
+        self._manager.save(state, self.current_batch)
+
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
         if self.batch_period and self.current_batch % self.batch_period == 0:
             self._save(estimator, f"batch{self.current_batch}")
+            self._save_full(estimator)
 
     def epoch_end(self, estimator, *args, **kwargs):
         self.current_epoch += 1
         if self.epoch_period and self.current_epoch % self.epoch_period == 0:
             self._save(estimator, f"epoch{self.current_epoch - 1}")
+            self._save_full(estimator)
         if self.save_best and self.monitor is not None:
             value = self.monitor.get()[1]
             if np.isscalar(value) and self._cmp(value, self.best):
                 self.best = value
                 self._save(estimator, "best")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self._manager.flush()  # drain the async writer before exit
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):

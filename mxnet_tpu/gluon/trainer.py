@@ -110,9 +110,29 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def save_states(self, fname):
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states())
+        from ..checkpoint.atomic import atomic_write_bytes
+
+        # atomic: a crash mid-save must not leave a truncated .states file
+        atomic_write_bytes(fname, self._updaters[0].get_states())
 
     def load_states(self, fname):
         with open(fname, "rb") as f:
             self._updaters[0].set_states(f.read())
+
+    # -- checkpoint/resume hooks (docs/ROBUSTNESS.md) ----------------------
+    def get_checkpoint_state(self):
+        """Full optimizer snapshot for a CheckpointManager: slot arrays plus
+        the scalar counters ``save_states`` loses (num_update and the
+        per-index counts that drive Adam/Nadam bias correction)."""
+        from ..checkpoint.state import capture_optimizer
+
+        arrays = {}
+        meta = capture_optimizer(self._updaters[0], self._optimizer, arrays)
+        return {"arrays": arrays, "optimizer": meta}
+
+    def set_checkpoint_state(self, state):
+        from ..checkpoint.state import TrainingState, restore_optimizer
+
+        restore_optimizer(self._updaters[0], self._optimizer,
+                          TrainingState(state["arrays"],
+                                        {"optimizer": state["optimizer"]}))

@@ -79,6 +79,18 @@ class DataIter:
     def getpad(self):
         return 0
 
+    # -- checkpoint/resume hooks (docs/ROBUSTNESS.md) ----------------------
+    def get_checkpoint_state(self):
+        """Snapshot of the iteration position for mid-epoch resume, or None
+        when this iterator cannot be positioned (the fit loop then only
+        checkpoints at epoch boundaries). Values must be JSON scalars or
+        numpy arrays."""
+        return None
+
+    def set_checkpoint_state(self, state):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support mid-epoch resume")
+
 
 def _shard(arr, part_index, num_parts):
     if num_parts <= 1:
@@ -156,6 +168,19 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size - self.num_data
         return 0
 
+    def get_checkpoint_state(self):
+        # cursor + shuffle order fully determine the remaining batches; the
+        # global numpy RNG (next epoch's reshuffle) is captured separately
+        # by the checkpoint RNG snapshot. The order MUST be copied: reset()
+        # reshuffles it in place, and the snapshot may sit on the async
+        # writer's queue across that
+        return {"cursor": int(self.cursor),
+                "order": np.array(self._order, np.int64)}
+
+    def set_checkpoint_state(self, state):
+        self.cursor = int(state["cursor"])
+        self._order = np.asarray(state["order"], np.int64)
+
 
 def _normalize(data, default_name) -> List:
     if data is None:
@@ -212,6 +237,12 @@ class CSVIter(DataIter):
     def next(self):
         return self._inner.next()
 
+    def get_checkpoint_state(self):
+        return self._inner.get_checkpoint_state()
+
+    def set_checkpoint_state(self, state):
+        self._inner.set_checkpoint_state(state)
+
 
 class MNISTIter(DataIter):
     """MNIST IDX file iterator (reference src/io/iter_mnist.cc analog)."""
@@ -244,6 +275,12 @@ class MNISTIter(DataIter):
 
     def next(self):
         return self._inner.next()
+
+    def get_checkpoint_state(self):
+        return self._inner.get_checkpoint_state()
+
+    def set_checkpoint_state(self, state):
+        self._inner.set_checkpoint_state(state)
 
 
 class ImageRecordIter(DataIter):

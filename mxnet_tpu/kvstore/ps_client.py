@@ -1,34 +1,45 @@
 """Worker-side client for the async parameter server (see ps_server.py).
 
-Failure handling (SURVEY.md §5.3): every RPC has a socket timeout and
-reconnect-retry with backoff, so a killed/restarted server looks like a slow
-RPC, not a worker crash. Retries give at-least-once semantics — a PUSH whose
-reply was lost may be applied twice, the same contract the reference's
-ps-lite resend path has.
+Failure handling (SURVEY.md §5.3, docs/ROBUSTNESS.md): every RPC has a
+socket timeout and reconnect-retry with capped exponential backoff + jitter,
+so a killed/restarted server looks like a slow RPC, not a worker crash.
+Mutating RPCs (dense AND sparse pushes) carry a ``(client_id, seq)`` token
+the server dedups on, and ``barrier()`` carries a barrier-epoch token —
+so the retry path is exactly-once end to end, strictly stronger than the
+reference ps-lite's at-least-once resend.
+
+Chaos hooks: ``mxnet_tpu.chaos.rpc`` can deterministically drop / delay /
+duplicate frames at the marked points below (one dict lookup when disabled).
 """
 from __future__ import annotations
 
 import os
+import random
 import socket
+import struct
 import threading
 import time
 
 import numpy as np
 
 from ..base import MXNetError
+from ..chaos import rpc as chaos_rpc
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
-                        OP_PUSH, OP_PUSH_SEQ, OP_PUSH_SPARSE, OP_SET_OPT,
-                        OP_SHUTDOWN, _pack_array, _pack_sparse, _recv_msg,
-                        _send_msg, _unpack_array)
+                        OP_PUSH, OP_PUSH_SEQ, OP_PUSH_SPARSE,
+                        OP_PUSH_SPARSE_SEQ, OP_SET_OPT, OP_SHUTDOWN,
+                        _pack_array, _pack_sparse, _recv_msg, _send_msg,
+                        _unpack_array)
 
 
 class PSClient:
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 retries: int = 5, retry_interval: float = 0.5):
+                 retries: int = 5, retry_interval: float = 0.5,
+                 retry_max_interval: float = 5.0):
         self._addr = (host, port)
         self._timeout = timeout
         self._retries = max(1, int(retries))
         self._retry_interval = retry_interval
+        self._retry_max_interval = retry_max_interval
         self._lock = threading.Lock()
         self._sock = None
         # exactly-once pushes: (client_id, seq) dedups server-side, so a
@@ -36,6 +47,9 @@ class PSClient:
         # than the reference ps-lite's at-least-once resend)
         self._client_id = int.from_bytes(os.urandom(8), "little")
         self._push_seq = 0  # guarded by _lock (allocated with the send)
+        # barrier idempotency: the epoch token lets the server count a
+        # retried arrival once, so a lost reply can't double-enter
+        self._barrier_epoch = 0
         self._connect()
 
     def _connect(self):
@@ -46,6 +60,14 @@ class PSClient:
                 pass
         self._sock = socket.create_connection(self._addr,
                                               timeout=self._timeout)
+
+    def _backoff(self, attempt: int) -> float:
+        """Capped exponential backoff with full-range jitter: attempt 0 →
+        ~interval, doubling up to retry_max_interval; jitter in [0.5, 1.0]×
+        decorrelates a worker fleet hammering a restarting server."""
+        delay = min(self._retry_max_interval,
+                    self._retry_interval * (2.0 ** attempt))
+        return delay * (0.5 + random.random() / 2.0)
 
     def _rpc(self, opcode, key="", payload=b"", timeout=None, retries=None):
         with self._lock:
@@ -63,8 +85,14 @@ class PSClient:
                     self._connect()
                 if timeout is not None:
                     self._sock.settimeout(timeout)
+                dup = chaos_rpc.on_send(opcode, key)
                 _send_msg(self._sock, opcode, key, payload)
+                if dup == "dup":  # chaos: duplicated frame on the wire
+                    _send_msg(self._sock, opcode, key, payload)
                 reply = _recv_msg(self._sock)
+                if dup == "dup":
+                    reply = _recv_msg(self._sock)  # drain the second reply
+                chaos_rpc.on_reply(opcode, key)
                 if timeout is not None:
                     self._sock.settimeout(self._timeout)
                 return reply
@@ -76,7 +104,7 @@ class PSClient:
                     except OSError:
                         pass
                     self._sock = None
-                time.sleep(self._retry_interval * (attempt + 1))
+                time.sleep(self._backoff(attempt))
         raise MXNetError(
             f"PS rpc op={opcode} key={key!r} failed after "
             f"{retries} attempts: {last_err}")
@@ -85,8 +113,6 @@ class PSClient:
         self._rpc(OP_INIT, key, _pack_array(np.ascontiguousarray(value)))
 
     def push(self, key: str, grad: np.ndarray, compressor=None):
-        import struct
-
         if compressor is not None:
             payload = compressor.pack_wire(key, np.ascontiguousarray(grad))
         else:
@@ -112,9 +138,15 @@ class PSClient:
     def push_row_sparse(self, key: str, indices: np.ndarray,
                         rows: np.ndarray):
         """Push only the touched rows (reference sparse ZPush: wire moves
-        len(indices) rows, not the full embedding matrix)."""
-        _, _, payload = self._rpc(OP_PUSH_SPARSE, key,
-                                  _pack_sparse(indices, rows))
+        len(indices) rows, not the full embedding matrix). Seq-tagged like
+        the dense path, so a retried sparse push applies exactly once."""
+        with self._lock:
+            self._push_seq += 1
+            seq = self._push_seq
+            _, _, payload = self._rpc_locked(
+                OP_PUSH_SPARSE_SEQ, key,
+                struct.pack("<QQ", self._client_id, seq)
+                + _pack_sparse(indices, rows))
         if bytes(payload[:1]) != b"\x00":
             raise MXNetError(
                 f"sparse push rejected for key {key!r} (bad dtype, "
@@ -145,11 +177,24 @@ class PSClient:
         spec = name + " " + " ".join(f"{k}={v}" for k, v in kwargs.items())
         self._rpc(OP_SET_OPT, "", spec.encode("ascii"))
 
-    def barrier(self):
-        # not idempotent (a lost reply would double-enter the barrier) and
-        # may legitimately block for the server's 60s straggler window
-        _, _, payload = self._rpc(OP_BARRIER, timeout=90.0, retries=1)
-        if bytes(payload[:1]) == b"\x01":
+    def barrier(self, timeout: float = 90.0):
+        """Idempotent rendezvous: the ``(client_id, barrier_epoch)`` token
+        lets the server count a retried arrival (lost reply) once, so the
+        full retry budget applies — the old ``retries=1`` special case that
+        turned one dropped ack into a training abort is gone. May
+        legitimately block for the server's straggler window."""
+        with self._lock:
+            # allocate-and-send in one critical section (like _push_seq):
+            # concurrent callers must not share a token, and the epoch
+            # advances even on failure — reusing the token at the NEXT
+            # rendezvous could match this round's released entry and skip
+            # the barrier entirely
+            epoch = self._barrier_epoch
+            self._barrier_epoch += 1
+            payload = struct.pack("<QQ", self._client_id, epoch)
+            _, _, reply = self._rpc_locked(OP_BARRIER, payload=payload,
+                                           timeout=timeout)
+        if bytes(reply[:1]) == b"\x01":
             raise TimeoutError(
                 "kvstore barrier timed out waiting for stragglers")
 

@@ -20,6 +20,7 @@ Wire format (little-endian):
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import socket
@@ -33,7 +34,7 @@ import numpy as np
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
 
 (OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
- OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ) = range(9)
+ OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ, OP_PUSH_SPARSE_SEQ) = range(10)
 
 
 def _pack_array(arr: np.ndarray) -> bytes:
@@ -118,7 +119,8 @@ class PSServer:
     ``updater(key, grad, weight)`` under the key's lock — no worker barrier.
     """
 
-    def __init__(self, host="0.0.0.0", port=9091, num_workers=1):
+    def __init__(self, host="0.0.0.0", port=9091, num_workers=1,
+                 barrier_timeout=60.0):
         self._weights: Dict[str, np.ndarray] = {}
         self._locks: Dict[str, threading.Lock] = {}
         self._updater = None
@@ -132,8 +134,15 @@ class PSServer:
         # per-key weight locks are not enough (mirrors the C++ seq_mu_).
         self._applied_seq: "OrderedDict" = OrderedDict()
         self._seq_lock = threading.Lock()
+        self._barrier_timeout = barrier_timeout  # straggler window (seconds)
         self._barrier_count = 0
         self._barrier_gen = 0
+        # idempotent barrier (docs/ROBUSTNESS.md): clients send a
+        # (client_id, barrier_epoch) token; the arrival SET dedups a
+        # retransmit within the round, and the released LRU acks a
+        # retransmit that arrives after the round completed.
+        self._barrier_arrived: Dict = {}
+        self._barrier_released: "OrderedDict" = OrderedDict()
         self._barrier_cv = threading.Condition()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -231,10 +240,7 @@ class PSServer:
                             # record only AFTER a successful apply, so a
                             # failed apply doesn't burn the seq
                             with self._seq_lock:
-                                self._applied_seq[(cid, key)] = seq
-                                self._applied_seq.move_to_end((cid, key))
-                                while len(self._applied_seq) > 65536:
-                                    self._applied_seq.popitem(last=False)
+                                self._record_seq(cid, key, seq)
                     _send_msg(conn, OP_PUSH_SEQ, key, b"\x00")
                 elif opcode == OP_PULL:
                     with self._locks.get(key, self._global_lock):
@@ -245,24 +251,28 @@ class PSServer:
                     # cross the wire; the server applies a row-sparse update.
                     # Same validation contract as the C++ twin: bad key /
                     # out-of-range or negative index → \x01, never corruption
-                    ok = False
-                    if key in self._weights:
-                        idx, rows = _unpack_sparse(payload)
-                        idx = idx.astype(np.int64)
-                        w = self._weights[key]
-                        if (idx.ndim == 1 and rows.shape[:1] == idx.shape
-                                and rows.shape[1:] == w.shape[1:]
-                                and idx.size > 0
-                                and 0 <= idx.min() and idx.max() < w.shape[0]):
-                            with self._locks[key]:
-                                if self._updater is not None:
-                                    grad = np.zeros_like(w)
-                                    np.add.at(grad, idx, rows.astype(w.dtype))
-                                    self._apply(key, grad, w)
-                                else:
-                                    np.add.at(w, idx, rows.astype(w.dtype))
-                            ok = True
+                    ok = self._apply_sparse(key, payload)
                     _send_msg(conn, OP_PUSH_SPARSE, key,
+                              b"\x00" if ok else b"\x01")
+                elif opcode == OP_PUSH_SPARSE_SEQ:
+                    # sparse twin of OP_PUSH_SEQ: (client_id, seq) prefix
+                    # dedups a retried frame so the row update applies
+                    # exactly once even when the ack was lost
+                    if key not in self._weights or len(payload) < 16:
+                        _send_msg(conn, OP_PUSH_SPARSE_SEQ, key, b"\x01")
+                        continue
+                    cid, seq = struct.unpack_from("<QQ", payload, 0)
+                    ok = True
+                    with self._locks[key]:
+                        with self._seq_lock:
+                            fresh = self._applied_seq.get((cid, key), -1) < seq
+                        if fresh:
+                            ok = self._apply_sparse(key, payload[16:],
+                                                    locked=True)
+                            if ok:  # a rejected frame must not burn the seq
+                                with self._seq_lock:
+                                    self._record_seq(cid, key, seq)
+                    _send_msg(conn, OP_PUSH_SPARSE_SEQ, key,
                               b"\x00" if ok else b"\x01")
                 elif opcode == OP_PULL_SPARSE:
                     reply = b""  # empty = failure, matching the C++ twin
@@ -280,34 +290,100 @@ class PSServer:
                     self._set_optimizer_bytes(bytes(payload))
                     _send_msg(conn, OP_SET_OPT, key, b"\x00")
                 elif opcode == OP_BARRIER:
-                    # generation-counted barrier: a straggler timeout rolls
-                    # its arrival back instead of poisoning the next round
-                    ok = True
-                    with self._barrier_cv:
-                        gen = self._barrier_gen
-                        self._barrier_count += 1
-                        if self._barrier_count >= self._num_workers:
-                            self._barrier_count = 0
-                            self._barrier_gen += 1
-                            self._barrier_cv.notify_all()
-                        else:
-                            deadline = time.monotonic() + 60
-                            while self._barrier_gen == gen:
-                                remaining = deadline - time.monotonic()
-                                if remaining <= 0:
-                                    self._barrier_count = max(
-                                        0, self._barrier_count - 1)
-                                    ok = False
-                                    break
-                                self._barrier_cv.wait(timeout=remaining)
                     _send_msg(conn, OP_BARRIER, key,
-                              b"\x00" if ok else b"\x01")
+                              b"\x00" if self._barrier(payload) else b"\x01")
                 elif opcode == OP_SHUTDOWN:
                     _send_msg(conn, OP_SHUTDOWN, key, b"\x00")
                     self.stop()
                     return
         except (ConnectionError, OSError):
             return
+
+    def _record_seq(self, cid, key, seq):
+        """Caller holds ``self._seq_lock``. LRU-bounded (client churn)."""
+        self._applied_seq[(cid, key)] = seq
+        self._applied_seq.move_to_end((cid, key))
+        while len(self._applied_seq) > 65536:
+            self._applied_seq.popitem(last=False)
+
+    def _apply_sparse(self, key, payload, locked=False) -> bool:
+        """Validate + apply a row-sparse push. Returns False (never corrupts)
+        on bad key / shape mismatch / out-of-range or negative index."""
+        if key not in self._weights:
+            return False
+        idx, rows = _unpack_sparse(payload)
+        idx = idx.astype(np.int64)
+        w = self._weights[key]
+        if not (idx.ndim == 1 and rows.shape[:1] == idx.shape
+                and rows.shape[1:] == w.shape[1:] and idx.size > 0
+                and 0 <= idx.min() and idx.max() < w.shape[0]):
+            return False
+        lock = self._locks[key] if not locked else contextlib.nullcontext()
+        with lock:
+            if self._updater is not None:
+                grad = np.zeros_like(w)
+                np.add.at(grad, idx, rows.astype(w.dtype))
+                self._apply(key, grad, w)
+            else:
+                np.add.at(w, idx, rows.astype(w.dtype))
+        return True
+
+    def _barrier(self, payload) -> bool:
+        """Generation-counted rendezvous; a straggler timeout rolls its
+        arrival back instead of poisoning the next round.
+
+        Idempotent when the client sends a (client_id, barrier_epoch) token
+        (16-byte payload): a retransmit within the round is counted once
+        (arrival keyed by token), and a retransmit that lands after the round
+        released — the lost-reply case — is acked immediately from the
+        released LRU instead of entering the next round. Tokenless legacy
+        frames fall back to plain arrival counting.
+        """
+        token = (struct.unpack_from("<QQ", payload, 0)
+                 if len(payload) >= 16 else None)
+        ok = True
+        with self._barrier_cv:
+            counted = True
+            if token is not None:
+                if token in self._barrier_released:
+                    return True  # round already completed; just re-ack
+                if token in self._barrier_arrived:
+                    # retransmit while the round is still gathering: wait for
+                    # the release the original arrival is counted toward
+                    gen = self._barrier_arrived[token]
+                    counted = False
+                else:
+                    gen = self._barrier_gen
+                    self._barrier_arrived[token] = gen
+                    self._barrier_count += 1
+            else:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+            if counted and self._barrier_count >= self._num_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                for tok in self._barrier_arrived:
+                    self._barrier_released[tok] = True
+                self._barrier_arrived.clear()
+                while len(self._barrier_released) > 65536:
+                    self._barrier_released.popitem(last=False)
+                self._barrier_cv.notify_all()
+            else:
+                deadline = time.monotonic() + self._barrier_timeout
+                while self._barrier_gen == gen:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        # roll back only an arrival THIS handler counted; a
+                        # timed-out retransmit must not erase the original's
+                        if counted:
+                            self._barrier_count = max(
+                                0, self._barrier_count - 1)
+                            if token is not None:
+                                self._barrier_arrived.pop(token, None)
+                        ok = False
+                        break
+                    self._barrier_cv.wait(timeout=remaining)
+        return ok
 
     def _set_optimizer_bytes(self, blob: bytes):
         """SET_OPT payload is text: ``name key=val key=val …`` — a format the

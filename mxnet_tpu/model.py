@@ -68,7 +68,10 @@ class FeedForward:
 
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             batch_end_callback=None, epoch_end_callback=None, logger=None,
-            **kwargs):
+            checkpoint=None, resume="auto", **kwargs):
+        """``checkpoint=`` (a directory or CheckpointManager) + the default
+        ``resume="auto"`` give the legacy API the same crash-safe
+        checkpointing contract as Module.fit (docs/ROBUSTNESS.md)."""
         from .io import NDArrayIter
 
         del logger  # accepted for signature parity; Module logs via logging
@@ -80,7 +83,8 @@ class FeedForward:
             arg_params=self.arg_params, aux_params=self.aux_params,
             num_epoch=self._num_epoch or 1,
             batch_end_callback=batch_end_callback,
-            epoch_end_callback=epoch_end_callback, **kwargs)
+            epoch_end_callback=epoch_end_callback,
+            checkpoint=checkpoint, resume=resume, **kwargs)
         self.arg_params, self.aux_params = self._module.get_params()
         self._fitted = True
         return self

@@ -189,8 +189,11 @@ class Module(BaseModule):
         arg, aux = self.get_params()
         save_checkpoint(prefix, epoch, self.symbol, arg, aux)
         if save_optimizer_states and self._updater is not None:
-            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
-                f.write(self._updater.get_states())
+            from ..checkpoint.atomic import atomic_write_bytes
+
+            # atomic: a crash mid-save must not leave truncated .states
+            atomic_write_bytes(f"{prefix}-{epoch:04d}.states",
+                               self._updater.get_states())
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):

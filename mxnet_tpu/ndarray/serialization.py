@@ -8,12 +8,21 @@ Apache MXNet 1.x format, TBV against a real ``.params`` file when available):
 
     file   := u64 list_magic(0x112) | u64 reserved(0)
               | u64 n_arrays | array*  | u64 n_names | dmlc_str*
+              | [u64 crc_magic | u32 crc32]          (optional footer)
     array  := u32 nd_magic | i32 stype | u32 ndim | i64*ndim shape
               | i32 dev_type | i32 dev_id | i32 type_flag | raw data
     dmlc_str := u64 len | bytes
 
 Dense arrays only (stype 0); sparse NDArrays are densified on save with a
 warning. ndim==0 encodes a "none" array (no context/dtype/data follow).
+
+Robustness extensions (docs/ROBUSTNESS.md): ``save_nd`` commits via
+temp-file + fsync + rename (a crashed save never leaves a half-written
+.params file) and appends a CRC32 footer over the whole container;
+``load_nd`` verifies the footer when present and rejects any other trailing
+bytes, so truncation and bit flips surface as a clean ``ValueError`` rather
+than silently corrupt weights. Reference-format files written by upstream
+MXNet (no footer) still load.
 """
 from __future__ import annotations
 
@@ -23,7 +32,11 @@ from typing import Dict, List, Union
 
 import numpy as np
 
+from ..checkpoint.atomic import atomic_write_bytes, crc32_bytes
+
 _LIST_MAGIC = 0x112
+_CRC_MAGIC = 0x314352435F544B43  # "CKT_CRC1" little-endian
+_CRC_FOOTER_LEN = 12  # u64 magic + u32 crc32
 # reference ndarray.cc: V1 = int64 TShape, V2 = +storage type, V3 = np-shape
 _ND_V1 = 0xF993FAC8
 _ND_V2 = 0xF993FAC9
@@ -116,8 +129,14 @@ def _read_array(r: _Reader) -> np.ndarray:
     return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
 
 
-def save_nd(fname: str, arrays: List[np.ndarray], names: List[str]) -> None:
-    """Write the reference list container. ``names`` may be empty (list save)."""
+def save_nd(fname: str, arrays: List[np.ndarray], names: List[str],
+            crc: bool = True, durable: bool = True) -> None:
+    """Write the reference list container. ``names`` may be empty (list save).
+
+    Crash-safe by default: the bytes are committed via temp-file + fsync +
+    rename, and a CRC32 footer covers the whole container (``crc=False``
+    reproduces the plain upstream byte layout for cross-version tests).
+    """
     out: List[bytes] = [struct.pack("<QQ", _LIST_MAGIC, 0),
                         struct.pack("<Q", len(arrays))]
     for a in arrays:
@@ -127,8 +146,10 @@ def save_nd(fname: str, arrays: List[np.ndarray], names: List[str]) -> None:
         b = n.encode("utf-8")
         out.append(struct.pack("<Q", len(b)))
         out.append(b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    body = b"".join(out)
+    if crc:
+        body += struct.pack("<QI", _CRC_MAGIC, crc32_bytes(body))
+    atomic_write_bytes(fname, body, durable=durable)
 
 
 def is_binary_nd(head: bytes) -> bool:
@@ -147,9 +168,31 @@ def load_nd(fname: str) -> Union[List[np.ndarray], Dict[str, np.ndarray]]:
         raise ValueError(f"implausible array count {n}")
     arrays = [_read_array(r) for _ in range(n)]
     (n_names,) = r.unpack("<Q")
-    if n_names == 0:
-        return arrays
-    if n_names != n:
+    if n_names not in (0, n):
         raise ValueError(f"{n} arrays but {n_names} names")
     names = [r.take(r.unpack("<Q")[0]).decode("utf-8") for _ in range(n_names)]
+    _verify_footer(buf, r.pos)
+    if n_names == 0:
+        return arrays
     return dict(zip(names, arrays))
+
+
+def _verify_footer(buf: bytes, end: int) -> None:
+    """Verify the optional CRC32 footer. Zero trailing bytes = legacy
+    (upstream) file, accepted; a valid footer must match; anything else is
+    truncation or corruption and is rejected."""
+    remaining = len(buf) - end
+    if remaining == 0:
+        return
+    if remaining != _CRC_FOOTER_LEN:
+        raise ValueError(
+            f"{remaining} unexpected trailing bytes (truncated file or "
+            "damaged CRC footer)")
+    magic, crc = struct.unpack_from("<QI", buf, end)
+    if magic != _CRC_MAGIC:
+        raise ValueError(f"bad CRC footer magic {magic:#x}")
+    actual = crc32_bytes(buf[:end])
+    if actual != crc:
+        raise ValueError(
+            f"CRC mismatch: footer {crc:#010x} != computed {actual:#010x} "
+            "(file is corrupt)")

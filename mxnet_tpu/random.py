@@ -21,7 +21,8 @@ import numpy as np
 
 from .base import get_env
 
-__all__ = ["seed", "next_key", "trace_key_scope", "get_state", "uniform", "normal",
+__all__ = ["seed", "next_key", "trace_key_scope", "get_state",
+           "get_state_data", "set_state_data", "uniform", "normal",
            "randint", "randn", "bernoulli", "gamma", "exponential", "poisson",
            "negative_binomial", "generalized_negative_binomial", "multinomial",
            "shuffle"]
@@ -90,6 +91,30 @@ class trace_key_scope:
 
 def get_state():
     return _root_key()
+
+
+def get_state_data():
+    """Serializable view of the global key stream (checkpoint capture):
+    the raw uint32 key data, or None when the stream was never seeded/used
+    (a resumed process will lazily seed exactly like a fresh one)."""
+    if _STATE.key is None:
+        return None
+    key = _STATE.key
+    try:
+        data = jax.random.key_data(key)
+    except (TypeError, AttributeError):  # already a raw uint32 key array
+        data = key
+    return np.asarray(data)
+
+
+def set_state_data(data) -> None:
+    """Restore the stream captured by :func:`get_state_data` (checkpoint
+    resume) — draws after this replay bit-identically."""
+    arr = np.asarray(data, np.uint32)
+    try:
+        _STATE.key = jax.random.wrap_key_data(arr)
+    except (TypeError, AttributeError):  # older jax: raw arrays are keys
+        _STATE.key = arr
 
 
 # ---------------------------------------------------------------------------

@@ -411,8 +411,11 @@ class Symbol:
                            "attrs": {"mxnet_version": ["int", 10900]}}, indent=2)
 
     def save(self, fname: str):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from ..checkpoint.atomic import atomic_write_bytes
+
+        # atomic: checkpoints pair this JSON with .params — a crash must
+        # not leave a truncated graph next to valid weights
+        atomic_write_bytes(fname, self.tojson().encode("utf-8"))
 
     def __repr__(self):
         if self._op is None:

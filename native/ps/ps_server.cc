@@ -23,8 +23,10 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <list>
 #include <map>
+#include <set>
 #include <mutex>
 #include <sstream>
 #include <string>
@@ -35,7 +37,7 @@ namespace {
 
 enum Op : uint8_t { INIT = 0, PUSH = 1, PULL = 2, SET_OPT = 3, BARRIER = 4,
                     SHUTDOWN = 5, PUSH_SPARSE = 6, PULL_SPARSE = 7,
-                    PUSH_SEQ = 8 };
+                    PUSH_SEQ = 8, PUSH_SPARSE_SEQ = 9 };
 
 struct Entry {
   std::vector<uint32_t> shape;
@@ -212,6 +214,36 @@ class Server {
           ok = ApplySparsePush(e, payload, payload_len);
         }
         SendMsg(conn, PUSH_SPARSE, key, std::string(ok ? "\x00" : "\x01", 1));
+      } else if (op == PUSH_SPARSE_SEQ) {
+        // sparse twin of PUSH_SEQ: u64 client_id | u64 seq | sparse payload;
+        // the (client_id, seq) dedup makes a retried row update exactly-once
+        Entry* e = GetEntry(key, false);
+        if (!e || payload_len < 16) {
+          SendMsg(conn, PUSH_SPARSE_SEQ, key, std::string("\x01", 1));
+          continue;
+        }
+        uint64_t cid, seq;
+        memcpy(&cid, payload, 8);
+        memcpy(&seq, payload + 8, 8);
+        bool ok = true;
+        {
+          std::lock_guard<std::mutex> lk(e->mu);
+          auto k = std::make_pair(cid, key);
+          bool fresh;
+          {
+            std::lock_guard<std::mutex> sl(seq_mu_);
+            fresh = SeqIsFresh(k, seq);
+          }
+          if (fresh) {
+            ok = ApplySparsePush(e, payload + 16, payload_len - 16);
+            if (ok) {  // a rejected frame must not burn the seq
+              std::lock_guard<std::mutex> sl(seq_mu_);
+              SeqRecord(k, seq);
+            }
+          }
+        }
+        SendMsg(conn, PUSH_SPARSE_SEQ, key,
+                std::string(ok ? "\x00" : "\x01", 1));
       } else if (op == PULL_SPARSE) {
         Entry* e = GetEntry(key, false);
         std::string out;
@@ -229,13 +261,51 @@ class Server {
         // Generation-counted barrier, matching the Python twin: a straggler
         // timeout rolls its arrival back (instead of poisoning the next
         // round) and replies \x01 so the client can surface the failure.
+        // Idempotent when the client sends a (client_id, barrier_epoch)
+        // token: a retransmit within the round is counted once, and a
+        // retransmit after the round released (lost reply) is re-acked
+        // from the released LRU instead of entering the next round.
         bool ok = true;
+        bool has_token = payload_len >= 16;
+        std::pair<uint64_t, uint64_t> token{0, 0};
+        if (has_token) {
+          memcpy(&token.first, payload, 8);
+          memcpy(&token.second, payload + 8, 8);
+        }
+        bool reack = false;
         {
           std::unique_lock<std::mutex> lk(barrier_mu_);
           uint64_t gen = barrier_gen_;
-          if (++barrier_count_ >= num_workers_) {
+          bool counted = true;
+          if (has_token) {
+            if (barrier_released_.count(token)) {
+              // re-ack AFTER the lock scope: a blocking write to a slow
+              // client must not stall every other worker's rendezvous
+              reack = true;
+            } else {
+              auto it = barrier_arrived_.find(token);
+              if (it != barrier_arrived_.end()) {
+                gen = it->second;  // retransmit mid-round: wait, don't recount
+                counted = false;
+              } else {
+                barrier_arrived_[token] = gen;
+              }
+            }
+          }
+          if (reack) {
+            // fall through to the post-lock SendMsg
+          } else if (counted && ++barrier_count_ >= num_workers_) {
             barrier_count_ = 0;
             ++barrier_gen_;
+            for (const auto& kv : barrier_arrived_) {
+              barrier_released_.insert(kv.first);
+              released_lru_.push_back(kv.first);
+            }
+            barrier_arrived_.clear();
+            while (released_lru_.size() > 65536) {
+              barrier_released_.erase(released_lru_.front());
+              released_lru_.pop_front();
+            }
             barrier_cv_.notify_all();
           } else {
             auto deadline =
@@ -243,7 +313,12 @@ class Server {
             while (barrier_gen_ == gen) {
               if (barrier_cv_.wait_until(lk, deadline) ==
                   std::cv_status::timeout && barrier_gen_ == gen) {
-                if (barrier_count_ > 0) --barrier_count_;
+                // roll back only an arrival THIS handler counted; a timed-out
+                // retransmit must not erase the original arrival
+                if (counted) {
+                  if (barrier_count_ > 0) --barrier_count_;
+                  if (has_token) barrier_arrived_.erase(token);
+                }
                 ok = false;
                 break;
               }
@@ -511,6 +586,11 @@ class Server {
   std::condition_variable barrier_cv_;
   int barrier_count_ = 0;
   uint64_t barrier_gen_ = 0;
+  // idempotent-barrier token state (barrier_mu_ guards all of it)
+  using BarrierToken = std::pair<uint64_t, uint64_t>;  // (client_id, epoch)
+  std::map<BarrierToken, uint64_t> barrier_arrived_;   // token -> gen
+  std::set<BarrierToken> barrier_released_;
+  std::deque<BarrierToken> released_lru_;
   // exactly-once dedup state, LRU-bounded (seq_mu_ guards all of it).
   // A plain ordered-map eviction would remove the smallest client_id —
   // possibly the entry just inserted — so recency order is kept explicitly.

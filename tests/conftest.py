@@ -26,6 +26,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: static-analysis tests; run standalone via "
         "`pytest -m lint` or `make lint-tests`")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (docs/ROBUSTNESS.md); run "
+        "via `pytest -m chaos` or `make chaos`. Fast chaos tests stay in "
+        "tier-1; subprocess SIGKILL ones are also marked slow")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,277 @@
+"""Fault-injection suite (``pytest -m chaos`` / ``make chaos``).
+
+Deterministic injectors from mxnet_tpu/chaos/ drive three proof obligations
+(docs/ROBUSTNESS.md):
+
+1. exactly-once PS mutations — dropped/duplicated RPC frames must not
+   double-apply gradients (dense AND sparse) or double-enter barriers;
+2. SIGKILL at an arbitrary step + ``resume="auto"`` reproduces the
+   uninterrupted run's final params bitwise on CPU (flagship, subprocess);
+3. a checkpoint writer killed mid-commit leaves only ignorable garbage
+   (see also the CRC fallback tests in test_checkpoint.py).
+
+Subprocess tests are additionally marked ``slow`` (tier-1 excludes slow);
+the in-process RPC tests are fast and ride in tier-1 too.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.chaos import rpc as chaos_rpc
+from mxnet_tpu.chaos.proc import run_to_completion, run_until_step
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS_KILL_TOOL = os.path.join(REPO, "tools", "chaos_kill.py")
+
+
+@pytest.fixture
+def ps_pair():
+    """A started PSServer + connected PSClient; chaos rules cleared around
+    each test so injected faults can't leak."""
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    chaos_rpc.reset()
+    srv = PSServer(host="127.0.0.1", port=0, num_workers=1)
+    srv.start()
+    cli = PSClient("127.0.0.1", srv.port, timeout=5, retries=6,
+                   retry_interval=0.05)
+    yield srv, cli
+    chaos_rpc.reset()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once pushes under injected connection faults (satellite)
+# ---------------------------------------------------------------------------
+
+def test_push_exactly_once_under_dropped_reply(ps_pair):
+    """Drop the PUSH_SEQ reply: the server HAS applied the gradient, the
+    client retries — the (client_id, seq) dedup must keep it applied exactly
+    once (w == 1, not 2)."""
+    srv, cli = ps_pair
+    cli.init("w", np.zeros(4, np.float32))
+    chaos_rpc.configure([chaos_rpc.Rule("push_seq", "drop_reply", {1})])
+    cli.push("w", np.ones(4, np.float32))
+    chaos_rpc.reset()
+    np.testing.assert_array_equal(cli.pull("w"), np.ones(4, np.float32))
+
+
+def test_push_exactly_once_under_dropped_request(ps_pair):
+    """Drop the request instead: the server never saw attempt 1, so the
+    retry is the first application — still exactly once."""
+    srv, cli = ps_pair
+    cli.init("w", np.zeros(4, np.float32))
+    chaos_rpc.configure([chaos_rpc.Rule("push_seq", "drop_request", {1})])
+    cli.push("w", np.ones(4, np.float32))
+    chaos_rpc.reset()
+    np.testing.assert_array_equal(cli.pull("w"), np.ones(4, np.float32))
+
+
+def test_push_exactly_once_under_duplicated_frame(ps_pair):
+    """A duplicating network sends the same frame twice back-to-back; the
+    second copy carries the same seq and must be acked without re-applying."""
+    srv, cli = ps_pair
+    cli.init("w", np.zeros(4, np.float32))
+    chaos_rpc.configure([chaos_rpc.Rule("push_seq", "dup", {1})])
+    cli.push("w", np.ones(4, np.float32))
+    chaos_rpc.reset()
+    np.testing.assert_array_equal(cli.pull("w"), np.ones(4, np.float32))
+
+
+def test_sparse_push_exactly_once_under_dropped_reply(ps_pair):
+    """The sparse path (PUSH_SPARSE_SEQ) carries the same (client_id, seq)
+    dedup: a retried row update lands exactly once."""
+    srv, cli = ps_pair
+    cli.init("emb", np.zeros((5, 3), np.float32))
+    chaos_rpc.configure([chaos_rpc.Rule("push_sparse_seq", "drop_reply", {1})])
+    cli.push_row_sparse("emb", np.array([1, 3], np.int32),
+                        np.ones((2, 3), np.float32))
+    chaos_rpc.reset()
+    out = cli.pull("emb")
+    expect = np.zeros((5, 3), np.float32)
+    expect[[1, 3]] = 1.0
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_sparse_push_exactly_once_under_duplicated_frame(ps_pair):
+    srv, cli = ps_pair
+    cli.init("emb", np.zeros((4, 2), np.float32))
+    chaos_rpc.configure([chaos_rpc.Rule("push_sparse_seq", "dup", {1})])
+    cli.push_row_sparse("emb", np.array([0, 0], np.int32),
+                        np.full((2, 2), 2.0, np.float32))
+    chaos_rpc.reset()
+    # duplicate indices within ONE push still accumulate (np.add.at), but
+    # the duplicated FRAME must not double that
+    expect = np.zeros((4, 2), np.float32)
+    expect[0] = 4.0
+    np.testing.assert_array_equal(cli.pull("emb"), expect)
+
+
+def test_interleaved_drops_converge_to_exact_sum(ps_pair):
+    """A lossy session: several pushes with replies dropped at assorted
+    occurrences — the final weight equals the exact sum of all gradients."""
+    srv, cli = ps_pair
+    cli.init("w", np.zeros(3, np.float32))
+    chaos_rpc.configure([
+        chaos_rpc.Rule("push_seq", "drop_reply", {2, 5}),
+        chaos_rpc.Rule("push_seq", "drop_request", {7}),
+    ])
+    total = np.zeros(3, np.float32)
+    for i in range(1, 6):
+        g = np.full(3, float(i), np.float32)
+        cli.push("w", g)
+        total += g
+    chaos_rpc.reset()
+    np.testing.assert_array_equal(cli.pull("w"), total)
+
+
+# ---------------------------------------------------------------------------
+# idempotent barrier (satellite)
+# ---------------------------------------------------------------------------
+
+def test_barrier_idempotent_under_dropped_reply(ps_pair):
+    """A lost barrier ack triggers a retry carrying the same epoch token;
+    the server re-acks from its released set instead of double-entering.
+    The follow-up barrier would hang (count leak) if the retry had been
+    counted as a second arrival."""
+    srv, cli = ps_pair
+    chaos_rpc.configure([chaos_rpc.Rule("barrier", "drop_reply", {1})])
+    cli.barrier(timeout=10.0)
+    chaos_rpc.reset()
+    cli.barrier(timeout=10.0)  # next round must still work
+    assert srv._barrier_count == 0
+
+
+def test_barrier_two_workers_with_lost_replies(ps_pair):
+    """Both workers lose their first barrier ack; both retries must be
+    deduped and round 2 must complete inside the straggler window."""
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv = PSServer(host="127.0.0.1", port=0, num_workers=2,
+                   barrier_timeout=15.0)
+    srv.start()
+    clients = [PSClient("127.0.0.1", srv.port, timeout=5, retries=6,
+                        retry_interval=0.05) for _ in range(2)]
+    # rules are process-wide; occurrence counters are thread-local, so each
+    # worker thread drops ITS first reply
+    chaos_rpc.configure([chaos_rpc.Rule("barrier", "drop_reply", {1})])
+    errs = []
+
+    def _rounds(cli):
+        try:
+            cli.barrier(timeout=20.0)
+            cli.barrier(timeout=20.0)
+        except Exception as e:  # surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=_rounds, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    chaos_rpc.reset()
+    srv.stop()
+    assert not errs, errs
+    assert srv._barrier_count == 0
+
+
+def test_rpc_rules_count_occurrences_independently():
+    """Two rules targeting the same (op, action) at different occurrences
+    must each see every matching event once — a shared counter would make
+    occurrence specs drift (the determinism contract)."""
+    from mxnet_tpu.kvstore.ps_server import OP_PUSH
+
+    chaos_rpc.configure([chaos_rpc.Rule("push", "dup", {1}),
+                         chaos_rpc.Rule("push", "dup", {3})])
+    try:
+        verdicts = [chaos_rpc.on_send(OP_PUSH, "k") for _ in range(4)]
+        assert verdicts == ["dup", None, "dup", None]
+    finally:
+        chaos_rpc.reset()
+
+
+# ---------------------------------------------------------------------------
+# kill points (process-level injection)
+# ---------------------------------------------------------------------------
+
+def test_kill_point_sigkills_at_occurrence():
+    code = (
+        "from mxnet_tpu.chaos.proc import kill_point\n"
+        "for i in range(5):\n"
+        "    kill_point('loop')\n"
+        "    print('survived', i, flush=True)\n"
+        "print('done', flush=True)\n")
+    env = dict(os.environ)
+    env["MXNET_CHAOS_KILL"] = "loop@3"
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, timeout=120)
+    assert out.returncode == -signal.SIGKILL
+    assert "survived 1" in out.stdout and "survived 2" not in out.stdout
+
+
+def test_kill_point_noop_when_unset():
+    from mxnet_tpu.chaos.proc import kill_point, reset_kill_points
+
+    old = os.environ.pop("MXNET_CHAOS_KILL", None)
+    reset_kill_points()
+    try:
+        for _ in range(3):
+            kill_point("anything")  # must be a cheap no-op
+    finally:
+        if old is not None:
+            os.environ["MXNET_CHAOS_KILL"] = old
+        reset_kill_points()
+
+
+# ---------------------------------------------------------------------------
+# flagship: SIGKILL mid-training, resume, bitwise identity (subprocess)
+# ---------------------------------------------------------------------------
+
+def _orchestrate(tmp_path, kill_at_step, chaos_kill=""):
+    cmd = [sys.executable, CHAOS_KILL_TOOL,
+           "--kill-at-step", str(kill_at_step),
+           "--ckpt-dir", str(tmp_path)]
+    if chaos_kill:
+        cmd += ["--chaos-kill", chaos_kill]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True, timeout=540)
+    return out.returncode, out.stdout
+
+
+@pytest.mark.slow
+def test_sigkill_mid_epoch_resume_bitwise(tmp_path):
+    """Acceptance flagship: SIGKILL at an arbitrary mid-epoch step, restart
+    with resume='auto', final params bitwise-identical to an uninterrupted
+    run (CPU, fixed seeds)."""
+    rc, out = _orchestrate(tmp_path, kill_at_step=7)
+    assert rc == 0 and "BITWISE MATCH" in out, out[-3000:]
+
+
+@pytest.mark.slow
+def test_sigkill_writer_mid_rename_resume_bitwise(tmp_path):
+    """Kill the checkpoint writer mid-commit (ckpt:pre_rename kill point) on
+    top of the step kill: the torn commit must be invisible and the run
+    still resumes bitwise from the previous valid checkpoint."""
+    rc, out = _orchestrate(tmp_path, kill_at_step=9,
+                           chaos_kill="ckpt:pre_rename@2")
+    assert rc == 0 and "BITWISE MATCH" in out, out[-3000:]
+
+
+@pytest.mark.slow
+def test_sigkill_before_first_checkpoint_resume_bitwise(tmp_path):
+    """Killed before anything committed: resume='auto' finds nothing and
+    restarts from scratch — still bitwise (determinism is end-to-end)."""
+    rc, out = _orchestrate(tmp_path, kill_at_step=1)
+    assert rc == 0 and "BITWISE MATCH" in out, out[-3000:]

@@ -1,0 +1,417 @@
+"""Crash-safe checkpointing (mxnet_tpu/checkpoint/ — docs/ROBUSTNESS.md):
+atomic commit protocol, CRC validation and corrupt-fallback, full
+training-state capture/restore, and bitwise split-vs-straight training
+through Module.fit(checkpoint=..., resume="auto")."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.checkpoint import CheckpointError, CheckpointManager
+from mxnet_tpu.checkpoint.atomic import atomic_write_bytes, crc32_bytes
+from mxnet_tpu.checkpoint.state import (TrainingState, capture_training_state,
+                                        restore_optimizer, restore_rng)
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+from mxnet_tpu.ndarray import serialization as ser
+
+
+# ---------------------------------------------------------------------------
+# serialization: atomic save + CRC footer (satellite)
+# ---------------------------------------------------------------------------
+
+def test_save_nd_crc_roundtrip(tmp_path):
+    path = str(tmp_path / "a.params")
+    arrs = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(3, np.float16)}
+    ser.save_nd(path, list(arrs.values()), list(arrs.keys()))
+    out = ser.load_nd(path)
+    for k, v in arrs.items():
+        np.testing.assert_array_equal(out[k], v)
+
+
+def test_load_nd_rejects_bit_flip(tmp_path):
+    from mxnet_tpu.chaos.proc import corrupt_file
+
+    path = str(tmp_path / "a.params")
+    ser.save_nd(path, [np.arange(8, dtype=np.float32)], ["w"])
+    corrupt_file(path, offset=60)  # inside the raw data block
+    with pytest.raises(ValueError, match="CRC mismatch"):
+        ser.load_nd(path)
+
+
+def test_load_nd_rejects_truncation(tmp_path):
+    path = str(tmp_path / "a.params")
+    ser.save_nd(path, [np.arange(8, dtype=np.float32)], ["w"])
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(data[:-5])  # torn write: mid-footer
+    with pytest.raises(ValueError):
+        ser.load_nd(path)
+
+
+def test_load_nd_accepts_legacy_no_footer(tmp_path):
+    path = str(tmp_path / "a.params")
+    arr = np.arange(8, dtype=np.float32)
+    ser.save_nd(path, [arr], ["w"], crc=False)  # upstream byte layout
+    np.testing.assert_array_equal(ser.load_nd(path)["w"], arr)
+
+
+def test_atomic_write_replaces_not_appends(tmp_path):
+    path = str(tmp_path / "f.bin")
+    atomic_write_bytes(path, b"a" * 100)
+    atomic_write_bytes(path, b"b" * 3)
+    with open(path, "rb") as f:
+        assert f.read() == b"bbb"
+    assert [e for e in os.listdir(tmp_path) if ".tmp-" in e] == []
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: commit, validate, GC, fallback
+# ---------------------------------------------------------------------------
+
+def _state(step, seed=0):
+    rng = np.random.RandomState(seed + step)
+    return TrainingState(
+        {"arg:w": rng.randn(4, 3).astype(np.float32),
+         "arg:b": rng.randn(3).astype(np.float32)},
+        {"format": 1, "global_step": step, "epoch": 0, "nbatch": step})
+
+
+def test_manager_save_load_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=0, async_write=False)
+    st = _state(5)
+    mgr.save(st, 5)
+    out = mgr.load(5)
+    assert out.global_step == 5
+    np.testing.assert_array_equal(out.arrays["arg:w"], st.arrays["arg:w"])
+    assert out.arg_params().keys() == {"w", "b"}
+
+
+def test_manager_keep_last_gc(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=2, async_write=False)
+    for s in (1, 2, 3, 4):
+        mgr.save(_state(s), s)
+    assert mgr.list_steps() == [3, 4]
+
+
+def test_manager_async_writer_flush(tmp_path):
+    with CheckpointManager(str(tmp_path), keep_last=0) as mgr:
+        for s in (1, 2, 3):
+            mgr.save(_state(s), s)
+        mgr.flush()
+        assert mgr.list_steps() == [1, 2, 3]
+        assert mgr.load_latest().global_step == 3
+
+
+def test_manager_sweeps_stale_staging(tmp_path):
+    stale = tmp_path / ".ckpt-00000009.tmp-12345"
+    stale.mkdir()
+    (stale / "arrays.bin").write_bytes(b"partial")
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    assert not stale.exists()
+    assert mgr.list_steps() == []
+
+
+@pytest.mark.chaos
+def test_manager_corrupt_newest_falls_back(tmp_path):
+    """Acceptance: a bit-flipped newest checkpoint is detected via CRC and
+    skipped in favor of the previous valid one."""
+    from mxnet_tpu.chaos.proc import corrupt_file
+
+    mgr = CheckpointManager(str(tmp_path), keep_last=0, async_write=False)
+    mgr.save(_state(1), 1)
+    mgr.save(_state(2), 2)
+    corrupt_file(str(tmp_path / "ckpt-00000002" / "arrays.bin"), offset=60)
+    with pytest.raises(CheckpointError):
+        mgr.validate(2)
+    st = mgr.load_latest()
+    assert st is not None and st.global_step == 1
+
+
+@pytest.mark.chaos
+def test_manager_truncated_newest_falls_back(tmp_path):
+    """Acceptance: a torn (truncated) arrays.bin fails validation and the
+    previous checkpoint is used instead."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=0, async_write=False)
+    mgr.save(_state(1), 1)
+    mgr.save(_state(2), 2)
+    arrays = tmp_path / "ckpt-00000002" / "arrays.bin"
+    arrays.write_bytes(arrays.read_bytes()[:37])
+    st = mgr.load_latest()
+    assert st is not None and st.global_step == 1
+
+
+@pytest.mark.chaos
+def test_manager_missing_manifest_falls_back(tmp_path):
+    """A crash between arrays.bin and manifest.json (the ckpt:post_arrays
+    kill point) must leave an ignorable checkpoint."""
+    mgr = CheckpointManager(str(tmp_path), keep_last=0, async_write=False)
+    mgr.save(_state(1), 1)
+    mgr.save(_state(2), 2)
+    os.unlink(tmp_path / "ckpt-00000002" / "manifest.json")
+    st = mgr.load_latest()
+    assert st is not None and st.global_step == 1
+
+
+def test_manager_all_invalid_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    assert mgr.load_latest() is None
+    mgr.save(_state(1), 1)
+    os.unlink(tmp_path / "ckpt-00000001" / "manifest.json")
+    assert mgr.load_latest() is None
+
+
+def test_manager_reuse_clears_preempted(tmp_path):
+    """A caller-supplied manager reused across fits must not carry a stale
+    preemption flag into the next fit (which would abort it after one
+    batch, looking like a completed run)."""
+    mgr = CheckpointManager(str(tmp_path), async_write=False)
+    mgr.preempted.set()
+    mgr.install_signal_handlers()
+    try:
+        assert not mgr.preempted.is_set()
+    finally:
+        mgr.restore_signal_handlers()
+
+
+def test_atomic_write_respects_umask(tmp_path):
+    """mkstemp creates 0600; the committed file must get the umask-derived
+    mode a plain open() would have produced."""
+    path = str(tmp_path / "m.bin")
+    old = os.umask(0o022)
+    try:
+        atomic_write_bytes(path, b"x")
+    finally:
+        os.umask(old)
+    assert (os.stat(path).st_mode & 0o777) == 0o644
+
+
+def test_manager_background_write_error_surfaces(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=0)
+    bad = TrainingState({"arg:w": np.ones(2, np.float32)}, {"format": 1})
+    bad.meta["unjsonable"] = object()  # manifest json.dumps will fail
+    mgr.save(bad, 1)
+    with pytest.raises(CheckpointError):
+        mgr.flush()
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# training-state capture/restore pieces
+# ---------------------------------------------------------------------------
+
+def test_optimizer_state_roundtrip():
+    from mxnet_tpu.ndarray import array
+    from mxnet_tpu.optimizer import create as opt_create
+    from mxnet_tpu.optimizer.optimizer import Updater
+
+    opt = opt_create("adam", learning_rate=0.01)
+    upd = Updater(opt)
+    w = array(np.ones((3, 2), np.float32))
+    for _ in range(3):
+        upd(0, array(np.full((3, 2), 0.1, np.float32)), w)
+    st = capture_training_state(updater=upd, optimizer=opt)
+
+    opt2 = opt_create("adam", learning_rate=0.01)
+    upd2 = Updater(opt2)
+    restore_optimizer(upd2, opt2, st)
+    assert opt2.num_update == opt.num_update
+    assert opt2._index_update_count == opt._index_update_count
+    m1, v1 = upd.states[0][0].asnumpy(), upd.states[0][1].asnumpy()
+    m2, v2 = upd2.states[0][0].asnumpy(), upd2.states[0][1].asnumpy()
+    np.testing.assert_array_equal(m1, m2)
+    np.testing.assert_array_equal(v1, v2)
+
+    # bitwise: the next update must match on both replicas
+    w2 = array(w.asnumpy())
+    g = array(np.full((3, 2), 0.2, np.float32))
+    upd(0, g, w)
+    upd2(0, g, w2)
+    np.testing.assert_array_equal(w.asnumpy(), w2.asnumpy())
+
+
+def test_rng_state_roundtrip():
+    np.random.seed(11)
+    mx.random.seed(11)
+    mx.random.uniform(shape=(2,))  # advance the key stream
+    np.random.rand(3)              # advance the MT stream
+    st = capture_training_state()
+
+    a1 = np.random.rand(4)
+    k1 = mx.random.uniform(shape=(3,)).asnumpy()
+
+    np.random.seed(999)  # scramble, then restore
+    mx.random.seed(999)
+    restore_rng(st)
+    np.testing.assert_array_equal(np.random.rand(4), a1)
+    np.testing.assert_array_equal(mx.random.uniform(shape=(3,)).asnumpy(), k1)
+
+
+def test_iterator_state_roundtrip():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(X, y, batch_size=2, shuffle=True)
+    it.reset()
+    next(it)
+    next(it)
+    st = capture_training_state(train_data=it)
+    remaining1 = [b.data[0].asnumpy() for b in it]
+
+    it2 = NDArrayIter(X, y, batch_size=2, shuffle=True)
+    from mxnet_tpu.checkpoint.state import restore_iterator
+
+    assert restore_iterator(it2, st)
+    remaining2 = [b.data[0].asnumpy() for b in it2]
+    assert len(remaining1) == len(remaining2) == 3
+    for a, b in zip(remaining1, remaining2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_trainer_checkpoint_state_roundtrip():
+    from mxnet_tpu import gluon, nd
+
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    with mx.autograd.record():
+        loss = net(nd.ones((2, 4))).sum()
+    loss.backward()
+    tr.step(2)
+    st = tr.get_checkpoint_state()
+
+    net2 = gluon.nn.Dense(3)
+    net2.initialize()
+    net2(nd.ones((2, 4)))
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+    tr2.set_checkpoint_state(st)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+    for k, s in tr._updaters[0].states.items():
+        s2 = tr2._updaters[0].states[k]
+        np.testing.assert_array_equal(_leaf(s), _leaf(s2))
+
+
+def _leaf(s):
+    while isinstance(s, tuple):
+        s = s[0]
+    return s.asnumpy()
+
+
+# ---------------------------------------------------------------------------
+# Module.fit integration: split run == straight run, bitwise
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fit(num_epoch, ckpt=None, resume="never", seed=33):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    rng = np.random.RandomState(4321)
+    X = rng.randn(32, 6).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=8, shuffle=True)
+    mod = Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            checkpoint=ckpt, resume=resume, checkpoint_batch_period=3)
+    return mod.get_params()[0]
+
+
+def test_fit_split_training_bitwise(tmp_path):
+    """2 epochs + resume for 2 more == 4 straight epochs, bit-for-bit: the
+    checkpoint captures everything that matters (params, momentum, counters,
+    RNG streams, iterator order)."""
+    straight = _fit(4)
+    _fit(2, ckpt=str(tmp_path), resume="auto")  # writes checkpoints
+    resumed = _fit(4, ckpt=str(tmp_path), resume="auto")
+    assert straight.keys() == resumed.keys()
+    for n in straight:
+        np.testing.assert_array_equal(straight[n].asnumpy(),
+                                      resumed[n].asnumpy(), err_msg=n)
+
+
+def test_fit_resume_never_ignores_checkpoints(tmp_path):
+    _fit(2, ckpt=str(tmp_path), resume="auto")
+    p1 = _fit(1, ckpt=None)
+    p2 = _fit(1, ckpt=str(tmp_path), resume="never")
+    for n in p1:
+        np.testing.assert_array_equal(p1[n].asnumpy(), p2[n].asnumpy())
+
+
+def test_fit_resume_pinned_step(tmp_path):
+    _fit(2, ckpt=str(tmp_path), resume="auto")
+    mgr = CheckpointManager(str(tmp_path))
+    steps = mgr.list_steps()
+    assert steps, "expected committed checkpoints"
+    st = mgr.load(steps[0])
+    assert st.global_step == steps[0]
+
+
+def test_estimator_checkpoint_resume_fresh_net(tmp_path):
+    """CheckpointHandler(resume_from_checkpoint=True) must restore into a
+    FRESH net instance — structural param names, not gluon's auto-prefixed
+    p.name (dense0_weight vs the restarted process's dense1_weight)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import \
+        CheckpointHandler
+
+    np.random.seed(3)
+    mx.random.seed(3)
+    X = np.random.randn(40, 6).astype(np.float32)
+    y = np.random.randint(0, 3, 40).astype(np.float32)
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, y),
+                                   batch_size=8)
+
+    def make():
+        np.random.seed(3)
+        mx.random.seed(3)
+        net = gluon.nn.Dense(3)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        return net, Estimator(net, loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                              trainer=tr)
+
+    net1, est1 = make()
+    est1.fit(train_data=loader, epochs=2,
+             event_handlers=[CheckpointHandler(str(tmp_path), batch_period=3)])
+    p1 = {k: p.data().asnumpy()
+          for k, p in net1._collect_params_with_prefix().items()}
+
+    net2, est2 = make()  # fresh instance: different auto-prefix
+    h = CheckpointHandler(str(tmp_path), resume_from_checkpoint=True)
+    h.train_begin(est2)
+    assert h.resumed_from is not None
+    p2 = {k: p.data().asnumpy()
+          for k, p in net2._collect_params_with_prefix().items()}
+    assert p1.keys() == p2.keys()
+    for k in p1:
+        np.testing.assert_array_equal(p1[k], p2[k], err_msg=k)
+    for k, s in est1.trainer._updaters[0].states.items():
+        np.testing.assert_array_equal(
+            _leaf(s), _leaf(est2.trainer._updaters[0].states[k]))
+
+
+def test_feedforward_fit_checkpoint(tmp_path):
+    from mxnet_tpu.model import FeedForward
+
+    np.random.seed(7)
+    mx.random.seed(7)
+    X = np.random.randn(32, 6).astype(np.float32)
+    y = np.random.randint(0, 4, 32).astype(np.float32)
+    ff = FeedForward(_mlp(), num_epoch=2)
+    ff.fit(X, y, checkpoint=str(tmp_path), resume="never")
+    assert CheckpointManager(str(tmp_path)).list_steps()

@@ -22,7 +22,7 @@ import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.ndarray import ndarray as nd_mod
 assert nd_mod._MX_SYNC, "MX_SYNC env not honored"
-a = nd.array(np.arange(6, np.float32).reshape(2, 3)) if False else nd.array(np.arange(6).astype(np.float32).reshape(2, 3))
+a = nd.array(np.arange(6).astype(np.float32).reshape(2, 3))
 b = (a * 2 + 1).sum()
 assert float(b.asnumpy()) == 36.0, float(b.asnumpy())
 print("MX_SYNC OK")
@@ -78,6 +78,59 @@ def test_ps_client_survives_server_restart():
         np.testing.assert_allclose(cli.pull("w"), np.full(4, 3.0))
     finally:
         srv2.stop()
+
+
+@pytest.mark.chaos
+def test_ps_client_survives_restart_during_inflight_pull():
+    """Restart the server *during* an in-flight pull: a chaos delay rule
+    holds the PULL frame on the wire while another thread kills and restarts
+    the server, so the client's socket dies mid-RPC and the retry path must
+    reconnect and complete against the new process."""
+    import threading
+
+    from mxnet_tpu.chaos import rpc as chaos_rpc
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv = PSServer(host="127.0.0.1", port=0, num_workers=1)
+    srv.start()
+    port = srv.port
+    cli = PSClient("127.0.0.1", port, timeout=5, retries=8,
+                   retry_interval=0.2)
+    cli.init("w", np.full(4, 7.0, np.float32))
+
+    srv2_box = {}
+
+    def _restart():
+        time.sleep(0.4)  # lands inside the delayed pull's 1.2s window
+        srv.stop()
+        srv2 = None
+        for _ in range(40):  # the old listener's port can linger briefly
+            try:
+                srv2 = PSServer(host="127.0.0.1", port=port, num_workers=1)
+                break
+            except OSError:
+                time.sleep(0.25)
+        assert srv2 is not None, "could not rebind PS port after restart"
+        srv2.start()
+        srv2_box["srv"] = srv2
+        # re-seed state lost with the old process, so the retried pull
+        # has something to fetch from the replacement server
+        seeder = PSClient("127.0.0.1", port, timeout=5, retries=8,
+                          retry_interval=0.2)
+        seeder.init("w", np.full(4, 7.0, np.float32))
+
+    chaos_rpc.configure([chaos_rpc.Rule("pull", "delay", {1}, seconds=1.2)])
+    t = threading.Thread(target=_restart)
+    t.start()
+    try:
+        out = cli.pull("w")  # 1st attempt dies mid-flight; retry succeeds
+        np.testing.assert_allclose(out, np.full(4, 7.0))
+    finally:
+        chaos_rpc.reset()
+        t.join()
+        if "srv" in srv2_box:
+            srv2_box["srv"].stop()
 
 
 def test_ps_client_fails_loudly_when_server_gone():

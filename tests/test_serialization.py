@@ -17,9 +17,6 @@ from mxnet_tpu.ndarray import serialization as ser
 def test_golden_bytes(tmp_path):
     arr = np.arange(6, dtype=np.float32).reshape(2, 3)
     path = str(tmp_path / "g.params")
-    ser.save_nd(path, [arr], ["w"])
-    with open(path, "rb") as f:
-        got = f.read()
     expect = b"".join([
         struct.pack("<QQ", 0x112, 0),          # list magic, reserved
         struct.pack("<Q", 1),                  # n arrays
@@ -32,7 +29,17 @@ def test_golden_bytes(tmp_path):
         struct.pack("<Q", 1),                  # n names
         struct.pack("<Q", 1), b"w",
     ])
-    assert got == expect
+    # crc=False reproduces the upstream byte layout exactly
+    ser.save_nd(path, [arr], ["w"], crc=False)
+    with open(path, "rb") as f:
+        assert f.read() == expect
+    # the default appends only the 12-byte CRC footer after the same bytes
+    ser.save_nd(path, [arr], ["w"])
+    with open(path, "rb") as f:
+        got = f.read()
+    assert got[:len(expect)] == expect
+    assert got[len(expect):] == struct.pack(
+        "<QI", ser._CRC_MAGIC, ser.crc32_bytes(expect))
 
 
 @pytest.mark.parametrize("dtype", [np.float32, np.float64, np.float16,

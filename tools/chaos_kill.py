@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Kill-and-resume harness: prove a SIGKILL at any step costs nothing.
+
+Orchestrator mode (default) runs the same tiny deterministic training job
+three ways and diffs the final parameters byte-for-byte:
+
+  1. reference:  uninterrupted run to completion
+  2. killed:     SIGKILL delivered the moment ``CHAOS_STEP <n>`` reaches
+                 --kill-at-step (exactly how a preempted VM vanishes)
+  3. resumed:    same checkpoint dir, ``resume="auto"`` — restarts from the
+                 newest *valid* checkpoint and runs to completion
+
+Because checkpoints capture params + optimizer slots/counters + RNG streams
++ iterator cursor, the resumed run must be bitwise identical to the
+reference on CPU — any drift means checkpoint capture is incomplete.
+
+  python tools/chaos_kill.py --kill-at-step 7
+  python tools/chaos_kill.py --kill-at-step 3 --chaos-kill ckpt:pre_rename@2
+
+``--chaos-kill`` forwards MXNET_CHAOS_KILL to the victim, e.g. to die
+mid-rename inside the checkpoint writer on top of the step kill.
+
+Worker mode (``--train``) is the training job itself: a fixed-seed MLP on
+synthetic data through ``Module.fit`` with crash-safe checkpointing. It
+prints ``CHAOS_STEP <n>`` after every optimizer step (the orchestrator's
+kill trigger) and writes ``final.params`` on completion.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SEED = 77
+NUM_EPOCH = 3
+BATCH = 8
+NSAMPLES = 64
+FINAL = "final.params"
+
+
+def train(ckpt_dir: str, resume="auto", batch_period=2) -> int:
+    """The deterministic training job (worker mode)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.ndarray.serialization import save_nd
+
+    np.random.seed(SEED)
+    mx.random.seed(SEED)
+    # dataset drawn from a private stream so it is identical in every run
+    # regardless of where the consumer RNG state was checkpointed
+    rng = np.random.RandomState(1234)
+    X = rng.randn(NSAMPLES, 10).astype(np.float32)
+    y = rng.randint(0, 4, NSAMPLES).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=BATCH, shuffle=True,
+                     label_name="softmax_label")
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = Module(net, context=mx.cpu())
+
+    def on_batch(param):
+        # the orchestrator kills on this marker; print AFTER the update so
+        # "killed at step n" means n optimizer steps are visible on disk
+        print(f"CHAOS_STEP {param.locals['global_step']}", flush=True)
+
+    mod.fit(it, num_epoch=NUM_EPOCH, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            batch_end_callback=on_batch,
+            checkpoint=ckpt_dir, resume=resume,
+            checkpoint_batch_period=batch_period)
+
+    arg, aux = mod.get_params()
+    names = sorted(arg)
+    save_nd(os.path.join(ckpt_dir, FINAL),
+            [np.asarray(arg[n].asnumpy()) for n in names], names)
+    print("TRAIN_DONE", flush=True)
+    return 0
+
+
+def _worker_cmd(ckpt_dir: str) -> list:
+    return [sys.executable, os.path.abspath(__file__), "--train",
+            "--ckpt-dir", ckpt_dir]
+
+
+def _worker_env(chaos_kill: str = "") -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if chaos_kill:
+        env["MXNET_CHAOS_KILL"] = chaos_kill
+    else:
+        env.pop("MXNET_CHAOS_KILL", None)
+    return env
+
+
+def orchestrate(kill_at_step: int, workdir: str, chaos_kill: str = "") -> int:
+    from mxnet_tpu.chaos.proc import run_to_completion, run_until_step
+
+    ref_dir = os.path.join(workdir, "ref")
+    vic_dir = os.path.join(workdir, "victim")
+    os.makedirs(ref_dir)
+    os.makedirs(vic_dir)
+
+    print(f"[1/3] reference run (uninterrupted) -> {ref_dir}")
+    rc, out = run_to_completion(_worker_cmd(ref_dir), env=_worker_env())
+    if rc != 0 or "TRAIN_DONE" not in out:
+        print(out[-3000:])
+        print("reference run failed")
+        return 2
+
+    print(f"[2/3] victim run, SIGKILL at step {kill_at_step} -> {vic_dir}")
+    rc, out = run_until_step(_worker_cmd(vic_dir), kill_at_step,
+                             env=_worker_env(chaos_kill))
+    if rc != -9:
+        print(out[-3000:])
+        print(f"victim exited rc={rc} before reaching step {kill_at_step}")
+        return 2
+
+    print("[3/3] resume with resume='auto' from the same directory")
+    rc, out = run_to_completion(_worker_cmd(vic_dir), env=_worker_env())
+    if rc != 0 or "TRAIN_DONE" not in out:
+        print(out[-3000:])
+        print("resumed run failed")
+        return 2
+
+    with open(os.path.join(ref_dir, FINAL), "rb") as f:
+        ref_bytes = f.read()
+    with open(os.path.join(vic_dir, FINAL), "rb") as f:
+        vic_bytes = f.read()
+    if ref_bytes == vic_bytes:
+        print("BITWISE MATCH: resumed final params == uninterrupted run")
+        return 0
+    import numpy as np
+
+    from mxnet_tpu.ndarray.serialization import load_nd
+
+    ref = load_nd(os.path.join(ref_dir, FINAL))
+    vic = load_nd(os.path.join(vic_dir, FINAL))
+    for n in sorted(ref):
+        delta = float(np.abs(ref[n] - vic[n]).max())
+        print(f"  {n}: max |delta| = {delta:g}")
+    print("MISMATCH: resumed run drifted from the uninterrupted one")
+    return 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="SIGKILL a training run at step N, resume, diff params")
+    ap.add_argument("--train", action="store_true",
+                    help="worker mode: run the training job itself")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint directory (worker) / scratch (orchestrator)")
+    ap.add_argument("--resume", default="auto", help='worker: "auto"|"never"')
+    ap.add_argument("--batch-period", type=int, default=2,
+                    help="worker: checkpoint every N steps")
+    ap.add_argument("--kill-at-step", type=int, default=7,
+                    help="orchestrator: SIGKILL when CHAOS_STEP reaches N")
+    ap.add_argument("--chaos-kill", default="",
+                    help="orchestrator: MXNET_CHAOS_KILL for the victim, "
+                         "e.g. ckpt:pre_rename@2")
+    args = ap.parse_args(argv)
+
+    if args.train:
+        if not args.ckpt_dir:
+            ap.error("--train requires --ckpt-dir")
+        return train(args.ckpt_dir, resume=args.resume,
+                     batch_period=args.batch_period)
+
+    workdir = args.ckpt_dir or tempfile.mkdtemp(prefix="chaos_kill_")
+    cleanup = args.ckpt_dir is None
+    try:
+        return orchestrate(args.kill_at_step, workdir,
+                           chaos_kill=args.chaos_kill)
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
