@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos
+.PHONY: lint lint-tests test test-fast chaos perf
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -26,3 +26,10 @@ test-fast: lint
 # exactly-once checks, CRC corruption fallback (docs/ROBUSTNESS.md)
 chaos:
 	$(PYTHON) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+# dispatch-overhead guarantees (docs/PERFORMANCE.md): the perf-marked tests
+# assert a Trainer.step updates all params in <=2 compiled programs, then
+# profile_step.py prints the full per-phase dispatch breakdown
+perf:
+	$(PYTHON) -m pytest tests/ -q -m perf -p no:cacheprovider
+	$(PYTHON) tools/profile_step.py --model resnet50_v1

@@ -418,6 +418,23 @@ def _lm_train_flops(n_layers, units, hidden, vocab, seq, batch):
     return 3 * fwd
 
 
+def bench_update_engine_dispatches():
+    """Compiled executions per optimizer step (tools/profile_step.py
+    counters): the fused engine must stay at 1 program regardless of the
+    parameter count; the eager column is the per-param dispatch cost it
+    replaced."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import profile_step
+
+    res = profile_step.profile_model("resnet18_v1", batch_size=1,
+                                     image_size=32, optimizer="sgd",
+                                     eager=True, warmup=2)
+    return {"n_params": res["n_params"],
+            "fused": res["update"]["total_compiled"],
+            "eager": res["update_eager"]["total_compiled"]}
+
+
 def bench_lm_long(platform):
     """TransformerLM at seq 2048 bf16 — the config where the Pallas flash
     kernel is the difference between fitting the S×S scores in HBM or not.
@@ -605,6 +622,13 @@ def main():
         extra["lm_seq2048_bf16"] = bench_lm_long(platform)
     except Exception as e:
         extra["lm_seq2048_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        # dispatch-overhead guarantee (docs/PERFORMANCE.md): compiled device
+        # programs per Trainer.step update phase, fused engine vs eager loop
+        extra["update_engine_dispatches_per_step"] = \
+            bench_update_engine_dispatches()
+    except Exception as e:
+        extra["update_engine_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
             and not over_budget("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's

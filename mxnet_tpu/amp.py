@@ -31,8 +31,21 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
     _TARGET["dtype"] = target_dtype
 
 
-def init_trainer(trainer):
-    """No-op: bf16 needs no loss scaling (exponent range == fp32)."""
+def init_trainer(trainer, loss_scaler=None):
+    """Attach a dynamic loss scaler to a gluon Trainer.
+
+    bf16 (the TPU default) needs no loss scaling (exponent range == fp32),
+    so with no explicit ``loss_scaler`` this stays a no-op.  When a scaler
+    is attached (fp16 parity runs), the Trainer's fused update program takes
+    over the whole scaler protocol in-graph: gradient unscale, the found-inf
+    reduction, the skip-step masking, and the scale/window bookkeeping — the
+    scale and counters live device-resident and no step pays a host sync
+    (docs/PERFORMANCE.md)."""
+    if loss_scaler is None and _TARGET["dtype"] in ("float16", np.float16):
+        loss_scaler = LossScaler()
+    if loss_scaler is not None and hasattr(trainer, "_amp_loss_scaler"):
+        trainer._amp_loss_scaler = loss_scaler
+    return loss_scaler
 
 
 def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None,
@@ -67,11 +80,20 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
+        """One batched finiteness reduction + a single device→host sync
+        (was: one blocking asnumpy per parameter). The fused update path
+        never calls this — its found-inf decision stays on device."""
+        import jax
+        import jax.numpy as jnp
+
+        flags = []
         for p in params:
             g = p.grad() if callable(getattr(p, "grad", None)) else None
-            if g is not None and not bool(np.isfinite(g.asnumpy()).all()):
-                return True
-        return False
+            if g is not None:
+                flags.append(jnp.all(jnp.isfinite(g._data.astype(jnp.float32))))
+        if not flags:
+            return False
+        return not bool(np.all(jax.device_get(flags)))
 
     def update_scale(self, skip):
         if skip:

@@ -183,19 +183,80 @@ class TraceLinter:
                      "to one dtype so compiled programs are reused"))
         return findings
 
+    # ------------------------------------------------- fused update engine
+    @staticmethod
+    def _engines_of(obj):
+        """Yield FusedUpdateEngine instances reachable from a Trainer,
+        Updater, Module, or a bare engine."""
+        if hasattr(obj, "compile_log") and hasattr(obj, "apply"):
+            yield obj  # already an engine
+            return
+        updaters = []
+        if hasattr(obj, "_updaters"):  # gluon Trainer
+            updaters.extend(obj._updaters)
+        if hasattr(obj, "_updater") and obj._updater is not None:  # Module
+            updaters.append(obj._updater)
+        if hasattr(obj, "states") and hasattr(obj, "optimizer"):  # Updater
+            updaters.append(obj)
+        for u in updaters:
+            eng = getattr(u, "_engine", None)
+            if eng is not None:
+                yield eng
+
+    def check_update_engine(self, obj, baseline: int = 0) -> List[Finding]:
+        """Flag a training loop that keeps recompiling the fused update
+        program.  Per-step scalars (lr after scheduler, wd, loss scale,
+        update counts) are traced arguments by design — churn means a
+        *static* component varies per step: a mutated hyperparameter
+        (e.g. ``optimizer.momentum`` rewritten from a python float each
+        iteration), ragged parameter shapes, or a flapping scaler/clip
+        toggle."""
+        findings: List[Finding] = []
+        for eng in self._engines_of(obj):
+            log = eng.compile_log[baseline:]
+            if len(log) <= self.retrace_threshold:
+                continue
+            varying = []
+            for field, label in (("static", "static hyperparameters"),
+                                 ("avals", "parameter shapes/dtypes"),
+                                 ("state_structure", "optimizer state structure"),
+                                 ("flags", "loss-scaler/clip toggles"),
+                                 ("optimizer", "optimizer class")):
+                distinct = {repr(e.get(field)) for e in log}
+                if len(distinct) > 1:
+                    varying.append(f"{label} ({len(distinct)} distinct)")
+            findings.append(Finding(
+                "update-retrace-churn", Severity.WARNING,
+                f"the fused update program recompiled {len(log)} times "
+                f"(threshold {self.retrace_threshold}); varying: "
+                f"{'; '.join(varying) or 'unknown'}. Each recompile stalls "
+                "a training step on XLA compilation",
+                node=type(eng.optimizer).__name__,
+                fix_hint="don't rebind static optimizer hyperparameters per "
+                         "step — per-step values (lr/wd/scale) are already "
+                         "traced arguments; use set_learning_rate or an "
+                         "lr_scheduler instead of mutating e.g. momentum, "
+                         "and keep parameter shapes fixed"))
+        return findings
+
     # ------------------------------------------------------------- public
     def lint(self, block, *example_inputs) -> Report:
         report = Report(self.scan_source(block))
         if example_inputs:
             report.extend(self.check_dtypes(block, *example_inputs))
         report.extend(self.check_cache(block))
+        report.extend(self.check_update_engine(block))
         return report
 
     @contextlib.contextmanager
     def watch(self, block):
-        """Observe a training/eval loop; ``report()`` afterwards."""
+        """Observe a training/eval loop; ``report()`` afterwards. Accepts a
+        Block, a gluon Trainer, or a Module (the latter two are watched for
+        fused-update retrace churn)."""
         self._watched = block
         self._watch_baseline = len(self._cache_keys(block))
+        self._watch_engine_baseline = sum(
+            len(e.compile_log) for e in self._engines_of(block))
         try:
             yield self
         finally:
@@ -207,4 +268,6 @@ class TraceLinter:
         rep = Report(self.scan_source(self._watched))
         rep.extend(self.check_cache(self._watched,
                                     baseline=self._watch_baseline))
+        rep.extend(self.check_update_engine(
+            self._watched, baseline=getattr(self, "_watch_engine_baseline", 0)))
         return rep

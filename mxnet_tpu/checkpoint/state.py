@@ -63,18 +63,32 @@ class TrainingState:
 # optimizer state (Updater slots + scalar counters)
 # ---------------------------------------------------------------------------
 
-def _flatten_opt_state(state, path: str, arrays: Dict[str, np.ndarray]):
-    """Flatten a (possibly nested-tuple) Updater slot into named arrays and
-    return a JSON descriptor mirroring its structure."""
+def _flatten_opt_state(state, path: str, deferred: list):
+    """Flatten a (possibly nested-tuple) Updater slot into (key, value)
+    pairs and return a JSON descriptor mirroring the structure.  Values stay
+    device-side here; ``_drain_deferred`` moves them all to host in ONE
+    batched transfer (not one blocking asnumpy per slot array)."""
     if state is None:
         return None
     if isinstance(state, tuple):
-        return {"tuple": [_flatten_opt_state(s, f"{path}.{i}", arrays)
+        return {"tuple": [_flatten_opt_state(s, f"{path}.{i}", deferred)
                           for i, s in enumerate(state)]}
-    arr = state.asnumpy() if hasattr(state, "asnumpy") else np.asarray(state)
     key = f"opt:{path}"
-    arrays[key] = np.ascontiguousarray(arr)
+    deferred.append((key, state))
     return {"array": key}
+
+
+def _drain_deferred(deferred, arrays: Dict[str, np.ndarray]) -> None:
+    """One batched device→host transfer for all captured slot arrays."""
+    if not deferred:
+        return
+    import jax
+
+    host = jax.device_get([
+        (v._data if hasattr(v, "_data") else np.asarray(v))
+        for _k, v in deferred])
+    for (key, _v), h in zip(deferred, host):
+        arrays[key] = np.ascontiguousarray(np.asarray(h))
 
 
 def _unflatten_opt_state(desc, arrays: Dict[str, np.ndarray]):
@@ -92,10 +106,12 @@ def capture_optimizer(updater, optimizer, arrays: Dict[str, np.ndarray]):
     Slot keys may be ints (Module/Trainer) or strings (PS server)."""
     meta: dict = {"state_tree": []}
     if updater is not None:
+        deferred: list = []
         for key, slot in updater.states.items():
             tag = "i" if isinstance(key, (int, np.integer)) else "s"
             meta["state_tree"].append(
-                [tag, str(key), _flatten_opt_state(slot, str(key), arrays)])
+                [tag, str(key), _flatten_opt_state(slot, str(key), deferred)])
+        _drain_deferred(deferred, arrays)
     if optimizer is not None:
         meta["num_update"] = int(getattr(optimizer, "num_update", 0))
         meta["index_update_count"] = [

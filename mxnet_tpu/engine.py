@@ -2,10 +2,22 @@
 
 Reference: ``python/mxnet/engine.py`` (``bulk`` scope batching engine pushes,
 ``set_bulk_size`` — TBV, SURVEY.md §2.1 Engine). TPU mapping: XLA's async
-dispatch already pipelines eager ops and ``hybridize`` is the real bulking
-mechanism, so ``bulk`` is a compatibility scope — it suspends the MX_SYNC
-debug-sync behavior for its duration (the closest analog of batching engine
-pushes) and restores it after.
+dispatch already pipelines eager ops, and the real bulking mechanisms are
+
+- ``hybridize`` — the forward/backward graph compiles to one program, and
+- the **fused update engine** (``mxnet_tpu/optimizer/fused.py``) — every
+  optimizer update in a ``Trainer.step`` / ``Module.update`` runs as ONE
+  donated XLA program per step (docs/PERFORMANCE.md).
+
+so ``bulk`` is a compatibility scope — it suspends the MX_SYNC debug-sync
+behavior for its duration (the closest analog of batching engine pushes) and
+restores it after.  ``set_bulk_size`` is kept for script parity; it does not
+influence the fused paths (they always bulk the whole parameter set).
+
+Note: a training loop that keeps retracing the fused update (e.g. by
+rebinding static optimizer hyperparameters every step) defeats the bulking;
+the TraceLinter's ``update-retrace-churn`` rule diagnoses this — per-step
+scalars like the learning rate are traced arguments and never retrace.
 """
 from __future__ import annotations
 

@@ -245,6 +245,10 @@ class Executor:
 
         key = _random.next_key()
         key_data = jr.key_data(key) if hasattr(jr, "key_data") else key
+        from . import profiler as _profiler
+
+        if _profiler.counting_dispatches():
+            _profiler.count_dispatch("compiled")
         outs, new_aux = jitted(key_data, arg_vals, aux_vals)
         if is_train and self._grad_req != "null":
             # backward replays the same RNG key → identical dropout masks
@@ -289,6 +293,10 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             cot = tuple(NDArray(g)._data for g in out_grads)
+        from . import profiler as _profiler
+
+        if _profiler.counting_dispatches():
+            _profiler.count_dispatch("compiled")
         grads = self._get_grad_fn(train)(key_data, arg_vals, aux_vals, cot)
         for n, g in zip(self._arg_names, grads):
             if n in self.grad_dict and g is not None:

@@ -570,6 +570,10 @@ class CachedOp:
 
         # Wrap fn so first execution finalizes n_out/num_outputs metadata.
         def finalizing_fn(*vals, **kw):
+            from .. import profiler as _profiler
+
+            if _profiler.counting_dispatches():
+                _profiler.count_dispatch("compiled")
             res = jitted(*vals, **kw)
             n_aux = len(aux_param_idx)
             entry["n_out"] = len(res) - n_aux

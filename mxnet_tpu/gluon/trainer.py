@@ -20,7 +20,8 @@ __all__ = ["Trainer"]
 
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 clip_global_norm=None):
         if hasattr(params, "values"):
             params = list(params.values())
         self._params = [p for p in params if p.grad_req != "null"]
@@ -35,6 +36,11 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = False
         self._params_to_init = list(self._params)
+        # fused-update extensions: clip-by-global-norm is computed inside the
+        # one-program update; amp.init_trainer attaches the loss scaler so
+        # unscale + found-inf skip fuse into the same program
+        self._clip_global_norm = clip_global_norm
+        self._amp_loss_scaler = None
 
     # ------------------------------------------------------------------
     @property
@@ -64,6 +70,12 @@ class Trainer:
                 self._kvstore.set_optimizer(self._optimizer)
         else:
             self._kvstore = kind
+        if self._kvstore is not None and self._update_on_kvstore and \
+                (self._clip_global_norm or self._amp_loss_scaler is not None):
+            raise ValueError(
+                "clip_global_norm / an attached AMP loss scaler are not "
+                "supported with update_on_kvstore=True — the update runs "
+                "server-side without them; set update_on_kvstore=False")
         self._kv_initialized = True
 
     # ------------------------------------------------------------------
@@ -83,10 +95,14 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, p in enumerate(self._params):
-            self._kvstore.push(i, p.data().grad)
-            if not self._update_on_kvstore:
-                self._kvstore.pull(i, out=p.data().grad)
+        # one batched push/pull over all keys (the kvstore local-update path
+        # then applies the whole batch as one fused program; dist stores get
+        # their bulk-execution window)
+        keys = list(range(len(self._params)))
+        grads = [p.data().grad for p in self._params]
+        self._kvstore.push(keys, grads)
+        if not self._update_on_kvstore:
+            self._kvstore.pull(keys, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -97,16 +113,25 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]
         if self._kvstore is not None and self._update_on_kvstore:
-            for i, p in enumerate(self._params):
-                self._kvstore.pull(i, out=p.data())
+            keys = list(range(len(self._params)))
+            self._kvstore.pull(keys, out=[p.data() for p in self._params])
             return
+        idxs, grads, weights = [], [], []
         for i, p in enumerate(self._params):
             g = p.data().grad
             if g is None:
                 if ignore_stale_grad:
                     continue
                 raise RuntimeError(f"Parameter {p.name} has no grad")
-            updater(i, g, p.data())
+            idxs.append(i)
+            grads.append(g)
+            weights.append(p.data())
+        if idxs:
+            # the whole parameter set updates as ONE compiled program
+            # (optimizer/fused.py; MXNET_FUSED_UPDATE=0 = per-param oracle)
+            updater.update_batch(idxs, grads, weights,
+                                 loss_scaler=self._amp_loss_scaler,
+                                 clip_global_norm=self._clip_global_norm)
 
     # ------------------------------------------------------------------
     def save_states(self, fname):

@@ -580,6 +580,27 @@ class PrefetchingIter(DataIter):
                              if hasattr(self.iter, "_load_batch") else 1)
         self._pool = None
         self._queue = []
+        # kick off the first prefetches NOW: the first next() (typically the
+        # step right after trainer construction) finds its batch already
+        # decoded and in flight to the device instead of paying a cold fetch
+        self._ensure_pool()
+        while len(self._queue) < self._prefetch:
+            self._submit_one()
+
+    @staticmethod
+    def _start_transfer(batch):
+        """Begin the host→device copy from the worker thread. jax.device_put
+        is async — it returns immediately with an in-flight buffer — so the
+        consumer's device step overlaps the next batch's decode+transfer."""
+        try:
+            import jax
+
+            for arr in list(batch.data) + list(batch.label or []):
+                if hasattr(arr, "_set_data"):
+                    arr._set_data(jax.device_put(arr._data))
+        except Exception:
+            pass  # never fail a fetch over an optimistic transfer
+        return batch
 
     @property
     def provide_data(self):
@@ -623,10 +644,12 @@ class PrefetchingIter(DataIter):
                 fut.set_exception(e)
                 self._queue.append(fut)
                 return
-            self._queue.append(self._pool.submit(self.iter._load_batch,
-                                                 reserved))
+            self._queue.append(self._pool.submit(
+                lambda r: self._start_transfer(self.iter._load_batch(r)),
+                reserved))
         else:
-            self._queue.append(self._pool.submit(self.iter.next))
+            self._queue.append(self._pool.submit(
+                lambda: self._start_transfer(self.iter.next())))
 
     def next(self):
         self._ensure_pool()

@@ -65,10 +65,14 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Aggregate value(s) into the key (sum over pushed values, matching
         the reference's merge semantics); if an optimizer is set, run the
-        update instead (update_on_kvstore mode)."""
+        update instead (update_on_kvstore mode).  A multi-key push with a
+        server-side optimizer applies the whole batch as ONE fused program
+        (optimizer/fused.py) — the local-update analog of the reference's
+        grouped server kernels."""
         keys, values = _as_list(key), _as_list(value)
         if len(keys) == 1 and len(values) > 1:
             keys = keys * len(values)
+        batch = []  # (key, merged gradient) pairs bound for the updater
         for k, v in zip(keys, values):
             k = str(k)
             if k not in self._store:
@@ -88,10 +92,18 @@ class KVStore:
                 packed = comp.compress(k, g)
                 merged = type(merged)(comp.decompress(packed, g.shape))
             if self._updater is not None:
-                self._updater(int(k) if k.isdigit() else k, merged, self._store[k])
+                batch.append((k, merged))
             else:
                 self._pending = getattr(self, "_pending", {})
                 self._pending.setdefault(k, []).append(merged)
+        if len(batch) > 1 and hasattr(self._updater, "update_batch"):
+            idxs = [int(k) if k.isdigit() else k for k, _ in batch]
+            self._updater.update_batch(idxs, [m for _, m in batch],
+                                       [self._store[k] for k, _ in batch])
+        else:
+            for k, merged in batch:
+                self._updater(int(k) if k.isdigit() else k, merged,
+                              self._store[k])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = _as_list(key)

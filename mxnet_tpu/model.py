@@ -47,7 +47,12 @@ def load_checkpoint(prefix, epoch):
 class FeedForward:
     """Legacy pre-Module training API (reference mx.model.FeedForward —
     deprecated upstream in favor of Module; kept as a thin adapter over
-    Module for script parity)."""
+    Module for script parity).
+
+    Training through this adapter inherits Module's fused update path: all
+    parameter updates per step run as ONE compiled program
+    (optimizer/fused.py, docs/PERFORMANCE.md; ``MXNET_FUSED_UPDATE=0``
+    restores the per-parameter eager loop)."""
 
     def __init__(self, symbol, ctx=None, num_epoch=None, optimizer="sgd",
                  initializer=None, arg_params=None, aux_params=None,

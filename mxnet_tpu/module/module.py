@@ -164,6 +164,7 @@ class Module(BaseModule):
 
     def update(self):
         assert self.optimizer_initialized
+        idxs, grads, weights = [], [], []
         for i, name in enumerate(self._param_names):
             if name in self._fixed_param_names:
                 continue
@@ -171,7 +172,13 @@ class Module(BaseModule):
             g = self._exec.grad_dict.get(name)
             if g is None:
                 continue
-            self._updater(i, g, w)
+            idxs.append(i)
+            grads.append(g)
+            weights.append(w)
+        if idxs:
+            # one fused program per step (optimizer/fused.py);
+            # MXNET_FUSED_UPDATE=0 restores the per-param eager loop
+            self._updater.update_batch(idxs, grads, weights)
 
     def get_outputs(self, merge_multi_context=True):
         return self._exec.outputs

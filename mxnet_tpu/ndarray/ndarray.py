@@ -134,6 +134,11 @@ class NDArray:
     # ------------------------------------------------------------- transfer
     def asnumpy(self) -> np.ndarray:
         """Blocking device→host copy (reference NDArray::SyncCopyToCPU)."""
+        from .. import profiler
+
+        if profiler.counting_dispatches() and \
+                not isinstance(self._data, jax.core.Tracer):
+            profiler.count_dispatch("d2h")
         return np.asarray(self._data)
 
     def asscalar(self):
@@ -508,6 +513,11 @@ def invoke(op: Any, inputs: Sequence[NDArray], kwargs: dict):
     from .. import autograd, profiler
 
     datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+    # CachedOp dispatches count as "compiled" at their own call site
+    if profiler.counting_dispatches() and not any(
+            isinstance(d, jax.core.Tracer) for d in datas) \
+            and not opdef.name.startswith("CachedOp_"):
+        profiler.count_dispatch("eager_ops")
     # skip timing under trace: block_until_ready is a no-op on tracers, so
     # the "duration" would be trace-construction overhead, not execution
     timing = profiler.aggregate_active() and not any(
@@ -636,14 +646,18 @@ def save(fname: str, data) -> None:
     from .serialization import save_nd
 
     if isinstance(data, NDArray):
-        save_nd(fname, [data.asnumpy()], [])
+        keys, arrays = [], [data]
     elif isinstance(data, dict):
         keys = list(data.keys())
-        save_nd(fname, [data[k].asnumpy() for k in keys], keys)
+        arrays = [data[k] for k in keys]
     elif isinstance(data, (list, tuple)):
-        save_nd(fname, [v.asnumpy() for v in data], [])
+        keys, arrays = [], list(data)
     else:
         raise TypeError(f"cannot save {type(data)}")
+    # ONE batched device→host gather for the whole set (not a blocking
+    # asnumpy per array) — checkpoints of many-parameter models sync once
+    host = jax.device_get([a._data for a in arrays])
+    save_nd(fname, [np.asarray(h) for h in host], keys)
 
 
 def load(fname: str):

@@ -434,6 +434,25 @@ def _sgld_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
     return weight - 0.5 * lr * g + noise
 
 
+@register("lars_update", num_outputs=2, ndarray_inputs=['weight', 'grad', 'mom'])
+def _lars_update(weight, grad, mom, lr=0.01, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """LARS (reference optimizer.LARS): per-tensor trust ratio
+    eta*||w|| / (||g|| + wd*||w|| + eps) scales the lr of a plain momentum
+    step.  Norms are f32 in-graph — no host round-trip."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(weight.astype(jnp.float32))))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                      eta * w_norm / (g_norm + wd * w_norm + epsilon),
+                      jnp.float32(1.0))
+    return _sgd_mom_update(weight, grad, mom, lr=(lr * trust).astype(weight.dtype),
+                           momentum=momentum, wd=wd, rescale_grad=rescale_grad,
+                           clip_gradient=clip_gradient)
+
+
 @register("dcasgd_update", num_outputs=3, ndarray_inputs=['weight', 'grad', 'mom', 'prev_weight'])
 def _dcasgd_update(weight, grad, mom, prev_weight, lr=0.01, momentum=0.0,
                    lamda=0.04, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):

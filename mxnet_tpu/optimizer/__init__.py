@@ -4,8 +4,11 @@ from .optimizer import (Optimizer, SGD, NAG, Adam, AdamW, LAMB, RMSProp, AdaGrad
                         SGLD, DCASGD, LARS, create, register, Updater,
                         get_updater)
 from . import lr_scheduler  # noqa: F401
+from . import fused  # noqa: F401  (the one-program-per-step update engine)
+from .fused import FusedUpdateEngine, fused_update_enabled
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "LAMB", "RMSProp", "AdaGrad",
            "AdaDelta", "Ftrl", "FTML", "Signum", "AdaMax", "Adamax", "Nadam",
            "SGLD", "DCASGD", "LARS", "create", "register", "Updater",
-           "get_updater", "lr_scheduler"]
+           "get_updater", "lr_scheduler", "fused", "FusedUpdateEngine",
+           "fused_update_enabled"]

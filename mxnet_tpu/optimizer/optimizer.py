@@ -390,29 +390,124 @@ class Signum(Optimizer):
 
 class Updater:
     """Applies an optimizer to (index, grad, weight) triplets — the object the
-    reference serializes to KVStore servers (set_optimizer)."""
+    reference serializes to KVStore servers (set_optimizer).
+
+    ``update_batch`` is the fused fast path: all parameters update in ONE
+    compiled program per step (optimizer/fused.py) unless
+    ``MXNET_FUSED_UPDATE=0`` selects the per-parameter eager oracle.
+    ``__call__`` stays per-parameter (the kvstore per-key push surface).
+    """
 
     def __init__(self, optimizer: Optimizer):
         self.optimizer = optimizer
         self.states = {}
+        self._engine = None
+
+    def _get_engine(self):
+        if self._engine is None:
+            from .fused import FusedUpdateEngine
+
+            self._engine = FusedUpdateEngine(self.optimizer)
+        return self._engine
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
+    def update_batch(self, indices, grads, weights, loss_scaler=None,
+                     clip_global_norm=None):
+        """Update a whole parameter set at once. Fused-by-default: one donated
+        XLA program covers every optimizer update plus global-norm clipping
+        and the AMP unscale/found-inf skip (docs/PERFORMANCE.md)."""
+        from .fused import fused_update_enabled
+
+        for i, w in zip(indices, weights):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(i, w)
+        # a duplicate index (kvstore broadcast push(key, [v1, v2])) must apply
+        # sequentially — the fused program reads all pre-step buffers up
+        # front, so last-write-wins would drop the earlier updates
+        if fused_update_enabled() and len(set(indices)) == len(indices):
+            eng = self._get_engine()
+            if eng.supported():
+                eng.apply(indices, weights, grads,
+                          [self.states[i] for i in indices],
+                          loss_scaler=loss_scaler,
+                          clip_global_norm=clip_global_norm)
+                return
+        self._eager_batch(indices, grads, weights, loss_scaler,
+                          clip_global_norm)
+
+    def _eager_batch(self, indices, grads, weights, loss_scaler=None,
+                     clip_global_norm=None):
+        """The per-parameter oracle: same semantics as the fused engine, one
+        dispatch per op, host syncs allowed (differential-test reference)."""
+        opt = self.optimizer
+        gs = list(grads)
+        skip = False
+        if loss_scaler is not None:
+            scale = float(loss_scaler.loss_scale)
+            if scale != 1.0:
+                gs = [g * (1.0 / scale) for g in gs]
+            skip = _grads_nonfinite(gs)
+        if not skip and clip_global_norm is not None and clip_global_norm > 0:
+            rescale = float(opt.rescale_grad)
+            sq = 0.0
+            for g in gs:
+                sq += float((g.astype(np.float32) * rescale).square().sum()
+                            .asscalar())
+            coef = min(1.0, float(clip_global_norm) /
+                       (float(np.sqrt(np.float32(sq))) + 1e-6))
+            if coef < 1.0:
+                gs = [g * coef for g in gs]
+        if skip:
+            # counters advance on skipped steps (same as the fused engine)
+            for i in indices:
+                opt._update_count(i)
+        else:
+            for i, g, w in zip(indices, gs, weights):
+                opt.update_multi_precision(i, w, g, self.states[i])
+        if loss_scaler is not None:
+            loss_scaler.loss_scale = float(loss_scaler.loss_scale)
+            loss_scaler._unskipped = int(getattr(loss_scaler, "_unskipped", 0))
+            loss_scaler.update_scale(skip)
+            loss_scaler.last_overflow = skip
+
     def get_states(self, dump_optimizer=False):
         import pickle
 
-        return pickle.dumps({k: _states_np(v) for k, v in self.states.items()})
+        # ONE batched device→host transfer for all slots (not one blocking
+        # asnumpy() per array): gather the jax leaves, device_get once,
+        # then rebuild the nested numpy structure.
+        import jax as _jax
+
+        from .fused import _rebuild_state, _state_leaves, _state_spec
+
+        leaves = []
+        for v in self.states.values():
+            _state_leaves(v, leaves)
+        host = _jax.device_get([x._data for x in leaves])
+        host_it = iter(np.asarray(h) for h in host)
+        out = {k: _rebuild_state(_state_spec(v), host_it)
+               for k, v in self.states.items()}
+        return pickle.dumps(out)
 
     def set_states(self, states):
         import pickle
 
-        from ..ndarray import array
-
         loaded = pickle.loads(states)
         self.states = {k: _states_nd(v) for k, v in loaded.items()}
+
+
+def _grads_nonfinite(grads) -> bool:
+    """One batched finiteness reduction over all gradients (single sync)."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    flags = [_jnp.all(_jnp.isfinite(g._data.astype(_jnp.float32)))
+             for g in grads]
+    return not bool(np.all(_jax.device_get(flags)))
 
 
 def _states_np(s):
@@ -478,6 +573,10 @@ class Nadam(Optimizer):
         lr, wd = self._common(index)
         t = self._index_update_count[index]
         momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        # the fused engine keeps m_schedule device-resident (a 0-d NDArray);
+        # re-entering the eager path materializes it back to a python float
+        if not isinstance(self.m_schedule, float):
+            self.m_schedule = float(self.m_schedule)
         mean, var = state
         invoke("nadam_update", [weight, grad, mean, var],
                {"lr": lr, "beta1": self.beta1, "beta2": self.beta2,
@@ -540,19 +639,13 @@ class LARS(Optimizer):
         return zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
 
     def update(self, index, weight, grad, state):
-        import numpy as _np
-
+        # trust-ratio norms are computed IN-GRAPH by the lars_update op — the
+        # previous weight.asnumpy()/np.linalg.norm implementation forced two
+        # blocking device→host transfers per parameter per step
         lr, wd = self._common(index)
-        w_norm = float(_np.linalg.norm(weight.asnumpy()))
-        g = grad.asnumpy() * self.rescale_grad
-        if self.clip_gradient is not None and self.clip_gradient > 0:
-            g = _np.clip(g, -self.clip_gradient, self.clip_gradient)
-        g_norm = float(_np.linalg.norm(g))
-        trust = 1.0
-        if w_norm > 0 and g_norm > 0:
-            trust = self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
-        invoke("sgd_mom_update", [weight, grad, state],
-               {"lr": lr * trust, "wd": wd, "momentum": self.momentum,
+        invoke("lars_update", [weight, grad, state],
+               {"lr": lr, "momentum": self.momentum, "eta": self.eta,
+                "epsilon": self.epsilon, "wd": wd,
                 "rescale_grad": self.rescale_grad,
                 "clip_gradient": self.clip_gradient,
                 "out": (weight, state)})

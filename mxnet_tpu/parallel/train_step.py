@@ -19,7 +19,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ndarray import NDArray
-from ..ops import get_op
 from .functional import functionalize
 from .sharding import ShardingRules, batch_sharding
 
@@ -60,6 +59,15 @@ class ShardedTrainer:
         self._opt_name = optimizer
         self._opt = opt
         self._grad_clip = grad_clip
+        # the per-param update math is the fused engine's lowering
+        # (optimizer/fused.py) applied to an Optimizer instance — the sharded
+        # and eager/Trainer paths share one implementation and cannot diverge
+        from ..optimizer import create as _opt_create
+
+        self._opt_obj = _opt_create(
+            optimizer, learning_rate=self._lr,
+            clip_gradient=(grad_clip if grad_clip and grad_clip > 0 else None),
+            **{k: v for k, v in opt.items() if k != "lr"})
         self._donate = donate
         # AMP: fwd/bwd in compute_dtype (bf16 on the MXU), fp32 master
         # weights + optimizer state. No loss scaling — bf16's exponent range
@@ -137,34 +145,21 @@ class ShardedTrainer:
         return (zeros(), zeros())  # adam/adamw mean, var
 
     def _update_one(self, w, g, state, lr, t):
+        from ..optimizer.fused import lower_update
+
         o = self._opt
-        wd = o.get("wd", 0.0)
-        rescale = o.get("rescale_grad", 1.0)
-        clip = self._grad_clip
+        # map the sharded state tuples onto the Updater slot layout the
+        # lowering expects: sgd () -> None, sgd-momentum (m,) -> m
         if self._opt_name == "sgd":
-            mom = o.get("momentum", 0.0)
-            if mom:
-                new_w, new_m = get_op("sgd_mom_update").fn(
-                    w, g, state[0], lr=lr, momentum=mom, wd=wd,
-                    rescale_grad=rescale, clip_gradient=clip)
-                return new_w, (new_m,)
-            return get_op("sgd_update").fn(w, g, lr=lr, wd=wd, rescale_grad=rescale,
-                                           clip_gradient=clip), ()
-        b1 = o.get("beta1", 0.9)
-        b2 = o.get("beta2", 0.999)
-        eps = o.get("epsilon", 1e-8)
-        if self._opt_name == "adam":
-            # bias correction via lr scaling (reference optimizer.Adam)
-            corr = jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
-            new_w, m, v = get_op("adam_update").fn(
-                w, g, state[0], state[1], lr=lr * corr, beta1=b1, beta2=b2,
-                epsilon=eps, wd=wd, rescale_grad=rescale, clip_gradient=clip)
-            return new_w, (m, v)
-        new_w, m, v = get_op("adamw_update").fn(
-            w, g, state[0], state[1], lr=lr, beta1=b1, beta2=b2, epsilon=eps,
-            wd=wd, eta=1.0, rescale_grad=jnp.asarray(rescale, w.dtype),
-            clip_gradient=clip)
-        return new_w, (m, v)
+            st = state[0] if state else None
+        else:
+            st = state
+        new_w, new_st, _ = lower_update(
+            self._opt_obj, w, g, st, lr=lr, wd=o.get("wd", 0.0), t=t,
+            rescale=o.get("rescale_grad", 1.0))
+        if self._opt_name == "sgd":
+            return new_w, (() if new_st is None else (new_st,))
+        return new_w, new_st
 
     # ------------------------------------------------------------------
     def _build(self, n_extra_inputs):

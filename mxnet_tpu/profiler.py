@@ -16,7 +16,8 @@ import warnings
 import jax
 
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume",
-           "scope", "Profiler"]
+           "scope", "Profiler", "DispatchCounts", "count_dispatches",
+           "count_dispatch", "counting_dispatches"]
 
 _config = {"filename": "profile.json", "profile_all": False, "aggregate_stats": False}
 _state = {"running": False, "dir": None}
@@ -34,6 +35,70 @@ _agg: dict = {}
 
 def aggregate_active() -> bool:
     return _state["running"] and bool(_config.get("aggregate_stats"))
+
+
+# ---------------------------------------------------------------------------
+# Dispatch counting — the honest "how many compiled device programs did this
+# step execute" metric behind tools/profile_step.py and the perf tests.
+# Hook points: ndarray.invoke (each eager op is one compiled execution),
+# the fused update engine, Executor forward/backward, CachedOp calls, and
+# NDArray.asnumpy (device→host transfers).  Works on any backend, CPU
+# included — it counts dispatches, not device time.
+# ---------------------------------------------------------------------------
+
+class DispatchCounts:
+    """Counters for one measured region."""
+
+    __slots__ = ("compiled", "eager_ops", "h2d", "d2h")
+
+    def __init__(self):
+        self.compiled = 0   # jit-compiled program executions (engine/executor)
+        self.eager_ops = 0  # eager op dispatches (each is a compiled program)
+        self.h2d = 0        # host→device transfers
+        self.d2h = 0        # device→host transfers (asnumpy/asscalar)
+
+    @property
+    def total_compiled(self):
+        return self.compiled + self.eager_ops
+
+    def as_dict(self):
+        return {"compiled_calls": self.compiled, "eager_ops": self.eager_ops,
+                "total_compiled": self.total_compiled,
+                "h2d_transfers": self.h2d, "d2h_transfers": self.d2h}
+
+    def __repr__(self):
+        return f"DispatchCounts({self.as_dict()})"
+
+
+_counts: "DispatchCounts | None" = None
+
+
+def counting_dispatches() -> bool:
+    return _counts is not None
+
+
+def count_dispatch(kind: str, n: int = 1) -> None:
+    c = _counts
+    if c is not None:
+        setattr(c, kind, getattr(c, kind) + n)
+
+
+@contextlib.contextmanager
+def count_dispatches():
+    """Count compiled executions / transfers in a region::
+
+        with profiler.count_dispatches() as c:
+            trainer.step(batch_size)
+        assert c.total_compiled <= 2
+    """
+    global _counts
+    prev = _counts
+    c = DispatchCounts()
+    _counts = c
+    try:
+        yield c
+    finally:
+        _counts = prev
 
 
 def record_op(name: str, seconds: float) -> None:

@@ -30,6 +30,9 @@ def pytest_configure(config):
         "markers", "chaos: fault-injection tests (docs/ROBUSTNESS.md); run "
         "via `pytest -m chaos` or `make chaos`. Fast chaos tests stay in "
         "tier-1; subprocess SIGKILL ones are also marked slow")
+    config.addinivalue_line(
+        "markers", "perf: dispatch-count / perf-guarantee smoke tests "
+        "(docs/PERFORMANCE.md); run via `pytest -m perf` or `make perf`")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""profile_step.py — count compiled device programs per training step.
+
+The dispatch-overhead benchmark behind the fused update engine
+(docs/PERFORMANCE.md): it runs a gluon training step and reports, per phase,
+how many compiled XLA programs executed and how many host<->device transfers
+happened.  Works on CPU (it counts dispatches, not device time), so CI can
+assert the "one donated program per optimizer step" guarantee cannot rot:
+
+    $ JAX_PLATFORMS=cpu python tools/profile_step.py --model resnet50_v1
+    {
+      "model": "resnet50_v1", "n_params": 161,
+      "update": {"total_compiled": 1, ...},        <- fused engine
+      "update_eager": {"total_compiled": 323, ...} <- MXNET_FUSED_UPDATE=0
+    }
+
+The counters hook the framework's own dispatch choke points
+(mxnet_tpu.profiler.count_dispatches): every eager op invoke, every jitted
+Executor/CachedOp/fused-engine call, and every asnumpy sync.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def profile_trainer_step(net, trainer, batch, batch_size=None, warmup=2):
+    """Run warmup steps, then measure one step's dispatch counts per phase.
+
+    Returns {"fwd_bwd": counts, "update": counts} where counts are
+    profiler.DispatchCounts.as_dict() dictionaries for the measured step.
+    """
+    from mxnet_tpu import autograd, profiler
+
+    bs = batch_size or batch.shape[0]
+
+    for _ in range(warmup):
+        with autograd.record():
+            out = net(batch)
+            loss = (out * out).sum()
+        loss.backward()
+        trainer.step(bs)
+    with profiler.count_dispatches() as cf:
+        with autograd.record():
+            out = net(batch)
+            loss = (out * out).sum()
+        loss.backward()
+    with profiler.count_dispatches() as cu:
+        trainer.step(bs)
+    return {"fwd_bwd": cf.as_dict(), "update": cu.as_dict()}
+
+
+def profile_model(model="resnet50_v1", batch_size=1, image_size=32,
+                  optimizer="sgd", optimizer_params=None, eager=True,
+                  warmup=2):
+    """Build a model-zoo network + Trainer and profile its step."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.get_model(model)
+    net.initialize()
+    x = nd.ones((batch_size, 3, image_size, image_size))
+    net(x)  # materialize deferred shapes before counting params
+    trainer = Trainer(net.collect_params(),
+                      optimizer, optimizer_params or {"learning_rate": 0.01})
+    result = {"model": model, "n_params": len(trainer._params),
+              "batch_size": batch_size, "image_size": image_size,
+              "optimizer": optimizer}
+    result.update(profile_trainer_step(net, trainer, x, batch_size,
+                                       warmup=warmup))
+    if eager:
+        prev = os.environ.get("MXNET_FUSED_UPDATE")
+        os.environ["MXNET_FUSED_UPDATE"] = "0"
+        try:
+            phases = profile_trainer_step(net, trainer, x, batch_size,
+                                          warmup=1)
+            result["update_eager"] = phases["update"]
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_FUSED_UPDATE", None)
+            else:
+                os.environ["MXNET_FUSED_UPDATE"] = prev
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="resnet50_v1")
+    ap.add_argument("--batch-size", type=int, default=1)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--no-eager", action="store_true",
+                    help="skip the MXNET_FUSED_UPDATE=0 comparison run")
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args(argv)
+    res = profile_model(args.model, args.batch_size, args.image_size,
+                        args.optimizer, {"learning_rate": args.lr},
+                        eager=not args.no_eager, warmup=args.warmup)
+    print(json.dumps(res, indent=2))
+    return res
+
+
+if __name__ == "__main__":
+    main()
