@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos perf
+.PHONY: lint lint-tests test test-fast chaos perf obs
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -33,3 +33,8 @@ chaos:
 perf:
 	$(PYTHON) -m pytest tests/ -q -m perf -p no:cacheprovider
 	$(PYTHON) tools/profile_step.py --model resnet50_v1
+
+# runtime telemetry suite: span tracer, metrics registry, instrumented
+# step phases, chaos-event tagging (docs/OBSERVABILITY.md)
+obs:
+	$(PYTHON) -m pytest tests/ -q -m obs -p no:cacheprovider

@@ -93,6 +93,7 @@ def __getattr__(name):
         "operator": ".operator",
         "monitor": ".monitor",
         "mon": ".monitor",
+        "obs": ".obs",
         "native": ".native",
         "viz": ".visualization",
         "visualization": ".visualization",

@@ -14,7 +14,14 @@ BatchEndParam = namedtuple("BatchEndParams",
 
 class Speedometer:
     """Log samples/sec every ``frequent`` batches (the number the baseline
-    quotes — reference callback.Speedometer)."""
+    quotes — reference callback.Speedometer).
+
+    Uses ``time.monotonic()`` (wall-clock steps from NTP would corrupt the
+    rate) and guards the zero-elapsed division (``frequent=1`` fires on the
+    first measured batch, which can land in the same clock tick). The rate
+    is also published as the ``training.samples_per_sec`` gauge when obs
+    telemetry is on, so it shows up in ``tools/trace_report.py``.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -25,13 +32,18 @@ class Speedometer:
         self.last_count = 0
 
     def __call__(self, param):
+        from . import obs
+
         count = param.nbatch
         if self.last_count > count:
             self.init = False
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                elapsed = time.monotonic() - self.tic
+                speed = (self.frequent * self.batch_size
+                         / max(elapsed, 1e-9))
+                obs.set_gauge("training.samples_per_sec", speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -43,10 +55,10 @@ class Speedometer:
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (
                         param.epoch, count, speed)
                 logging.info(msg)
-                self.tic = time.time()
+                self.tic = time.monotonic()
         else:
             self.init = True
-            self.tic = time.time()
+            self.tic = time.monotonic()
 
 
 def do_checkpoint(prefix, period=1):

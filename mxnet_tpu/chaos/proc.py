@@ -63,6 +63,12 @@ def kill_point(name: str) -> None:
         return
     _counters[name] = _counters.get(name, 0) + 1
     if _counters[name] == plan[name]:
+        # last words to the telemetry stream: the ring buffer dies with the
+        # process, but a JSONL stream (MXNET_OBS_JSONL) is flushed per
+        # event, so the kill shows up in the post-mortem timeline
+        from .. import obs
+
+        obs.event("chaos.kill", point=name, occurrence=_counters[name])
         os.kill(os.getpid(), signal.SIGKILL)
 
 

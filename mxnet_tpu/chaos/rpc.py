@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
+from .. import obs
+
 __all__ = ["Rule", "configure", "reset", "on_send", "on_reply", "enabled"]
 
 # opcode value -> canonical rule name (mirrors kvstore/ps_server.py opcodes)
@@ -125,7 +127,18 @@ def _fire(rule: Rule, opname: str) -> bool:
     # event exactly once, or occurrence specs drift nondeterministically
     key = id(rule)
     _STATE.counters[key] = _STATE.counters.get(key, 0) + 1
-    return rule.occurrences is None or _STATE.counters[key] in rule.occurrences
+    fired = (rule.occurrences is None
+             or _STATE.counters[key] in rule.occurrences)
+    if fired:
+        # tag the injection in the SAME timeline the training step writes
+        # to, so a fault experiment reads as "RPC span, then chaos.rpc
+        # event, then the retry" instead of an invisible stall
+        obs.event("chaos.rpc", action=rule.action, op=opname,
+                  occurrence=_STATE.counters[key],
+                  seconds=rule.seconds or None)
+        obs.inc("chaos.injected")
+        obs.inc(f"chaos.rpc.{rule.action}")
+    return fired
 
 
 def on_send(opcode: int, key: str) -> Optional[str]:

@@ -34,10 +34,12 @@ import re
 import shutil
 import signal
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
 
+from .. import obs
 from .atomic import atomic_write_json, crc32_bytes, fsync_dir, read_json
 from .state import FORMAT_VERSION, TrainingState
 
@@ -142,9 +144,12 @@ class CheckpointManager:
             self._ensure_writer()
             # coalesce under backpressure: each queued item is a FULL host
             # snapshot, so a writer slower than the save cadence must not
-            # grow memory without bound — drop stale pending saves, newest
-            # wins (crash recovery only ever reads the newest valid one)
-            while self._queue.qsize() > 1:
+            # grow memory without bound — beyond 2 pending snapshots, drop
+            # stale saves, newest wins (crash recovery only ever reads the
+            # newest valid one). The bound is 2, not 1, so a burst of saves
+            # racing a not-yet-scheduled writer thread doesn't silently
+            # thin the committed history
+            while self._queue.qsize() > 2:
                 try:
                     stale = self._queue.get_nowait()
                 except queue.Empty:
@@ -153,9 +158,12 @@ class CheckpointManager:
                 if stale is None:  # close() sentinel: not ours to eat
                     self._queue.put(None)
                     break
+                obs.inc("checkpoint.coalesced")
             self._queue.put((int(step), state))
+            obs.set_gauge("checkpoint.queue_depth", self._queue.qsize())
         else:
             self._write(int(step), state)
+        obs.inc("checkpoint.saves")
 
     def flush(self):
         """Block until every queued save has hit disk; re-raise write errors."""
@@ -196,12 +204,21 @@ class CheckpointManager:
                 step, state = item
                 try:
                     self._write(step, state)
-                except BaseException as e:  # surfaced on next save()/flush()
-                    log.warning("checkpoint %d write failed: %s", step, e)
+                except BaseException as e:
+                    # a silently lost checkpoint is a resume-time disaster:
+                    # log ONCE per failure with the traceback, count it, and
+                    # keep the error pending — the next save()/flush()/
+                    # close() re-raises it as CheckpointError
+                    log.error("background checkpoint %d write failed "
+                              "(will re-raise on next save/close): %s",
+                              step, e, exc_info=True)
+                    obs.metrics.registry.counter(
+                        "checkpoint.write_errors").inc()
                     with self._lock:
                         self._write_error = e
             finally:
                 self._queue.task_done()
+                obs.set_gauge("checkpoint.queue_depth", self._queue.qsize())
 
     def _write(self, step: int, state: TrainingState):
         from ..chaos.proc import kill_point
@@ -217,44 +234,65 @@ class CheckpointManager:
         if os.path.exists(staging):
             shutil.rmtree(staging, ignore_errors=True)
         os.makedirs(staging)
+        rec = obs.enabled()
+        t_start = time.monotonic() if rec else 0.0
         try:
-            names = sorted(state.arrays)
-            arrays = [np.ascontiguousarray(state.arrays[n]) for n in names]
-            arrays_path = os.path.join(staging, _ARRAYS_FILE)
-            save_nd(arrays_path, arrays, names)
-            kill_point("ckpt:post_arrays")  # chaos: die with data, no manifest
-            manifest = {
-                "format": FORMAT_VERSION,
-                "step": step,
-                "meta": state.meta,
-                "arrays": {
-                    n: {"crc32": crc32_bytes(a.tobytes()),
-                        "shape": list(a.shape), "dtype": str(a.dtype)}
-                    for n, a in zip(names, arrays)},
-            }
-            atomic_write_json(os.path.join(staging, _MANIFEST_FILE), manifest)
-            fsync_dir(staging)
-            kill_point("ckpt:pre_rename")  # chaos: die mid-commit
-            if os.path.exists(final):
-                # same-step rewrite (epoch-end on top of a batch-period
-                # save): both snapshots resume identically, so keep the
-                # committed one — deleting it first would open a crash
-                # window with NO valid checkpoint at this step
-                shutil.rmtree(staging, ignore_errors=True)
-            else:
-                try:
-                    os.rename(staging, final)
-                except OSError:
-                    if not os.path.exists(final):
-                        raise
-                    # lost a same-step commit race: keep the winner
+            with obs.trace.span("checkpoint.write", step=step):
+                names = sorted(state.arrays)
+                arrays = [np.ascontiguousarray(state.arrays[n])
+                          for n in names]
+                arrays_path = os.path.join(staging, _ARRAYS_FILE)
+                t0 = time.monotonic() if rec else 0.0
+                save_nd(arrays_path, arrays, names)
+                if rec:
+                    obs.observe("checkpoint.array_write_seconds",
+                                time.monotonic() - t0)
+                kill_point("ckpt:post_arrays")  # chaos: die, no manifest
+                manifest = {
+                    "format": FORMAT_VERSION,
+                    "step": step,
+                    "meta": state.meta,
+                    "arrays": {
+                        n: {"crc32": crc32_bytes(a.tobytes()),
+                            "shape": list(a.shape), "dtype": str(a.dtype)}
+                        for n, a in zip(names, arrays)},
+                }
+                atomic_write_json(os.path.join(staging, _MANIFEST_FILE),
+                                  manifest)
+                t0 = time.monotonic() if rec else 0.0
+                fsync_dir(staging)
+                if rec:
+                    obs.observe("checkpoint.fsync_seconds",
+                                time.monotonic() - t0)
+                kill_point("ckpt:pre_rename")  # chaos: die mid-commit
+                t0 = time.monotonic() if rec else 0.0
+                if os.path.exists(final):
+                    # same-step rewrite (epoch-end on top of a batch-period
+                    # save): both snapshots resume identically, so keep the
+                    # committed one — deleting it first would open a crash
+                    # window with NO valid checkpoint at this step
                     shutil.rmtree(staging, ignore_errors=True)
                 else:
-                    fsync_dir(self.directory)
-            kill_point("ckpt:post_rename")
+                    try:
+                        os.rename(staging, final)
+                    except OSError:
+                        if not os.path.exists(final):
+                            raise
+                        # lost a same-step commit race: keep the winner
+                        shutil.rmtree(staging, ignore_errors=True)
+                    else:
+                        fsync_dir(self.directory)
+                if rec:
+                    # commit = rename + parent fsync (the atomicity tax)
+                    obs.observe("checkpoint.commit_seconds",
+                                time.monotonic() - t0)
+                kill_point("ckpt:post_rename")
         except BaseException:
             shutil.rmtree(staging, ignore_errors=True)
             raise
+        if rec:
+            obs.observe("checkpoint.write_seconds",
+                        time.monotonic() - t_start)
         self._gc()
 
     def _gc(self):

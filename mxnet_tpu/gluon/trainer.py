@@ -12,6 +12,7 @@ match the reference.
 """
 from __future__ import annotations
 
+from .. import obs
 from ..optimizer import Optimizer, Updater, create as opt_create
 from .parameter import Parameter
 
@@ -84,8 +85,10 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        with obs.trace.span("allreduce"):
+            self._allreduce_grads()
+        with obs.trace.span("update"):
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -108,7 +111,8 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        with obs.trace.span("update"):
+            self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]

@@ -8,11 +8,13 @@ rank sharding keeps the reference's ``part_index``/``num_parts`` API.
 from __future__ import annotations
 
 import os
+import time
 from collections import namedtuple
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import obs
 from ..ndarray import NDArray, array as nd_array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
@@ -657,11 +659,24 @@ class PrefetchingIter(DataIter):
             self._submit_one()
         fut = self._queue.pop(0)
         self._submit_one()
+        rec = obs.enabled()
+        if rec:
+            # queue depth = batches already decoded and waiting; a depth
+            # pinned at 0 means the consumer is data-bound
+            obs.set_gauge("io.prefetch.queue_depth",
+                          sum(1 for f in self._queue if f.done()))
+            t0 = time.monotonic()
         try:
-            return fut.result()
+            batch = fut.result()
         except StopIteration:
             self._drain()
             raise
+        if rec:
+            # producer stall: how long the step loop blocked because the
+            # prefetch workers hadn't finished this batch (≈0 when ahead)
+            obs.observe("io.prefetch.stall_seconds", time.monotonic() - t0)
+            obs.inc("io.prefetch.batches")
+        return batch
 
     def close(self):
         """Stop the prefetch workers and drop pending batches. Call when

@@ -22,6 +22,7 @@ import time
 
 import numpy as np
 
+from .. import obs
 from ..base import MXNetError
 from ..chaos import rpc as chaos_rpc
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
@@ -79,20 +80,33 @@ class PSClient:
         send inside one critical section)."""
         retries = self._retries if retries is None else retries
         last_err = None
+        opname = chaos_rpc.OP_NAMES.get(opcode, str(opcode))
         for attempt in range(retries):
             try:
                 if self._sock is None:
                     self._connect()
                 if timeout is not None:
                     self._sock.settimeout(timeout)
-                dup = chaos_rpc.on_send(opcode, key)
-                _send_msg(self._sock, opcode, key, payload)
-                if dup == "dup":  # chaos: duplicated frame on the wire
+                rec = obs.enabled()
+                t0 = time.monotonic() if rec else 0.0
+                with obs.trace.span("kvstore.rpc", op=opname, key=key,
+                                    attempt=attempt):
+                    dup = chaos_rpc.on_send(opcode, key)
                     _send_msg(self._sock, opcode, key, payload)
-                reply = _recv_msg(self._sock)
-                if dup == "dup":
-                    reply = _recv_msg(self._sock)  # drain the second reply
-                chaos_rpc.on_reply(opcode, key)
+                    if dup == "dup":  # chaos: duplicated frame on the wire
+                        _send_msg(self._sock, opcode, key, payload)
+                    reply = _recv_msg(self._sock)
+                    if dup == "dup":
+                        reply = _recv_msg(self._sock)  # drain the 2nd reply
+                    chaos_rpc.on_reply(opcode, key)
+                if rec:
+                    obs.observe(f"kvstore.rpc.{opname}_seconds",
+                                time.monotonic() - t0)
+                    if opname in ("push", "push_seq", "push_sparse",
+                                  "push_sparse_seq", "init"):
+                        obs.inc("kvstore.bytes_pushed", len(payload))
+                    elif opname in ("pull", "pull_sparse"):
+                        obs.inc("kvstore.bytes_pulled", len(reply[2]))
                 if timeout is not None:
                     self._sock.settimeout(self._timeout)
                 return reply
@@ -104,7 +118,14 @@ class PSClient:
                     except OSError:
                         pass
                     self._sock = None
-                time.sleep(self._backoff(attempt))
+                delay = self._backoff(attempt)
+                if obs.enabled():
+                    obs.inc("kvstore.rpc.retries")
+                    obs.observe("kvstore.rpc.backoff_seconds", delay)
+                    obs.trace.event("kvstore.rpc.retry", op=opname, key=key,
+                                    attempt=attempt, error=str(e))
+                time.sleep(delay)
+        obs.inc("kvstore.rpc.failures")
         raise MXNetError(
             f"PS rpc op={opcode} key={key!r} failed after "
             f"{retries} attempts: {last_err}")

@@ -31,10 +31,18 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from .. import obs
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
 
 (OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
  OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ, OP_PUSH_SPARSE_SEQ) = range(10)
+
+# opcode → canonical name (telemetry labels; mxnet_tpu.chaos.rpc mirrors it)
+OP_NAMES = {OP_INIT: "init", OP_PUSH: "push", OP_PULL: "pull",
+            OP_SET_OPT: "set_opt", OP_BARRIER: "barrier",
+            OP_SHUTDOWN: "shutdown", OP_PUSH_SPARSE: "push_sparse",
+            OP_PULL_SPARSE: "pull_sparse", OP_PUSH_SEQ: "push_seq",
+            OP_PUSH_SPARSE_SEQ: "push_sparse_seq"}
 
 
 def _pack_array(arr: np.ndarray) -> bytes:
@@ -203,101 +211,122 @@ class PSServer:
         try:
             while True:
                 opcode, key, payload = _recv_msg(conn)
-                if opcode == OP_INIT:
-                    arr = _unpack_array(payload)
-                    with self._global_lock:
-                        if key not in self._weights:
-                            self._weights[key] = arr
-                            self._locks[key] = threading.Lock()
-                    _send_msg(conn, OP_INIT, key, b"\x00")
-                elif opcode == OP_PUSH:
-                    grad = _unpack_array(payload)
-                    with self._locks[key]:
-                        if self._updater is not None:
-                            w = self._weights[key]
-                            self._apply(key, grad, w)
-                        else:
-                            self._weights[key] = self._weights[key] + grad
-                    _send_msg(conn, OP_PUSH, key, b"\x00")
-                elif opcode == OP_PUSH_SEQ:
-                    # exactly-once push: payload prefixed with (client_id,
-                    # seq); a retried frame whose seq was already applied is
-                    # acked without re-applying — fixes the at-least-once
-                    # double-apply the plain PUSH retry path has
-                    if key not in self._weights or len(payload) < 16:
-                        _send_msg(conn, OP_PUSH_SEQ, key, b"\x01")
-                        continue
-                    cid, seq = struct.unpack_from("<QQ", payload, 0)
-                    grad = _unpack_array(payload[16:])
-                    with self._locks[key]:
-                        with self._seq_lock:
-                            fresh = self._applied_seq.get((cid, key), -1) < seq
-                        if fresh:
-                            if self._updater is not None:
-                                self._apply(key, grad, self._weights[key])
-                            else:
-                                self._weights[key] = self._weights[key] + grad
-                            # record only AFTER a successful apply, so a
-                            # failed apply doesn't burn the seq
-                            with self._seq_lock:
-                                self._record_seq(cid, key, seq)
-                    _send_msg(conn, OP_PUSH_SEQ, key, b"\x00")
-                elif opcode == OP_PULL:
-                    with self._locks.get(key, self._global_lock):
-                        arr = self._weights[key]
-                    _send_msg(conn, OP_PULL, key, _pack_array(arr))
-                elif opcode == OP_PUSH_SPARSE:
-                    # reference kvstore_dist.h sparse PSKV: only touched rows
-                    # cross the wire; the server applies a row-sparse update.
-                    # Same validation contract as the C++ twin: bad key /
-                    # out-of-range or negative index → \x01, never corruption
-                    ok = self._apply_sparse(key, payload)
-                    _send_msg(conn, OP_PUSH_SPARSE, key,
-                              b"\x00" if ok else b"\x01")
-                elif opcode == OP_PUSH_SPARSE_SEQ:
-                    # sparse twin of OP_PUSH_SEQ: (client_id, seq) prefix
-                    # dedups a retried frame so the row update applies
-                    # exactly once even when the ack was lost
-                    if key not in self._weights or len(payload) < 16:
-                        _send_msg(conn, OP_PUSH_SPARSE_SEQ, key, b"\x01")
-                        continue
-                    cid, seq = struct.unpack_from("<QQ", payload, 0)
-                    ok = True
-                    with self._locks[key]:
-                        with self._seq_lock:
-                            fresh = self._applied_seq.get((cid, key), -1) < seq
-                        if fresh:
-                            ok = self._apply_sparse(key, payload[16:],
-                                                    locked=True)
-                            if ok:  # a rejected frame must not burn the seq
-                                with self._seq_lock:
-                                    self._record_seq(cid, key, seq)
-                    _send_msg(conn, OP_PUSH_SPARSE_SEQ, key,
-                              b"\x00" if ok else b"\x01")
-                elif opcode == OP_PULL_SPARSE:
-                    reply = b""  # empty = failure, matching the C++ twin
-                    if key in self._weights:
-                        idx = _unpack_array(payload).astype(np.int64)
-                        w = self._weights[key]
-                        if (idx.ndim == 1 and idx.size > 0
-                                and 0 <= idx.min()
-                                and idx.max() < w.shape[0]):
-                            with self._locks.get(key, self._global_lock):
-                                reply = _pack_array(
-                                    np.ascontiguousarray(w[idx]))
-                    _send_msg(conn, OP_PULL_SPARSE, key, reply)
-                elif opcode == OP_SET_OPT:
-                    self._set_optimizer_bytes(bytes(payload))
-                    _send_msg(conn, OP_SET_OPT, key, b"\x00")
-                elif opcode == OP_BARRIER:
-                    _send_msg(conn, OP_BARRIER, key,
-                              b"\x00" if self._barrier(payload) else b"\x01")
-                elif opcode == OP_SHUTDOWN:
-                    _send_msg(conn, OP_SHUTDOWN, key, b"\x00")
-                    self.stop()
+                rec = obs.enabled()
+                t0 = time.monotonic() if rec else 0.0
+                if rec:
+                    obs.inc("kvstore.server.bytes_received", len(payload))
+                try:
+                    alive = self._handle_one(conn, opcode, key, payload)
+                finally:
+                    if rec:
+                        # per-RPC service time, server side (lock wait +
+                        # optimizer apply + reply serialization)
+                        obs.observe(
+                            "kvstore.server.rpc."
+                            f"{OP_NAMES.get(opcode, str(opcode))}_seconds",
+                            time.monotonic() - t0)
+                if not alive:
                     return
         except (ConnectionError, OSError):
             return
+
+    def _handle_one(self, conn: socket.socket, opcode: int, key: str,
+                    payload) -> bool:
+        """Serve one framed request; False only after OP_SHUTDOWN."""
+        if opcode == OP_INIT:
+            arr = _unpack_array(payload)
+            with self._global_lock:
+                if key not in self._weights:
+                    self._weights[key] = arr
+                    self._locks[key] = threading.Lock()
+            _send_msg(conn, OP_INIT, key, b"\x00")
+        elif opcode == OP_PUSH:
+            grad = _unpack_array(payload)
+            with self._locks[key]:
+                if self._updater is not None:
+                    w = self._weights[key]
+                    self._apply(key, grad, w)
+                else:
+                    self._weights[key] = self._weights[key] + grad
+            _send_msg(conn, OP_PUSH, key, b"\x00")
+        elif opcode == OP_PUSH_SEQ:
+            # exactly-once push: payload prefixed with (client_id,
+            # seq); a retried frame whose seq was already applied is
+            # acked without re-applying — fixes the at-least-once
+            # double-apply the plain PUSH retry path has
+            if key not in self._weights or len(payload) < 16:
+                _send_msg(conn, OP_PUSH_SEQ, key, b"\x01")
+                return True
+            cid, seq = struct.unpack_from("<QQ", payload, 0)
+            grad = _unpack_array(payload[16:])
+            with self._locks[key]:
+                with self._seq_lock:
+                    fresh = self._applied_seq.get((cid, key), -1) < seq
+                if fresh:
+                    if self._updater is not None:
+                        self._apply(key, grad, self._weights[key])
+                    else:
+                        self._weights[key] = self._weights[key] + grad
+                    # record only AFTER a successful apply, so a
+                    # failed apply doesn't burn the seq
+                    with self._seq_lock:
+                        self._record_seq(cid, key, seq)
+            _send_msg(conn, OP_PUSH_SEQ, key, b"\x00")
+        elif opcode == OP_PULL:
+            with self._locks.get(key, self._global_lock):
+                arr = self._weights[key]
+            _send_msg(conn, OP_PULL, key, _pack_array(arr))
+        elif opcode == OP_PUSH_SPARSE:
+            # reference kvstore_dist.h sparse PSKV: only touched rows
+            # cross the wire; the server applies a row-sparse update.
+            # Same validation contract as the C++ twin: bad key /
+            # out-of-range or negative index → \x01, never corruption
+            ok = self._apply_sparse(key, payload)
+            _send_msg(conn, OP_PUSH_SPARSE, key,
+                      b"\x00" if ok else b"\x01")
+        elif opcode == OP_PUSH_SPARSE_SEQ:
+            # sparse twin of OP_PUSH_SEQ: (client_id, seq) prefix
+            # dedups a retried frame so the row update applies
+            # exactly once even when the ack was lost
+            if key not in self._weights or len(payload) < 16:
+                _send_msg(conn, OP_PUSH_SPARSE_SEQ, key, b"\x01")
+                return True
+            cid, seq = struct.unpack_from("<QQ", payload, 0)
+            ok = True
+            with self._locks[key]:
+                with self._seq_lock:
+                    fresh = self._applied_seq.get((cid, key), -1) < seq
+                if fresh:
+                    ok = self._apply_sparse(key, payload[16:],
+                                            locked=True)
+                    if ok:  # a rejected frame must not burn the seq
+                        with self._seq_lock:
+                            self._record_seq(cid, key, seq)
+            _send_msg(conn, OP_PUSH_SPARSE_SEQ, key,
+                      b"\x00" if ok else b"\x01")
+        elif opcode == OP_PULL_SPARSE:
+            reply = b""  # empty = failure, matching the C++ twin
+            if key in self._weights:
+                idx = _unpack_array(payload).astype(np.int64)
+                w = self._weights[key]
+                if (idx.ndim == 1 and idx.size > 0
+                        and 0 <= idx.min()
+                        and idx.max() < w.shape[0]):
+                    with self._locks.get(key, self._global_lock):
+                        reply = _pack_array(
+                            np.ascontiguousarray(w[idx]))
+            _send_msg(conn, OP_PULL_SPARSE, key, reply)
+        elif opcode == OP_SET_OPT:
+            self._set_optimizer_bytes(bytes(payload))
+            _send_msg(conn, OP_SET_OPT, key, b"\x00")
+        elif opcode == OP_BARRIER:
+            _send_msg(conn, OP_BARRIER, key,
+                      b"\x00" if self._barrier(payload) else b"\x01")
+        elif opcode == OP_SHUTDOWN:
+            _send_msg(conn, OP_SHUTDOWN, key, b"\x00")
+            self.stop()
+            return False
+        return True
 
     def _record_seq(self, cid, key, seq):
         """Caller holds ``self._seq_lock``. LRU-bounded (client churn)."""

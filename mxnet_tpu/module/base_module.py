@@ -10,6 +10,7 @@ import time
 import numpy as np
 
 from .. import metric as metric_mod
+from .. import obs
 from ..callback import BatchEndParam
 
 __all__ = ["BaseModule"]
@@ -51,8 +52,10 @@ class BaseModule:
 
     # -- composite helpers ------------------------------------------------
     def forward_backward(self, data_batch):
-        self.forward(data_batch, is_train=True)
-        self.backward()
+        with obs.trace.span("forward"):
+            self.forward(data_batch, is_train=True)
+        with obs.trace.span("backward"):
+            self.backward()
 
     def score(self, eval_data, eval_metric, num_batch=None, reset=True, epoch=0,
               batch_end_callback=None):
@@ -193,12 +196,21 @@ class BaseModule:
                 else:
                     train_data.reset()
                     nbatch = -1
-                for data_batch in train_data:
+                batches = iter(train_data)
+                while True:
+                    # data_wait = time the step loop blocks on the iterator
+                    # (decode + host→device when PrefetchingIter is behind)
+                    with obs.trace.span("data_wait"):
+                        data_batch = next(batches, _STOP)
+                    if data_batch is _STOP:
+                        break
                     nbatch += 1
                     self.forward_backward(data_batch)
-                    self.update()
+                    with obs.trace.span("update"):
+                        self.update()
                     global_step += 1
-                    self.update_metric(eval_metric, data_batch.label)
+                    with obs.trace.span("metric"):
+                        self.update_metric(eval_metric, data_batch.label)
                     if batch_end_callback:
                         bp = BatchEndParam(epoch, nbatch, eval_metric,
                                            locals())
@@ -207,18 +219,22 @@ class BaseModule:
                     if (manager is not None and checkpoint_batch_period
                             and can_position
                             and global_step % checkpoint_batch_period == 0):
-                        manager.save(self._capture_training_state(
-                            epoch, nbatch, global_step, train_data),
-                            global_step)
+                        with obs.trace.span("checkpoint", step=global_step):
+                            manager.save(self._capture_training_state(
+                                epoch, nbatch, global_step, train_data),
+                                global_step)
                     if manager is not None and manager.preempted.is_set():
                         # flush a final snapshot after the in-flight batch;
                         # with a non-positionable iterator no mid-epoch point
                         # can be resumed exactly, so fall back to the last
                         # epoch-end checkpoint (cost: at most one interval)
                         if can_position:
-                            manager.save(self._capture_training_state(
-                                epoch, nbatch, global_step, train_data),
-                                global_step, block=True)
+                            with obs.trace.span("checkpoint",
+                                                step=global_step,
+                                                preemption=True):
+                                manager.save(self._capture_training_state(
+                                    epoch, nbatch, global_step, train_data),
+                                    global_step, block=True)
                         manager.flush()
                         self.logger.info(
                             "preempted at epoch %d batch %d — final "
@@ -252,8 +268,11 @@ class BaseModule:
                     # resumes to bitwise-identical params (re-entering the
                     # finished epoch for zero batches), and the manager
                     # would discard a same-step rewrite anyway
-                    manager.save(self._capture_training_state(
-                        epoch, None, global_step, train_data), global_step)
+                    with obs.trace.span("checkpoint", step=global_step,
+                                        epoch_end=True):
+                        manager.save(self._capture_training_state(
+                            epoch, None, global_step, train_data),
+                            global_step)
                 if eval_data is not None:
                     res = self.score(eval_data, validation_metric,
                                      epoch=epoch,
@@ -305,6 +324,9 @@ class BaseModule:
         restore_optimizer(getattr(self, "_updater", None),
                           getattr(self, "_optimizer", None), state)
         restore_rng(state)
+
+
+_STOP = object()  # iterator-exhausted sentinel for the data_wait span
 
 
 def _as_list(x):

@@ -86,10 +86,10 @@ class Monitor:
 
                     jax.debug.callback(emit, val)
                 elif self.activated:
-                    s = self.stat_func(o)
-                    if isinstance(s, NDArray):
-                        s = s.asnumpy()
-                    self.queue.append((self.step, tag, s))
+                    # stats stay device-resident here; toc() fetches every
+                    # pending stat with ONE batched jax.device_get instead
+                    # of one blocking asnumpy per tensor per batch
+                    self.queue.append((self.step, tag, self.stat_func(o)))
 
         def walk(b):
             b.register_forward_hook(hook)
@@ -128,15 +128,36 @@ class Monitor:
         if exe is not None:
             for name, out in zip(exe._symbol.list_outputs(), exe.outputs):
                 if self.pattern.match(name):
-                    s = self.stat_func(out)
-                    if isinstance(s, NDArray):
-                        s = s.asnumpy()
-                    self.queue.append((self.step, name, s))
+                    self.queue.append((self.step, name, self.stat_func(out)))
         self.activated = False
         res = list(self.queue)
+        self.queue = []
+        # ONE device→host transfer for ALL watched stats: the old path
+        # blocked on asnumpy once per tensor per batch (the same
+        # batched-get pattern Updater.get_states uses — PR 3)
+        device_idx = [i for i, (_, _, v) in enumerate(res)
+                      if isinstance(v, NDArray)]
+        if device_idx:
+            from . import profiler
+
+            if profiler.counting_dispatches():
+                profiler.count_dispatch("d2h")  # one batched transfer
+            fetched = jax.device_get([res[i][2]._data for i in device_idx])
+            for i, val in zip(device_idx, fetched):
+                step, tag, _ = res[i]
+                res[i] = (step, tag, np.asarray(val))
         if self.sort:
             res.sort(key=lambda t: t[1])
-        self.queue = []
+        # scalar stats land in the metrics registry too, so `obs` reports
+        # show tensor health beside latencies (docs/OBSERVABILITY.md)
+        from . import obs
+
+        if obs.enabled():
+            for step, tag, val in res:
+                arr = np.asarray(val)
+                if arr.size == 1:
+                    obs.set_gauge("monitor." + tag,
+                                  float(arr.reshape(())[()]))
         return res
 
     def toc_print(self, exe=None):

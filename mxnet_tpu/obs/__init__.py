@@ -1,0 +1,107 @@
+"""``mxnet_tpu.obs`` — unified runtime telemetry (docs/OBSERVABILITY.md).
+
+Two surfaces, one switch:
+
+- :mod:`~mxnet_tpu.obs.trace` — span tracer. ``obs.trace.span("phase")``
+  context managers build a framework-level timeline (per-batch step phases,
+  RPCs, checkpoint commits, chaos injections) exportable to chrome-trace
+  JSON (Perfetto) or a JSONL stream.
+- :mod:`~mxnet_tpu.obs.metrics` — metrics registry. Named counters, gauges,
+  and fixed-bucket histograms; ``obs.metrics.dump()`` prints the table,
+  ``snapshot()`` returns it as data. The profiler's dispatch counters live
+  here too (``dispatch.*``), so ``profiler.count_dispatches()`` and the obs
+  layer can never disagree.
+
+The whole layer is **off by default and zero-cost when off**: one module
+flag guards every entry point; ``span()`` returns a shared no-op, the
+convenience helpers (``inc``/``observe``/``set_gauge``) return immediately.
+Turn it on with ``MXNET_OBS=1`` in the environment or ``obs.enable()`` in
+code; ``MXNET_OBS_JSONL=<path>`` additionally streams events to a file.
+
+Typical session::
+
+    import mxnet_tpu as mx
+    mx.obs.enable()
+    module.fit(train_iter, num_epoch=2, checkpoint="ckpts")
+    mx.obs.export("trace.json")          # spans + metrics, one file
+    print(mx.obs.metrics.dump())         # the metrics table
+    # then: python tools/trace_report.py trace.json
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import metrics, trace
+
+__all__ = ["trace", "metrics", "enable", "disable", "enabled", "span",
+           "event", "inc", "observe", "set_gauge", "export", "reset"]
+
+# re-exported hot-path helpers (obs.span is obs.trace.span)
+span = trace.span
+event = trace.event
+
+
+def enabled() -> bool:
+    """True when telemetry is recording (the one module flag)."""
+    return trace._ENABLED
+
+
+def enable(jsonl: Optional[str] = None) -> None:
+    """Turn telemetry on. ``jsonl`` additionally streams every completed
+    span/event to that path (appended, flushed per event — survives
+    SIGKILL, tail-able on headless workers)."""
+    trace._ENABLED = True
+    if jsonl:
+        trace.stream_to(jsonl)
+
+
+def disable() -> None:
+    """Turn telemetry off (the no-op fast path) and close any JSONL
+    stream (after appending a final metrics-snapshot record to it).
+    Recorded events and metrics are kept until :func:`reset`."""
+    was_streaming = trace.tracer._stream is not None
+    trace._ENABLED = False
+    if was_streaming:
+        trace.tracer.stream_metrics(metrics.snapshot())
+    trace.stream_to(None)
+
+
+def reset() -> None:
+    """Clear the span ring buffer and drop every metric."""
+    trace.reset()
+    metrics.reset()
+
+
+# -- self-gating convenience helpers for instrumentation call sites --------
+# One call, one flag check: `obs.inc("kvstore.rpc.retries")` costs a single
+# boolean test when telemetry is off.
+
+def inc(name: str, n: int = 1) -> None:
+    if trace._ENABLED:
+        metrics.registry.counter(name).inc(n)
+
+
+def observe(name: str, value: float) -> None:
+    if trace._ENABLED:
+        metrics.registry.histogram(name).observe(value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if trace._ENABLED:
+        metrics.registry.gauge(name).set(value)
+
+
+def export(path: str) -> str:
+    """Write the chrome-trace JSON (spans + instant events + a metrics
+    snapshot in ``otherData``) to ``path``. Load it in Perfetto, or feed it
+    to ``tools/trace_report.py`` for a terminal breakdown."""
+    return trace.export_chrome_trace(path, metrics=metrics.snapshot())
+
+
+# environment switches: MXNET_OBS=1 enables at import, MXNET_OBS_JSONL
+# names the stream file (implies enable)
+_env = os.environ.get("MXNET_OBS", "").lower()
+_jsonl = os.environ.get("MXNET_OBS_JSONL")
+if _jsonl or _env not in ("", "0", "false", "no", "off"):
+    enable(jsonl=_jsonl)

@@ -1,0 +1,299 @@
+"""Metrics registry — named counters, gauges, and fixed-bucket histograms.
+
+The reference framework's observability splits across ``mx.mon.Monitor``
+(tensor stats), the profiler's aggregate tables, and ad-hoc logging; here
+every numeric runtime signal lands in ONE process-global registry so a
+single ``snapshot()`` / ``dump()`` shows dispatch counts, RPC latencies,
+queue depths, and retrace churn side by side (docs/OBSERVABILITY.md has the
+metric name catalog).
+
+Design notes:
+
+- **Names, not label sets.** Metrics are keyed by a flat dotted name
+  (``kvstore.rpc.push_seq.seconds``); callers bake the one discriminating
+  dimension into the name. This keeps ``observe()`` one dict lookup + one
+  lock — cheap enough for per-RPC and per-dispatch call sites.
+- **Fixed buckets.** Histograms use preset upper bounds (Prometheus-style
+  latency ladder by default) so ``observe()`` is a bisect, never a resize,
+  and snapshots are stable across runs.
+- **Thread-safe.** Every mutation takes the metric's own lock: the async
+  checkpoint writer, prefetch workers, and PS server handler threads all
+  report concurrently.
+
+The registry always exists and always works — the ``obs`` module flag only
+gates whether *instrumentation call sites* feed it (obs/__init__.py).
+``profiler.DispatchCounts`` is a delta view over this registry's
+``dispatch.*`` counters, so the two systems cannot drift.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "DEFAULT_BUCKETS", "registry", "counter", "gauge", "histogram",
+           "snapshot", "dump", "reset"]
+
+# Prometheus-style latency ladder (seconds). Fine enough to separate a
+# sub-ms fused dispatch from a 100ms RPC retry from a multi-second compile.
+DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    """Monotonically increasing integer (retries, bytes, retraces)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+    def __repr__(self):
+        return f"Counter({self.name}={self._value})"
+
+
+class Gauge:
+    """Last-write-wins float (queue depth, samples/sec, loss scale)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value -= n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+    def __repr__(self):
+        return f"Gauge({self.name}={self._value})"
+
+
+class Histogram:
+    """Fixed-bucket distribution (latencies, sizes).
+
+    ``buckets`` are ascending upper bounds; an implicit +Inf bucket catches
+    the overflow. ``quantile(q)`` gives a bucket-resolution estimate (good
+    enough for a p50/p99 column in a report, not for SLO math).
+    """
+
+    __slots__ = ("name", "buckets", "_counts", "_count", "_sum", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name: str, buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._count += 1
+            self._sum += value
+            if value < self._min:
+                self._min = value
+            if value > self._max:
+                self._max = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> float:
+        """Bucket-upper-bound estimate of the q-quantile (0 < q <= 1)."""
+        with self._lock:
+            total = self._count
+            if total == 0:
+                return 0.0
+            target = q * total
+            running = 0
+            for i, c in enumerate(self._counts):
+                running += c
+                if running >= target:
+                    return (self.buckets[i] if i < len(self.buckets)
+                            else self._max)
+            return self._max
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count = self._count
+            s = {"count": count, "sum": self._sum,
+                 "min": self._min if count else 0.0,
+                 "max": self._max if count else 0.0,
+                 "avg": (self._sum / count) if count else 0.0,
+                 "buckets": {("+Inf" if i == len(self.buckets)
+                              else repr(self.buckets[i])): c
+                             for i, c in enumerate(self._counts) if c}}
+        s["p50"] = self.quantile(0.5)
+        s["p99"] = self.quantile(0.99)
+        return s
+
+    def __repr__(self):
+        return f"Histogram({self.name} count={self._count})"
+
+
+class MetricsRegistry:
+    """Process-global name → metric map with typed accessors.
+
+    Accessors get-or-create: ``registry.counter("a.b").inc()`` is the whole
+    instrumentation idiom. Requesting an existing name as a different type
+    raises — silent type drift is how dashboards lie.
+    """
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, *args):
+        m = self._metrics.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get(name, Histogram, buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._metrics)
+
+    def snapshot(self) -> dict:
+        """Deep, stable snapshot: ``{"counters": {...}, "gauges": {...},
+        "histograms": {...}}``, names sorted. Safe to mutate or serialize."""
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if isinstance(m, Counter):
+                out["counters"][name] = m.snapshot()
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = m.snapshot()
+            else:
+                out["histograms"][name] = m.snapshot()
+        return out
+
+    def dump(self, fmt: str = "text") -> str:
+        """Human table (fmt="text") or machine JSON (fmt="json")."""
+        snap = self.snapshot()
+        if fmt == "json":
+            return json.dumps(snap, indent=2, default=float)
+        if fmt != "text":
+            raise ValueError(f"fmt must be 'text'|'json', got {fmt!r}")
+        lines = []
+        if snap["counters"]:
+            lines.append(f"{'Counter':<44}{'Value':>14}")
+            for n, v in snap["counters"].items():
+                lines.append(f"{n:<44}{v:>14}")
+        if snap["gauges"]:
+            if lines:
+                lines.append("")
+            lines.append(f"{'Gauge':<44}{'Value':>14}")
+            for n, v in snap["gauges"].items():
+                lines.append(f"{n:<44}{v:>14.6g}")
+        if snap["histograms"]:
+            if lines:
+                lines.append("")
+            lines.append(f"{'Histogram':<44}{'Count':>8}{'Avg':>12}"
+                         f"{'P50':>12}{'P99':>12}{'Max':>12}")
+            for n, h in snap["histograms"].items():
+                lines.append(f"{n:<44}{h['count']:>8}{h['avg']:>12.6g}"
+                             f"{h['p50']:>12.6g}{h['p99']:>12.6g}"
+                             f"{h['max']:>12.6g}")
+        return "\n".join(lines) if lines else "(no metrics)"
+
+    def reset(self) -> None:
+        """Drop every metric (tests; a fresh run's registry is empty)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+# the process-global default registry — module-level helpers delegate here
+registry = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return registry.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return registry.gauge(name)
+
+
+def histogram(name: str, buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return registry.histogram(name, buckets)
+
+
+def snapshot() -> dict:
+    return registry.snapshot()
+
+
+def dump(fmt: str = "text") -> str:
+    return registry.dump(fmt)
+
+
+def reset() -> None:
+    registry.reset()

@@ -1,0 +1,270 @@
+"""Span tracer — a low-overhead framework-level timeline.
+
+The reference MXNet's ``src/profiler/`` hooks the dependency engine and dumps
+a chrome-trace JSON of every op. Our engine is XLA, whose XPlane dump is
+opaque above the HLO level — so this tracer records the *framework* phases
+(data_wait / forward / backward / update / metric / checkpoint, RPCs,
+checkpoint commits, chaos injections) and exports them as:
+
+- **chrome-trace JSON** (``export_chrome_trace``): load the file in Perfetto
+  (ui.perfetto.dev) or ``chrome://tracing`` — one track per thread, so the
+  async checkpoint writer and prefetch workers show up beside the step loop;
+- **JSONL event stream** (``stream_to``): one JSON object per line, appended
+  and flushed as each span closes — survives SIGKILL mid-run (the chaos
+  harness's process kills), tail -f-able on headless workers.
+
+Overhead contract (tested in tests/test_obs.py):
+
+- **Disabled** (the default): ``span()`` returns a shared no-op singleton —
+  no event, no allocation retained, one module-flag check. The whole layer
+  is gated on this ONE flag (``_ENABLED``), flipped by ``obs.enable()`` /
+  ``MXNET_OBS=1``.
+- **Enabled**: ``__enter__``/``__exit__`` cost two ``time.monotonic()``
+  calls and one deque append into a bounded ring buffer (old events drop,
+  newest win — a long run cannot OOM the tracer).
+
+Spans nest per thread (a thread-local stack records depth); the context
+manager is reentrant across threads because each thread owns its stack.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import IO, List, Optional
+
+__all__ = ["Tracer", "span", "event", "events", "reset", "stream_to",
+           "to_chrome_trace", "export_chrome_trace", "tracer"]
+
+# THE module flag: obs.enable()/disable() flip it; every instrumentation
+# entry point checks it first. Plain module global — one LOAD_GLOBAL on the
+# hot path, no function call.
+_ENABLED = False
+
+
+def _trace_epoch() -> float:
+    return time.monotonic()
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager for disabled tracing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """A live span: records (name, start, duration, thread, depth, attrs)
+    on exit. Created only while tracing is enabled."""
+
+    __slots__ = ("_tracer", "name", "attrs", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._tracer._stack().append(self)
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.monotonic()
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        else:  # unbalanced exit (generator teardown etc.) — drop to self
+            while stack and stack[-1] is not self:
+                stack.pop()
+            if stack:
+                stack.pop()
+        self._tracer._record(
+            ("X", self.name, self.t0, t1 - self.t0,
+             threading.get_ident(), len(stack), self.attrs))
+        return False
+
+
+class Tracer:
+    """Bounded ring buffer of trace events + optional JSONL stream.
+
+    Event records (tuples, cheapest to append):
+      ("X", name, t_start, duration, tid, depth, attrs)   — completed span
+      ("i", name, t,        None,    tid, depth, attrs)   — instant event
+    Timestamps are ``time.monotonic()`` seconds; exporters rebase to the
+    tracer's epoch so traces start near t=0.
+    """
+
+    def __init__(self, capacity: int = 65536):
+        self.capacity = int(capacity)
+        self._events: deque = deque(maxlen=self.capacity)
+        self._local = threading.local()
+        self._epoch = _trace_epoch()
+        self._stream: Optional[IO[str]] = None
+        self._stream_lock = threading.Lock()
+
+    # -- hot path ----------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _record(self, rec: tuple) -> None:
+        self._events.append(rec)  # deque.append is atomic under the GIL
+        stream = self._stream
+        if stream is not None:
+            line = json.dumps(self._event_dict(rec), default=str)
+            with self._stream_lock:
+                if self._stream is not None:
+                    try:
+                        self._stream.write(line + "\n")
+                        self._stream.flush()  # survive SIGKILL mid-run
+                    except (OSError, ValueError):
+                        self._stream = None  # never fail training over a log
+
+    def span(self, name: str, **attrs) -> "_Span | _NoopSpan":
+        if not _ENABLED:
+            return _NOOP
+        return _Span(self, name, attrs or None)
+
+    def event(self, name: str, **attrs) -> None:
+        """Record an instant (zero-duration) event — chaos injections,
+        preemption signals, retries."""
+        if not _ENABLED:
+            return
+        self._record(("i", name, time.monotonic(), None,
+                      threading.get_ident(), len(self._stack()),
+                      attrs or None))
+
+    # -- introspection / export -------------------------------------------
+    def events(self) -> List[tuple]:
+        return list(self._events)
+
+    def reset(self) -> None:
+        self._events.clear()
+        self._epoch = _trace_epoch()
+
+    def stream_to(self, path: Optional[str]) -> None:
+        """Append completed events to ``path`` as JSONL (None closes)."""
+        with self._stream_lock:
+            if self._stream is not None:
+                try:
+                    self._stream.close()
+                except OSError:
+                    pass
+                self._stream = None
+            if path is not None:
+                self._stream = open(path, "a", buffering=1)
+
+    def stream_metrics(self, snapshot: dict) -> None:
+        """Append a metrics-snapshot record to the JSONL stream (written by
+        ``obs.disable()`` so a finished headless run's stream carries its
+        final metrics table; tools/trace_report.py reads it back)."""
+        with self._stream_lock:
+            if self._stream is not None:
+                try:
+                    self._stream.write(json.dumps(
+                        {"ph": "M", "name": "metrics",
+                         "metrics": snapshot}, default=float) + "\n")
+                    self._stream.flush()
+                except (OSError, ValueError):
+                    self._stream = None
+
+    def _event_dict(self, rec: tuple) -> dict:
+        ph, name, ts, dur, tid, depth, attrs = rec
+        d = {"ph": ph, "name": name, "ts": ts - self._epoch, "tid": tid,
+             "depth": depth}
+        if dur is not None:
+            d["dur"] = dur
+        if attrs:
+            d["args"] = attrs
+        return d
+
+    def to_chrome_trace(self, metrics: Optional[dict] = None) -> dict:
+        """Chrome Trace Event Format dict (Perfetto/about:tracing loadable).
+
+        Durations use "X" complete events; instants use "i". A metrics
+        snapshot rides along in ``otherData`` so one file carries the whole
+        observability state (tools/trace_report.py reads it back).
+        """
+        pid = os.getpid()
+        trace_events = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "mxnet_tpu"},
+        }]
+        tids = {}
+        for rec in list(self._events):
+            ph, name, ts, dur, tid, depth, attrs = rec
+            tids.setdefault(tid, len(tids))
+            ev = {"name": name, "ph": ph, "pid": pid, "tid": tid,
+                  "ts": (ts - self._epoch) * 1e6}
+            if ph == "X":
+                ev["dur"] = (dur or 0.0) * 1e6
+            else:
+                ev["s"] = "t"  # thread-scoped instant
+            if attrs:
+                ev["args"] = dict(attrs)
+            trace_events.append(ev)
+        for tid, idx in tids.items():
+            trace_events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": f"thread-{idx}"
+                         if idx else "main"}})
+        out = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+        if metrics is not None:
+            out["otherData"] = {"metrics": metrics}
+        return out
+
+    def export_chrome_trace(self, path: str,
+                            metrics: Optional[dict] = None) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(metrics), f, default=str)
+        return path
+
+
+# the process-global tracer; module-level helpers delegate here
+tracer = Tracer(capacity=int(os.environ.get("MXNET_OBS_BUFFER", "65536")))
+
+
+def span(name: str, **attrs):
+    """``with obs.trace.span("forward", epoch=3): ...`` — no-op singleton
+    when tracing is disabled."""
+    if not _ENABLED:
+        return _NOOP
+    return _Span(tracer, name, attrs or None)
+
+
+def event(name: str, **attrs) -> None:
+    if _ENABLED:
+        tracer.event(name, **attrs)
+
+
+def events() -> List[tuple]:
+    return tracer.events()
+
+
+def reset() -> None:
+    tracer.reset()
+
+
+def stream_to(path: Optional[str]) -> None:
+    tracer.stream_to(path)
+
+
+def to_chrome_trace(metrics: Optional[dict] = None) -> dict:
+    return tracer.to_chrome_trace(metrics)
+
+
+def export_chrome_trace(path: str, metrics: Optional[dict] = None) -> str:
+    return tracer.export_chrome_trace(path, metrics)

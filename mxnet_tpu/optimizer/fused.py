@@ -36,12 +36,14 @@ PJRT client does not implement donation).  See docs/PERFORMANCE.md.
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..ndarray import NDArray
 from ..ops import get_op
 
@@ -459,7 +461,8 @@ class FusedUpdateEngine:
                tuple(tuple(self._aval(x) for x in lp) for lp in state_leaves),
                scaler_on, factor, window, cgn_on, self._donate)
         jitted = self._cache.get(key)
-        if jitted is None:
+        is_compile = jitted is None
+        if is_compile:
             jitted = self._build(specs, mp, scaler_on, factor, window, cgn_on)
             self._cache[key] = jitted
             self.compile_log.append({
@@ -469,15 +472,32 @@ class FusedUpdateEngine:
                 "state_structure": specs,
                 "flags": (scaler_on, cgn_on),
             })
+            # telemetry: every compile counts; a compile AFTER the first is
+            # a retrace (something static churned — the TraceLinter's
+            # update-retrace-churn rule diagnoses which component)
+            obs.inc("update.compile")
+            if len(self.compile_log) > 1:
+                obs.inc("update.retrace")
 
         from .. import profiler
 
         if profiler.counting_dispatches():
             profiler.count_dispatch("compiled")
             profiler.count_dispatch("h2d")  # the packed lr/wd/t hyper vectors
-        new_ws, new_flat, new_ex, scaler_out = jitted(
-            ws, gs, state_leaves, lrs, wds, ts, rescale, scale, unskipped,
-            cgn_val, extras)
+        rec = obs.enabled()
+        t0 = time.monotonic() if rec else 0.0
+        with obs.trace.span("update.fused", optimizer=type(opt).__name__,
+                            n_params=n, compile=is_compile):
+            new_ws, new_flat, new_ex, scaler_out = jitted(
+                ws, gs, state_leaves, lrs, wds, ts, rescale, scale, unskipped,
+                cgn_val, extras)
+        if rec:
+            # first call traces+compiles (blocking); later calls only
+            # dispatch — on async backends this is dispatch wall time, not
+            # device time (docs/OBSERVABILITY.md)
+            obs.observe("update.compile_seconds" if is_compile
+                        else "update.execute_seconds",
+                        time.monotonic() - t0)
         self.exec_count += 1
 
         for w, nw in zip(weights, new_ws):

@@ -44,7 +44,20 @@ def aggregate_active() -> bool:
 # the fused update engine, Executor forward/backward, CachedOp calls, and
 # NDArray.asnumpy (device→host transfers).  Works on any backend, CPU
 # included — it counts dispatches, not device time.
+#
+# Storage is the obs metrics registry (``dispatch.*`` counters): every
+# count_dispatch() call feeds the registry, and a count_dispatches() region
+# is a before/after delta over those counters.  ONE choke point feeds both
+# the region view and the global metrics, so the two cannot drift
+# (docs/OBSERVABILITY.md).  Counting activates when a region is open OR
+# when obs telemetry is enabled; otherwise the call-site guard
+# (counting_dispatches()) keeps the hot path a no-op, exactly as before.
 # ---------------------------------------------------------------------------
+
+from . import obs as _obs
+
+_DISPATCH_KINDS = ("compiled", "eager_ops", "h2d", "d2h")
+
 
 class DispatchCounts:
     """Counters for one measured region."""
@@ -70,17 +83,22 @@ class DispatchCounts:
         return f"DispatchCounts({self.as_dict()})"
 
 
-_counts: "DispatchCounts | None" = None
+_open_regions = 0  # count_dispatches() nesting depth
 
 
 def counting_dispatches() -> bool:
-    return _counts is not None
+    """Call-site guard: True while a count_dispatches() region is open or
+    obs telemetry is enabled (the registry then accumulates globally)."""
+    return _open_regions > 0 or _obs.enabled()
 
 
 def count_dispatch(kind: str, n: int = 1) -> None:
-    c = _counts
-    if c is not None:
-        setattr(c, kind, getattr(c, kind) + n)
+    _obs.metrics.registry.counter("dispatch." + kind).inc(n)
+
+
+def _dispatch_totals() -> dict:
+    reg = _obs.metrics.registry
+    return {k: reg.counter("dispatch." + k).value for k in _DISPATCH_KINDS}
 
 
 @contextlib.contextmanager
@@ -90,15 +108,21 @@ def count_dispatches():
         with profiler.count_dispatches() as c:
             trainer.step(batch_size)
         assert c.total_compiled <= 2
+
+    The yielded counts are finalized when the region exits (they are a
+    delta over the registry's ``dispatch.*`` counters).
     """
-    global _counts
-    prev = _counts
+    global _open_regions
     c = DispatchCounts()
-    _counts = c
+    before = _dispatch_totals()
+    _open_regions += 1
     try:
         yield c
     finally:
-        _counts = prev
+        _open_regions -= 1
+        after = _dispatch_totals()
+        for k in _DISPATCH_KINDS:
+            setattr(c, k, after[k] - before[k])
 
 
 def record_op(name: str, seconds: float) -> None:

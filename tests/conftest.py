@@ -33,6 +33,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "perf: dispatch-count / perf-guarantee smoke tests "
         "(docs/PERFORMANCE.md); run via `pytest -m perf` or `make perf`")
+    config.addinivalue_line(
+        "markers", "obs: runtime telemetry tests — span tracer, metrics "
+        "registry, instrumented step (docs/OBSERVABILITY.md); run via "
+        "`pytest -m obs` or `make obs`")
 
 
 @pytest.fixture(autouse=True)

@@ -34,7 +34,7 @@ def profile_trainer_step(net, trainer, batch, batch_size=None, warmup=2):
     Returns {"fwd_bwd": counts, "update": counts} where counts are
     profiler.DispatchCounts.as_dict() dictionaries for the measured step.
     """
-    from mxnet_tpu import autograd, profiler
+    from mxnet_tpu import autograd, obs, profiler
 
     bs = batch_size or batch.shape[0]
 
@@ -44,11 +44,15 @@ def profile_trainer_step(net, trainer, batch, batch_size=None, warmup=2):
             loss = (out * out).sum()
         loss.backward()
         trainer.step(bs)
+    # DispatchCounts is a delta view over the obs metrics registry's
+    # dispatch.* counters (mxnet_tpu/obs — docs/OBSERVABILITY.md), so these
+    # regions and a --trace-out metrics table can never disagree
     with profiler.count_dispatches() as cf:
-        with autograd.record():
+        with obs.trace.span("forward"), autograd.record():
             out = net(batch)
             loss = (out * out).sum()
-        loss.backward()
+        with obs.trace.span("backward"):
+            loss.backward()
     with profiler.count_dispatches() as cu:
         trainer.step(bs)
     return {"fwd_bwd": cf.as_dict(), "update": cu.as_dict()}
@@ -98,10 +102,21 @@ def main(argv=None):
     ap.add_argument("--no-eager", action="store_true",
                     help="skip the MXNET_FUSED_UPDATE=0 comparison run")
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--trace-out", default=None, metavar="TRACE_JSON",
+                    help="also record an obs span timeline and write it "
+                         "(with the metrics snapshot) as chrome-trace JSON "
+                         "— view in Perfetto or tools/trace_report.py")
     args = ap.parse_args(argv)
+    if args.trace_out:
+        from mxnet_tpu import obs
+
+        obs.enable()
     res = profile_model(args.model, args.batch_size, args.image_size,
                         args.optimizer, {"learning_rate": args.lr},
                         eager=not args.no_eager, warmup=args.warmup)
+    if args.trace_out:
+        res["trace"] = obs.export(args.trace_out)
+        obs.disable()
     print(json.dumps(res, indent=2))
     return res
 

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""trace_report.py — terminal breakdown of an obs trace.
+
+Reads a chrome-trace ``trace.json`` (``mx.obs.export(...)`` /
+``tools/profile_step.py --trace-out``) or a JSONL event stream
+(``MXNET_OBS_JSONL=...``) and prints:
+
+1. the per-phase time breakdown — every span name aggregated
+   (count / total / mean / max / % of wall), step phases first;
+2. the top-N individual spans by duration (where did the spikes go);
+3. tagged instant events (chaos injections, RPC retries, preemptions);
+4. the metrics table (counters / gauges / histograms) embedded in the
+   trace (`otherData.metrics` in chrome traces, the final ``"ph": "M"``
+   record in JSONL streams).
+
+Usage::
+
+    python tools/trace_report.py trace.json [--top 10] [--json]
+
+No framework import needed — this parses the files, so it runs anywhere
+(including on a laptop against a trace scp'd off a TPU worker).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+# the canonical step phases (mxnet_tpu/obs — docs/OBSERVABILITY.md); shown
+# first and in pipeline order so a fit's breakdown reads top to bottom
+STEP_PHASES = ("data_wait", "forward", "backward", "update", "metric",
+               "checkpoint")
+
+
+def load_trace(path: str) -> Tuple[List[dict], List[dict], Optional[dict]]:
+    """Parse chrome-trace JSON or a JSONL stream into (spans, instants,
+    metrics). Spans/instants are normalized to seconds-based dicts:
+    {"name", "ts", "dur", "tid", "args"}."""
+    with open(path) as f:
+        text = f.read()
+    # chrome traces are one JSON document with "traceEvents"; JSONL lines
+    # each start with "{" too, so try the whole-document parse first
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        spans, instants = [], []
+        for ev in doc.get("traceEvents", []):
+            ph = ev.get("ph")
+            if ph == "X":
+                spans.append({"name": ev["name"],
+                              "ts": ev.get("ts", 0.0) / 1e6,
+                              "dur": ev.get("dur", 0.0) / 1e6,
+                              "tid": ev.get("tid"),
+                              "args": ev.get("args") or {}})
+            elif ph == "i":
+                instants.append({"name": ev["name"],
+                                 "ts": ev.get("ts", 0.0) / 1e6,
+                                 "tid": ev.get("tid"),
+                                 "args": ev.get("args") or {}})
+        metrics = (doc.get("otherData") or {}).get("metrics")
+        return spans, instants, metrics
+    # JSONL stream: one event per line, ts/dur already in seconds
+    spans, instants, metrics = [], [], None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue  # torn final line after a SIGKILL
+        ph = ev.get("ph")
+        if ph == "X":
+            spans.append({"name": ev["name"], "ts": ev.get("ts", 0.0),
+                          "dur": ev.get("dur", 0.0),
+                          "tid": ev.get("tid"),
+                          "args": ev.get("args") or {}})
+        elif ph == "i":
+            instants.append({"name": ev["name"], "ts": ev.get("ts", 0.0),
+                             "tid": ev.get("tid"),
+                             "args": ev.get("args") or {}})
+        elif ph == "M" and "metrics" in ev:
+            metrics = ev["metrics"]
+    return spans, instants, metrics
+
+
+def phase_breakdown(spans: List[dict]) -> List[dict]:
+    """Aggregate spans by name: step phases first (pipeline order), then
+    everything else by descending total time."""
+    agg = {}
+    for s in spans:
+        ent = agg.setdefault(s["name"], {"name": s["name"], "count": 0,
+                                         "total": 0.0, "max": 0.0})
+        ent["count"] += 1
+        ent["total"] += s["dur"]
+        ent["max"] = max(ent["max"], s["dur"])
+    wall = 0.0
+    if spans:
+        wall = (max(s["ts"] + s["dur"] for s in spans)
+                - min(s["ts"] for s in spans))
+    rows = []
+    for name in STEP_PHASES:
+        if name in agg:
+            rows.append(agg.pop(name))
+    rows.extend(sorted(agg.values(), key=lambda e: -e["total"]))
+    for r in rows:
+        r["avg"] = r["total"] / r["count"]
+        r["pct_wall"] = (100.0 * r["total"] / wall) if wall > 0 else 0.0
+    return rows
+
+
+def report(path: str, top: int = 10) -> dict:
+    """Build the full report as data (the CLI renders it; tests assert on
+    it)."""
+    spans, instants, metrics = load_trace(path)
+    out = {
+        "trace": path,
+        "n_spans": len(spans),
+        "n_events": len(instants),
+        "phases": phase_breakdown(spans),
+        "top_spans": sorted(spans, key=lambda s: -s["dur"])[:top],
+        "events": instants,
+        "metrics": metrics,
+    }
+    return out
+
+
+def _fmt_s(sec: float) -> str:
+    if sec >= 1.0:
+        return f"{sec:.3f}s"
+    return f"{sec * 1e3:.3f}ms"
+
+
+def render(rep: dict, stream=None) -> None:
+    out = stream or sys.stdout
+    w = out.write
+    w(f"trace: {rep['trace']}  "
+      f"({rep['n_spans']} spans, {rep['n_events']} events)\n\n")
+
+    w("Per-phase breakdown:\n")
+    w(f"  {'Phase':<28}{'Count':>7}{'Total':>12}{'Avg':>12}"
+      f"{'Max':>12}{'%Wall':>8}\n")
+    for r in rep["phases"]:
+        w(f"  {r['name']:<28}{r['count']:>7}{_fmt_s(r['total']):>12}"
+          f"{_fmt_s(r['avg']):>12}{_fmt_s(r['max']):>12}"
+          f"{r['pct_wall']:>7.1f}%\n")
+
+    if rep["top_spans"]:
+        w(f"\nTop {len(rep['top_spans'])} spans:\n")
+        for s in rep["top_spans"]:
+            args = (" " + json.dumps(s["args"], default=str)
+                    if s["args"] else "")
+            w(f"  {_fmt_s(s['dur']):>12}  {s['name']}{args}\n")
+
+    if rep["events"]:
+        w("\nTagged events:\n")
+        for e in rep["events"]:
+            args = (" " + json.dumps(e["args"], default=str)
+                    if e["args"] else "")
+            w(f"  t={e['ts']:.6f}s  {e['name']}{args}\n")
+
+    m = rep["metrics"]
+    if m:
+        w("\nMetrics:\n")
+        for name, v in (m.get("counters") or {}).items():
+            w(f"  {name:<44}{v:>14}\n")
+        for name, v in (m.get("gauges") or {}).items():
+            w(f"  {name:<44}{v:>14.6g}\n")
+        hists = m.get("histograms") or {}
+        if hists:
+            w(f"  {'histogram':<44}{'count':>8}{'avg':>12}{'p99':>12}"
+              f"{'max':>12}\n")
+            for name, h in hists.items():
+                w(f"  {name:<44}{h['count']:>8}{h['avg']:>12.6g}"
+                  f"{h.get('p99', 0.0):>12.6g}{h['max']:>12.6g}\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="trace.json (chrome) or events.jsonl")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many individual spans to list")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of tables")
+    args = ap.parse_args(argv)
+    rep = report(args.trace, top=args.top)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+    else:
+        render(rep)
+    return rep
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BrokenPipeError:  # `trace_report.py t.json | head` is routine
+        sys.exit(0)
