@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos perf obs
+.PHONY: lint lint-tests test test-fast chaos perf obs serve serve-bench
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -38,3 +38,12 @@ perf:
 # step phases, chaos-event tagging (docs/OBSERVABILITY.md)
 obs:
 	$(PYTHON) -m pytest tests/ -q -m obs -p no:cacheprovider
+
+# serving suite: compiled engine program bound, SLO scheduler, endpoint
+# lifecycle + chaos degradation (docs/SERVING.md)
+serve:
+	$(PYTHON) -m pytest tests/ -q -m serve -p no:cacheprovider
+
+# load generator: closed-loop + open-loop p50/p99 vs offered load
+serve-bench:
+	$(PYTHON) tools/serve_bench.py --model mlp --duration 5

@@ -418,6 +418,33 @@ def _lm_train_flops(n_layers, units, hidden, vocab, seq, batch):
     return 3 * fwd
 
 
+def bench_serve(platform):
+    """Serving trajectory (docs/SERVING.md): closed-loop load through the
+    full engine→batcher→socket stack on this chip. Headline gains:
+    ``serve_qps`` (throughput ceiling) and ``serve_p99_ms`` (tail latency
+    at that pressure), plus the compiled-program count as a regression
+    canary on the bucketing bound."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    model = os.environ.get("BENCH_SERVE_MODEL",
+                           "resnet18_v1" if platform == "tpu" else "mlp")
+    duration = float(os.environ.get("BENCH_SERVE_DURATION",
+                                    8 if platform == "tpu" else 4))
+    res = serve_bench.run_bench(
+        model=model, mode="closed", duration=duration,
+        clients=int(os.environ.get("BENCH_SERVE_CLIENTS", 4)),
+        max_batch_size=int(os.environ.get("BENCH_SERVE_BATCH", 8)))
+    return {"model": model,
+            "serve_qps": res["qps"],
+            "serve_p50_ms": res["p50_ms"],
+            "serve_p99_ms": res["p99_ms"],
+            "shed": res["shed"], "errors": res["errors"],
+            "compiled_programs": res.get("compiled_programs"),
+            "buckets": res.get("buckets")}
+
+
 def bench_update_engine_dispatches():
     """Compiled executions per optimizer step (tools/profile_step.py
     counters): the fused engine must stay at 1 program regardless of the
@@ -629,6 +656,14 @@ def main():
             bench_update_engine_dispatches()
     except Exception as e:
         extra["update_engine_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("serve"):
+        try:
+            # the inference half (docs/SERVING.md): closed-loop qps + tail
+            # latency through engine→batcher→socket, so BENCH_*.json
+            # captures the serving trajectory alongside training
+            extra["serve"] = bench_serve(platform)
+        except Exception as e:
+            extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
             and not over_budget("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's
@@ -672,6 +707,7 @@ def main():
         "bert_base_bf16": "bert_base_bf16",
         "lm_seq2048": "lm_seq2048_bf16",
         "lm_seq4096": "lm_seq4096_bf16",
+        "serve": "serve",
     }
     leg_error_key = {"bert_base_bf16": "bert_error"}  # irregular names
     extra["legs_run"] = [l for l, k in leg_result_key.items() if k in extra]

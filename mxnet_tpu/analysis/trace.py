@@ -239,6 +239,45 @@ class TraceLinter:
                          "and keep parameter shapes fixed"))
         return findings
 
+    # ---------------------------------------------------- serving engine
+    def check_serve_engine(self, engine, baseline: int = 0) -> List[Finding]:
+        """Prove the serving engine's compiled-program bound
+        (``serve/engine.py``): every ``compile_log`` entry must carry a
+        distinct input signature (a repeated key means jax retraced a
+        program the engine believed cached), and the distinct-signature
+        count must not exceed buckets × feature signatures — more means
+        bucketing is leaking ragged shapes straight to the compiler.
+        An empty finding list IS the proof tests assert on."""
+        findings: List[Finding] = []
+        log = engine.compile_log[baseline:]
+        if not log:
+            return findings
+        sigs = [e["sig"] for e in log]
+        dupes = {repr(s) for s in sigs if sigs.count(s) > 1}
+        if dupes:
+            findings.append(Finding(
+                "serve-retrace-churn", Severity.ERROR,
+                f"{len(dupes)} input signature(s) compiled more than once "
+                f"(e.g. {sorted(dupes)[0][:120]}); the per-signature "
+                "program cache is being bypassed",
+                node=type(engine).__name__,
+                fix_hint="keep parameter avals stable across reload() and "
+                         "don't mutate engine buckets after warmup"))
+        n_feat = len({tuple((shape[1:], dt) for shape, dt in s)
+                      for s in sigs})
+        bound = len(engine.buckets) * max(n_feat, 1)
+        if len(set(map(repr, sigs))) > bound:
+            findings.append(Finding(
+                "serve-retrace-churn", Severity.WARNING,
+                f"{len(set(map(repr, sigs)))} compiled programs exceed the "
+                f"bucket bound ({len(engine.buckets)} buckets × {n_feat} "
+                "feature signatures); ragged batch sizes are escaping "
+                "bucketing",
+                node=type(engine).__name__,
+                fix_hint="route all traffic through engine.infer (it pads "
+                         "to buckets); check for direct _jitted calls"))
+        return findings
+
     # ------------------------------------------------------------- public
     def lint(self, block, *example_inputs) -> Report:
         report = Report(self.scan_source(block))

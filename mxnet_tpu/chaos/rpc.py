@@ -37,7 +37,10 @@ from .. import obs
 
 __all__ = ["Rule", "configure", "reset", "on_send", "on_reply", "enabled"]
 
-# opcode value -> canonical rule name (mirrors kvstore/ps_server.py opcodes)
+# opcode value -> canonical rule name (mirrors kvstore/ps_server.py opcodes).
+# The serving plane (opcodes 32+) registers its names here on import —
+# serve/server.py does OP_NAMES.update(SERVE_OP_NAMES), ONE source of truth —
+# so one rule table fault-injects both training and inference RPCs.
 OP_NAMES = {0: "init", 1: "push", 2: "pull", 3: "set_opt", 4: "barrier",
             5: "shutdown", 6: "push_sparse", 7: "pull_sparse", 8: "push_seq",
             9: "push_sparse_seq"}

@@ -355,16 +355,22 @@ def _calibrate_ranges(sym, arg_params, aux_params, calib_data, data_names,
     group = S_mod.Group([n._inputs[0] for n in nodes])
     ranges = {}
     seen = 0
-    exe = None
+    execs = {}  # data-shape signature -> bound executor
     for batch in calib_data:
         xs = batch.data if hasattr(batch, "data") else [batch]
         xs = xs if isinstance(xs, (list, tuple)) else [xs]
         feed = dict(zip(data_names, xs))
         feed.update(arg_params)
         feed.update(aux_params or {})
-        if exe is None:  # bind ONCE: per-batch eval() would recompile
-            exe = group.simple_bind(grad_req="null",
-                                    **{k: v.shape for k, v in feed.items()})
+        # bind once PER DATA SHAPE: the steady-state batches share one
+        # executor, and a ragged final batch (num_calib_examples not a
+        # multiple of the batch size) gets its own bind instead of a
+        # mid-calibration shape-mismatch crash
+        sig = tuple(tuple(x.shape) for x in xs)
+        exe = execs.get(sig)
+        if exe is None:
+            exe = execs[sig] = group.simple_bind(
+                grad_req="null", **{k: v.shape for k, v in feed.items()})
         outs = exe.forward(is_train=False, **feed)
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
         for n, o in zip(nodes, outs):

@@ -397,13 +397,16 @@ class HybridBlock(Block):
         """
         import json
 
+        # normalize up front: the graph-embed below also counts inputs,
+        # and a bare NDArray would make len() return its batch dimension
+        if example_inputs is not None and \
+                not isinstance(example_inputs, (list, tuple)):
+            example_inputs = (example_inputs,)
         # validate BEFORE any file is written — a raise after
         # save_parameters would leave a truncated checkpoint on disk
         if format in ("onnx", "stablehlo"):
             if example_inputs is None:
                 raise ValueError(f"{format} export needs example_inputs")
-            if not isinstance(example_inputs, (list, tuple)):
-                example_inputs = (example_inputs,)
             deferred = [p.name for p in self._iter_params()
                         if p._data is None]
             if deferred:
@@ -415,6 +418,25 @@ class HybridBlock(Block):
 
         self.save_parameters(f"{path}-{epoch:04d}.params")
         meta = {"format": "mxnet_tpu-hybrid", "class": self.__class__.__name__}
+        # Embed the traced graph + a saved-name → variable-name map so the
+        # artifact is servable (mxnet_tpu.serve.load) and reloadable as a
+        # SymbolBlock without the original class. Best-effort: a block
+        # whose forward is not F-generic exports params-only, as before.
+        try:
+            from .. import symbol as sym_mod
+
+            n_inputs = len(example_inputs) if example_inputs is not None else 1
+            data_syms = [sym_mod.Variable(f"data{i}" if i else "data")
+                         for i in range(n_inputs)]
+            traced = self(*data_syms)
+            if isinstance(traced, (list, tuple)):
+                traced = sym_mod.Group(list(traced))
+            meta["symbol"] = traced.tojson()
+            meta["param_map"] = {
+                saved: p.name for saved, p in
+                self._collect_params_with_prefix().items()}
+        except Exception:  # noqa: BLE001 — tracing is optional here
+            pass
         if format == "onnx":
             from .. import symbol as sym_mod
             from ..contrib.onnx import export_model

@@ -94,6 +94,26 @@ def _unpack_sparse(buf: memoryview):
     return _unpack_array(buf[:n]), _unpack_array(buf[n:])
 
 
+def _pack_arrays(arrays) -> bytes:
+    """N arrays on one payload: u8 count, then each in the array framing
+    above (the sparse wire generalized — mxnet_tpu.serve's multi-input
+    requests and multi-output replies ride this)."""
+    if len(arrays) > 255:
+        raise ValueError(f"too many arrays for one frame ({len(arrays)})")
+    return struct.pack("<B", len(arrays)) + b"".join(
+        _pack_array(np.ascontiguousarray(a)) for a in arrays)
+
+
+def _unpack_arrays(buf: memoryview):
+    (count,) = struct.unpack_from("<B", buf, 0)
+    out, off = [], 1
+    for _ in range(count):
+        n = _array_nbytes(buf[off:])
+        out.append(_unpack_array(buf[off:off + n]))
+        off += n
+    return out, off
+
+
 def _send_msg(sock: socket.socket, opcode: int, key: str = "", payload: bytes = b""):
     kb = key.encode()
     body = struct.pack("<BH", opcode, len(kb)) + kb + payload
@@ -187,8 +207,10 @@ class PSServer:
             self._sock.close()
         except OSError:
             pass
-        for c in self._conns:  # sever live sessions too — a stopped server
-            try:               # must look dead, not half-alive
+        # snapshot: _handle threads concurrently .remove() from _conns and
+        # iterating the live list could skip a neighbor of a removed entry
+        for c in list(self._conns):  # sever live sessions too — a stopped
+            try:                     # server must look dead, not half-alive
                 c.close()
             except OSError:
                 pass

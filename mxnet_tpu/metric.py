@@ -363,7 +363,10 @@ class MApMetric(EvalMetric):
         for row in dets:
             c = int(row[0])
             rec = self._records.setdefault(c, [])
-            cand = np.where((gts[:, 0] == c) & ~matched)[0]
+            # VOC devkit semantics: argmax IoU over ALL GTs of the class
+            # (matched ones included) — a duplicate of an already-matched
+            # GT is an FP, it must NOT fall back to the second-best GT
+            cand = np.where(gts[:, 0] == c)[0]
             if len(cand) == 0:
                 rec.append((float(row[1]), 0))
                 continue
@@ -376,8 +379,11 @@ class MApMetric(EvalMetric):
                     # fp) and the difficult GT is NEVER consumed — later
                     # detections may still match it and be ignored too
                     continue
-                matched[gi] = True
-                rec.append((float(row[1]), 1))
+                if matched[gi]:
+                    rec.append((float(row[1]), 0))  # duplicate hit: FP
+                else:
+                    matched[gi] = True
+                    rec.append((float(row[1]), 1))
             else:
                 rec.append((float(row[1]), 0))
 

@@ -41,6 +41,15 @@ def load_checkpoint(prefix, epoch):
 
     symbol = sym_mod.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(prefix, epoch)
+    # Normalize arg/aux placement to the RELOADED graph's view: a
+    # checkpoint saved from a traced-gluon Module stores BatchNorm moving
+    # stats under ``arg:`` (the trace makes them plain variables), while
+    # load_json re-derives them as auxiliary states from the op registry —
+    # without this re-split such stats would be silently dropped on bind.
+    aux_names = set(symbol.list_auxiliary_states())
+    merged = {**arg_params, **aux_params}
+    arg_params = {k: v for k, v in merged.items() if k not in aux_names}
+    aux_params = {k: v for k, v in merged.items() if k in aux_names}
     return symbol, arg_params, aux_params
 
 

@@ -35,8 +35,8 @@ import time
 from collections import deque
 from typing import IO, List, Optional
 
-__all__ = ["Tracer", "span", "event", "events", "reset", "stream_to",
-           "to_chrome_trace", "export_chrome_trace", "tracer"]
+__all__ = ["Tracer", "span", "event", "complete", "events", "reset",
+           "stream_to", "to_chrome_trace", "export_chrome_trace", "tracer"]
 
 # THE module flag: obs.enable()/disable() flip it; every instrumentation
 # entry point checks it first. Plain module global — one LOAD_GLOBAL on the
@@ -147,6 +147,19 @@ class Tracer:
                       threading.get_ident(), len(self._stack()),
                       attrs or None))
 
+    def complete(self, name: str, t_start: float, duration: float,
+                 **attrs) -> None:
+        """Record an already-measured span with an explicit start and
+        duration (``time.monotonic()`` seconds) — for phases whose
+        endpoints live on different threads, e.g. a serve request's
+        queue_wait measured between the submitter's enqueue and the
+        batcher's dispatch."""
+        if not _ENABLED:
+            return
+        self._record(("X", name, t_start, max(duration, 0.0),
+                      threading.get_ident(), len(self._stack()),
+                      attrs or None))
+
     # -- introspection / export -------------------------------------------
     def events(self) -> List[tuple]:
         return list(self._events)
@@ -248,6 +261,12 @@ def span(name: str, **attrs):
 def event(name: str, **attrs) -> None:
     if _ENABLED:
         tracer.event(name, **attrs)
+
+
+def complete(name: str, t_start: float, duration: float, **attrs) -> None:
+    """Module-level passthrough to :meth:`Tracer.complete`."""
+    if _ENABLED:
+        tracer.complete(name, t_start, duration, **attrs)
 
 
 def events() -> List[tuple]:

@@ -402,6 +402,7 @@ class ZoneoutCell(BaseRNNCell):
         self._base = base_cell
         self._zo = zoneout_outputs
         self._zs = zoneout_states
+        self._prev_output = None
 
     @property
     def state_info(self):
@@ -410,6 +411,11 @@ class ZoneoutCell(BaseRNNCell):
     def begin_state(self, *a, **kw):
         return self._base.begin_state(*a, **kw)
 
+    def reset(self):
+        super().reset()
+        self._base.reset()
+        self._prev_output = None  # a new sequence starts from zero output
+
     def __call__(self, inputs, states):
         prev = self._base._materialize(inputs, states)
         out, new_states = self._base(inputs, prev)
@@ -417,5 +423,12 @@ class ZoneoutCell(BaseRNNCell):
             new_states = [p * self._zs + n * (1.0 - self._zs)
                           for p, n in zip(prev, new_states)]
         if self._zo:
-            out = out * (1.0 - self._zo)
+            # expectation blend like the state path: prev*p + next*(1-p),
+            # with the previous OUTPUT tracked across steps (zero at t=0,
+            # the reference's prev_output initial value) — not the
+            # out*(1-p) attenuation that assumed prev were always zero
+            prev_out = (self._prev_output if self._prev_output is not None
+                        else out * 0.0)
+            out = prev_out * self._zo + out * (1.0 - self._zo)
+            self._prev_output = out
         return out, new_states

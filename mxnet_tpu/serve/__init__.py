@@ -1,0 +1,160 @@
+"""``mxnet_tpu.serve`` — compiled inference with dynamic batching and
+SLO-aware scheduling (docs/SERVING.md).
+
+The inference half of the framework: take any trained artifact and turn it
+into a concurrent, low-latency endpoint.
+
+Layers
+------
+- :class:`~mxnet_tpu.serve.engine.InferenceEngine` — one compiled XLA
+  program per bucketed input shape, parameters device-resident and
+  hot-reloadable (``engine.py``);
+- :class:`~mxnet_tpu.serve.batcher.DynamicBatcher` — micro-batching with
+  deadlines, priority lanes, and load shedding (``batcher.py``);
+- :class:`~mxnet_tpu.serve.server.ServeServer` /
+  :class:`~mxnet_tpu.serve.client.ServeClient` — a threaded socket front
+  end on the parameter-server wire format, with health/readiness probes,
+  draining shutdown, and hot model reload (``server.py`` / ``client.py``).
+
+Typical session::
+
+    import mxnet_tpu as mx
+
+    engine = mx.serve.load("model/ckpt", epoch=3)        # any artifact kind
+    engine.warmup((3, 32, 32))                           # compile buckets
+    server = mx.serve.ServeServer(engine, port=9191)
+    server.start()
+    ...
+    client = mx.serve.ServeClient("localhost", 9191)
+    probs = client.infer(batch, deadline_ms=50, priority=0)
+
+``load`` understands three artifact kinds:
+
+1. a ``Module.save_checkpoint`` prefix (``prefix-symbol.json`` +
+   ``prefix-NNNN.params``) — ``epoch`` picks the file (default: newest);
+2. a ``HybridBlock.export`` path whose descriptor embeds the traced graph
+   (exports made by this version do automatically);
+3. a ``checkpoint/`` manager directory (crash-safe training checkpoints) —
+   pass ``symbol=`` since training checkpoints store only tensors.
+
+``quantize_model`` int8 rewrites serve through the same engine: construct
+:class:`InferenceEngine` directly with ``(qsym, qarg, aux)``.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Optional, Tuple
+
+from .batcher import DynamicBatcher, Future
+from .engine import (DeadlineExceeded, Draining, InferenceEngine,
+                     RequestRejected, ServeError, default_buckets)
+from .server import ServeServer
+from .client import ServeClient
+
+__all__ = ["load", "load_params", "InferenceEngine", "DynamicBatcher",
+           "Future", "ServeServer", "ServeClient", "ServeError",
+           "RequestRejected", "DeadlineExceeded", "Draining",
+           "default_buckets"]
+
+
+def _newest_epoch(path: str) -> int:
+    pat = re.compile(re.escape(os.path.basename(path))
+                     + r"-(\d{4,})\.params$")
+    epochs = [int(m.group(1)) for f in glob.glob(f"{path}-*.params")
+              for m in [pat.match(os.path.basename(f))] if m]
+    if not epochs:
+        raise ServeError(f"no {path}-NNNN.params files found")
+    return max(epochs)
+
+
+def _split_arg_aux(params: dict, symbol) -> Tuple[dict, dict]:
+    aux_names = set(symbol.list_auxiliary_states())
+    arg = {k: v for k, v in params.items() if k not in aux_names}
+    aux = {k: v for k, v in params.items() if k in aux_names}
+    return arg, aux
+
+
+def _load_artifact(path: str, epoch: Optional[int], symbol,
+                   prefix: str):
+    """Resolve an artifact to ``(symbol, arg_params, aux_params)``."""
+    from ..symbol import load_json as sym_load_json
+
+    if os.path.isdir(path):
+        # checkpoint-manager directory (crash-safe training checkpoints)
+        from ..checkpoint import CheckpointManager
+
+        if symbol is None:
+            raise ServeError(
+                f"{path!r} is a checkpoint directory; training checkpoints "
+                "store tensors only — pass symbol= (the trained graph)")
+        mgr = CheckpointManager(path, prefix=prefix)
+        state = mgr.load(epoch) if epoch is not None else mgr.load_latest()
+        if state is None:
+            raise ServeError(f"no valid checkpoint found in {path!r}")
+        return symbol, state.arg_params(), state.aux_params()
+
+    sym_file = f"{path}-symbol.json"
+    if not os.path.exists(sym_file):
+        raise ServeError(
+            f"{path!r} is neither a checkpoint directory nor a checkpoint "
+            f"prefix ({sym_file} missing)")
+    with open(sym_file) as f:
+        desc = json.load(f)
+    if isinstance(desc, dict) and "nodes" in desc:
+        # Module.save_checkpoint artifact: graph json + arg:/aux: params
+        from ..model import load_checkpoint
+
+        if epoch is None:
+            epoch = _newest_epoch(path)
+        sym, arg, aux = load_checkpoint(path, epoch)
+        return (symbol or sym), arg, aux
+    if isinstance(desc, dict) and desc.get("format") == "mxnet_tpu-hybrid":
+        # HybridBlock.export artifact: descriptor + save_parameters file
+        from ..ndarray import load as nd_load
+
+        if symbol is None:
+            if "symbol" not in desc:
+                raise ServeError(
+                    f"{sym_file} has no embedded graph (exported by an "
+                    "older version, or the block does not trace "
+                    "symbolically); re-export, or pass symbol=")
+            symbol = sym_load_json(desc["symbol"])
+        if epoch is None:
+            epoch = _newest_epoch(path)
+        loaded = nd_load(f"{path}-{epoch:04d}.params")
+        # save_parameters keys are attribute paths; the embedded map takes
+        # them to the graph's variable names
+        param_map = desc.get("param_map") or {}
+        renamed = {param_map.get(k, k): v for k, v in loaded.items()}
+        arg, aux = _split_arg_aux(renamed, symbol)
+        return symbol, arg, aux
+    raise ServeError(f"unrecognized artifact descriptor {sym_file}")
+
+
+def load(path: str, epoch: Optional[int] = None, symbol=None, *,
+         prefix: str = "ckpt", **engine_kwargs) -> InferenceEngine:
+    """Build an :class:`InferenceEngine` from any trained artifact (see
+    the module docstring for the three artifact kinds). Extra kwargs go to
+    the engine (``max_batch_size``, ``buckets``, ``data_names``,
+    ``lint``)."""
+    sym, arg, aux = _load_artifact(path, epoch, symbol, prefix)
+    return InferenceEngine(sym, arg, aux, **engine_kwargs)
+
+
+def load_params(path: str, epoch: Optional[int] = None, *,
+                prefix: str = "ckpt", symbol=None) -> Tuple[dict, dict]:
+    """Load just ``(arg_params, aux_params)`` from an artifact — the hot
+    model-reload path (``ServeServer.reload`` / ``engine.reload``)."""
+    if os.path.isdir(path):
+        from ..checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(path, prefix=prefix)
+        state = mgr.load(epoch) if epoch is not None else mgr.load_latest()
+        if state is None:
+            raise ServeError(f"no valid checkpoint found in {path!r}")
+        return state.arg_params(), state.aux_params()
+    sym, arg, aux = _load_artifact(path, epoch, symbol, prefix)
+    return arg, aux

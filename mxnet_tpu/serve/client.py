@@ -1,0 +1,215 @@
+"""Client for the ``mxnet_tpu.serve`` socket endpoint.
+
+Mirrors ``kvstore/ps_client.py``: every RPC has a socket timeout and a
+reconnect-retry loop with capped exponential backoff + jitter, and the
+chaos layer (``mxnet_tpu.chaos.rpc``) can deterministically drop / delay /
+duplicate frames at the marked points — so the degradation paths the
+server promises are *tested* against a real flaky wire, not hoped for.
+
+Inference is stateless, so retrying an INFER whose reply was lost is safe
+(the server may execute it twice; both executions return the same answer
+for the same parameter generation). Deadlines still bound the total retry
+budget: a request whose SLO has expired is not worth re-sending, so the
+retry loop gives up once the deadline passes and surfaces
+:class:`DeadlineExceeded`.
+"""
+from __future__ import annotations
+
+import json
+import random
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Union
+
+import numpy as np
+
+from .. import obs
+from ..chaos import rpc as chaos_rpc
+from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
+                                 _unpack_arrays)
+from .engine import (DeadlineExceeded, Draining, RequestRejected, ServeError)
+from .server import (OP_DRAIN, OP_HEALTH, OP_INFER, OP_READY, OP_RELOAD,
+                     OP_SHUTDOWN, OP_STATS, SERVE_OP_NAMES, STATUS_BAD_REQUEST,
+                     STATUS_DEADLINE, STATUS_DRAINING, STATUS_INTERNAL,
+                     STATUS_NOT_READY, STATUS_OK, STATUS_REJECTED, _INFER_HDR)
+
+__all__ = ["ServeClient"]
+
+_STATUS_ERRORS = {
+    STATUS_REJECTED: RequestRejected,
+    STATUS_DEADLINE: DeadlineExceeded,
+    STATUS_DRAINING: Draining,
+    STATUS_BAD_REQUEST: ServeError,
+    STATUS_INTERNAL: ServeError,
+    STATUS_NOT_READY: ServeError,
+}
+
+
+class ServeClient:
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 retries: int = 3, retry_interval: float = 0.2,
+                 retry_max_interval: float = 2.0):
+        self._addr = (host, port)
+        self._timeout = float(timeout)
+        self._retries = max(1, int(retries))
+        self._retry_interval = retry_interval
+        self._retry_max_interval = retry_max_interval
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._connect()
+
+    # ------------------------------------------------------------------
+    def _connect(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+
+    def _backoff(self, attempt: int) -> float:
+        delay = min(self._retry_max_interval,
+                    self._retry_interval * (2.0 ** attempt))
+        return delay * (0.5 + random.random() / 2.0)
+
+    def _rpc(self, opcode: int, payload: bytes = b"",
+             deadline: Optional[float] = None):
+        """Send one frame, return the reply payload. Reconnect-retries on
+        connection errors; gives up early once ``deadline`` (monotonic
+        seconds) has passed — retrying past the SLO only adds load."""
+        retries = self._retries
+        last_err = None
+        opname = SERVE_OP_NAMES.get(opcode, str(opcode))
+        with self._lock:
+            for attempt in range(retries):
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise DeadlineExceeded(
+                        f"deadline expired during {opname} retries "
+                        f"(last error: {last_err})")
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    rec = obs.enabled()
+                    t0 = time.monotonic() if rec else 0.0
+                    with obs.trace.span("serve.client.rpc", op=opname,
+                                        attempt=attempt):
+                        dup = chaos_rpc.on_send(opcode, "")
+                        _send_msg(self._sock, opcode, "", payload)
+                        if dup == "dup":
+                            _send_msg(self._sock, opcode, "", payload)
+                        reply = _recv_msg(self._sock)
+                        if dup == "dup":
+                            reply = _recv_msg(self._sock)
+                        chaos_rpc.on_reply(opcode, "")
+                    if rec:
+                        obs.observe(f"serve.client.{opname}_seconds",
+                                    time.monotonic() - t0)
+                    return reply[2]
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    delay = self._backoff(attempt)
+                    if obs.enabled():
+                        obs.inc("serve.client.retries")
+                        obs.trace.event("serve.client.retry", op=opname,
+                                        attempt=attempt, error=str(e))
+                    time.sleep(delay)
+        obs.inc("serve.client.failures")
+        raise ServeError(
+            f"serve rpc {opname} failed after {retries} attempts: "
+            f"{last_err}")
+
+    @staticmethod
+    def _check(payload, what: str) -> memoryview:
+        status = payload[0]
+        if status == STATUS_OK:
+            return payload[1:]
+        msg = bytes(payload[1:]).decode("utf-8", "replace") or what
+        raise _STATUS_ERRORS.get(status, ServeError)(msg)
+
+    # ------------------------------------------------------------------
+    # API
+    # ------------------------------------------------------------------
+    def infer(self, *inputs, deadline_ms: Optional[float] = None,
+              priority: int = 1, return_version: bool = False
+              ) -> Union[np.ndarray, List[np.ndarray], tuple]:
+        """Run inference on one request batch (one array per model input).
+        ``deadline_ms`` propagates to the server's scheduler — an expired
+        request is shed there, never executed late. ``priority`` 0 is the
+        tight-SLO lane. Returns the output array (or list), plus the
+        serving parameter version when ``return_version``."""
+        arrays = [np.ascontiguousarray(np.asarray(x)) for x in inputs]
+        payload = (_INFER_HDR.pack(float(deadline_ms or 0.0),
+                                   min(max(int(priority), 0), 255))
+                   + _pack_arrays(arrays))
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms else None)
+        reply = self._check(self._rpc(OP_INFER, payload, deadline=deadline),
+                            "inference failed")
+        (version,) = struct.unpack_from("<I", reply, 0)
+        outs, _ = _unpack_arrays(reply[4:])
+        result = outs[0] if len(outs) == 1 else outs
+        return (result, version) if return_version else result
+
+    def health(self) -> bool:
+        """Liveness probe (True = the process answers)."""
+        try:
+            return self._rpc(OP_HEALTH)[0] == STATUS_OK
+        except ServeError:
+            return False
+
+    def ready(self) -> bool:
+        """Readiness probe (True = model loaded and accepting traffic —
+        False while draining, so a load balancer rotates this replica
+        out before requests start bouncing)."""
+        try:
+            return self._rpc(OP_READY)[0] == STATUS_OK
+        except ServeError:
+            return False
+
+    def stats(self) -> dict:
+        reply = self._check(self._rpc(OP_STATS), "stats failed")
+        return json.loads(bytes(reply).decode("utf-8"))
+
+    def reload(self, path: str, epoch: Optional[int] = None,
+               prefix: str = "ckpt") -> int:
+        """Hot-swap the server onto a newer checkpoint of the same model.
+        Returns the new parameter version."""
+        spec = {"path": path, "epoch": epoch, "prefix": prefix}
+        reply = self._check(
+            self._rpc(OP_RELOAD, json.dumps(spec).encode("utf-8")),
+            "reload failed")
+        (version,) = struct.unpack_from("<I", reply, 0)
+        return version
+
+    def drain(self, stop: bool = False) -> bool:
+        """Ask the server to finish in-flight work and refuse new requests
+        (``stop=True`` also closes the listener afterwards)."""
+        payload = struct.pack("<B", 1 if stop else 0)
+        return self._rpc(OP_DRAIN, payload)[0] == STATUS_OK
+
+    def shutdown(self) -> None:
+        self._rpc(OP_SHUTDOWN)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

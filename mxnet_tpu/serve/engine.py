@@ -1,0 +1,471 @@
+"""Compiled inference executor — the device half of ``mxnet_tpu.serve``.
+
+Reference: the MXNet Model Server ran inference through threaded CachedOp
+executors (``python/mxnet/gluon/block.py`` CachedOp + mms's batching handler
+— TBV, SURVEY.md §1). TPU redesign: one **donation-free ``jax.jit``
+program per bucketed input shape**, parameters device-resident and passed
+as *traced arguments* — so a hot parameter reload swaps arrays without a
+single retrace, and the compiled-program count is bounded by construction:
+
+- **Shape bucketing**: a request batch of ``n`` rows is padded up to the
+  smallest configured bucket ≥ n (pad rows are zeros; outputs are sliced
+  back to ``n`` — rows are independent in eval mode, BatchNorm uses its
+  moving stats, so the valid rows are bitwise what an unpadded run with the
+  same program would produce). Ragged traffic therefore compiles at most
+  ``len(buckets) × distinct feature signatures`` programs, ever.
+- **Cache-key accounting** mirrors ``optimizer/fused.py``: every program is
+  keyed explicitly (input avals), ``compile_log`` records one entry per
+  compilation, and the TraceLinter's ``serve-retrace-churn`` rule
+  (``analysis/trace.py``) turns that log into a *proof* that the bound
+  holds — a key compiled twice, or more programs than buckets admit, is a
+  linted defect, not a hunch.
+- **Hot reload**: ``reload()`` validates the new parameter set against the
+  current avals (a shape/dtype drift would silently double the program
+  count) and swaps the whole device-resident set atomically under a lock.
+  In-flight executions hold the snapshot they started with — a request sees
+  *old or new* parameters, never a mix.
+
+Telemetry (docs/OBSERVABILITY.md): ``serve.execute`` spans per batch with
+bucket/compile attribution, ``serve.compile_seconds`` vs
+``serve.execute_seconds`` histograms, ``dispatch.*`` counters feeding
+``profiler.count_dispatches()`` so tests can assert the program bound.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..base import MXNetError
+
+__all__ = ["InferenceEngine", "ServeError", "RequestRejected",
+           "DeadlineExceeded", "Draining", "default_buckets"]
+
+
+class ServeError(MXNetError):
+    """Base error of the serving subsystem."""
+
+
+class RequestRejected(ServeError):
+    """Load shed: the request was refused before execution (HTTP-429
+    analog) — queue over watermark, or the server is not accepting."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline expired before (or while) it could run; it
+    was shed, not executed."""
+
+
+class Draining(ServeError):
+    """The endpoint is draining for shutdown and refuses new work."""
+
+
+def _to_device(v):
+    """NDArray/numpy → device array (load-time AND reload-time parameter
+    placement share this one helper so they can never diverge)."""
+    import jax
+
+    from ..ndarray import NDArray
+
+    if isinstance(v, NDArray) and v._data is not None:
+        return v._data
+    return jax.device_put(np.ascontiguousarray(np.asarray(v)))
+
+
+def default_buckets(max_batch_size: int) -> List[int]:
+    """Power-of-two batch buckets up to ``max_batch_size`` (which is always
+    included, power of two or not): 32 → [1, 2, 4, 8, 16, 32]."""
+    max_batch_size = int(max_batch_size)
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1")
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return out
+
+
+class _ParamSet:
+    """One immutable generation of device-resident parameters. Executions
+    snapshot the reference once, so a concurrent reload can never hand a
+    program half-old half-new arrays."""
+
+    __slots__ = ("version", "arg_vals", "aux_vals")
+
+    def __init__(self, version: int, arg_vals: tuple, aux_vals: tuple):
+        self.version = version
+        self.arg_vals = arg_vals
+        self.aux_vals = aux_vals
+
+
+class InferenceEngine:
+    """Serve a trained symbolic graph as compiled, bucketed inference.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The inference graph (a trained Module's symbol, a gluon export's
+        embedded trace, or a ``quantize_model`` int8 rewrite).
+    arg_params / aux_params : dict[str, array]
+        Trained parameters (NDArray or numpy). Graph arguments that are
+        neither data nor parameters (e.g. ``softmax_label`` on a training
+        head) are bound to zeros per bucket — they don't affect eval-mode
+        outputs.
+    data_names : sequence of str
+        Which graph arguments are request inputs, in request order.
+    max_batch_size : int
+        Largest bucket; requests bigger than this are chunked.
+    buckets : sequence of int, optional
+        Explicit batch buckets (sorted, deduped). Default:
+        ``default_buckets(max_batch_size)``.
+    lint : "off" | "warn" | "error"
+        Pre-flight ``Symbol.lint`` at load time; "error" refuses to serve a
+        graph with error-severity findings (a bad graph should fail at
+        deploy, not on the first customer request).
+    """
+
+    def __init__(self, symbol, arg_params, aux_params=None, *,
+                 data_names: Sequence[str] = ("data",),
+                 max_batch_size: int = 32,
+                 buckets: Optional[Sequence[int]] = None,
+                 lint: str = "warn",
+                 pad_value: float = 0.0):
+        import jax
+
+        from ..executor import _build_graph_fn
+
+        self.symbol = symbol
+        self._data_names = list(data_names)
+        if buckets is None:
+            buckets = default_buckets(max_batch_size)
+        self.buckets: List[int] = sorted(set(int(b) for b in buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"invalid buckets {buckets!r}")
+        self.max_batch_size = self.buckets[-1]
+        self._pad_value = float(pad_value)
+
+        arg_params = dict(arg_params or {})
+        aux_params = dict(aux_params or {})
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        missing_data = [n for n in self._data_names if n not in arg_names]
+        if missing_data:
+            raise ServeError(
+                f"data_names {missing_data} are not arguments of the graph "
+                f"(arguments: {arg_names})")
+        self._param_names = [n for n in arg_names
+                             if n not in self._data_names and n in arg_params]
+        # training-head leftovers (labels): zero-filled per bucket — they
+        # must not force the client to ship dummy tensors over the wire.
+        # ONLY label-like names qualify: zero-filling an arbitrary missing
+        # weight (a name-mismatched or truncated checkpoint) would serve
+        # garbage silently, the exact bug class the aux check below rejects
+        self._free_names = [n for n in arg_names
+                            if n not in self._data_names
+                            and n not in arg_params]
+        not_label = [n for n in self._free_names if "label" not in n]
+        if not_label:
+            raise ServeError(
+                f"graph arguments {not_label} are neither inputs nor in "
+                "arg_params — a zero-filled weight would serve wrong "
+                "predictions silently; fix the checkpoint/param_map, or "
+                "list them in data_names if they are real inputs")
+        self._aux_names = list(aux_names)
+        missing_aux = [n for n in aux_names if n not in aux_params]
+        if missing_aux:
+            raise ServeError(
+                f"aux states {missing_aux} missing from aux_params — an "
+                "untrained BatchNorm served with default stats is a silent "
+                "accuracy bug; export the full checkpoint")
+
+        # -- pre-flight static analysis (model-load, not first-request) ----
+        self.lint_report = None
+        if lint not in ("off", "warn", "error"):
+            raise ValueError(f"lint must be 'off'|'warn'|'error', got {lint!r}")
+        if lint != "off":
+            self.lint_report = symbol.lint()
+            if lint == "error":
+                self.lint_report.raise_if_errors()
+            elif self.lint_report:
+                import warnings
+
+                warnings.warn("serve model-load lint: "
+                              + self.lint_report.format(), stacklevel=2)
+
+        # -- device-resident parameters -----------------------------------
+        self._lock = threading.Lock()
+        self._params = _ParamSet(
+            0,
+            tuple(_to_device(arg_params[n]) for n in self._param_names),
+            tuple(_to_device(aux_params[n]) for n in self._aux_names))
+        self._param_avals = tuple(
+            (tuple(v.shape), str(v.dtype)) for v in self._params.arg_vals)
+        self._aux_avals = tuple(
+            (tuple(v.shape), str(v.dtype)) for v in self._params.aux_vals)
+
+        # -- the compiled program (one jax.jit entry per input signature) --
+        # The traced function mirrors Executor._get_fn's ``wrapped``
+        # EXACTLY (same arg_vals/aux_vals list layout, same (outs, new_aux)
+        # return): identical jaxpr → identical HLO → the engine's bucket-B
+        # program is bit-for-bit the executable ``Module.predict`` runs at
+        # batch B. That is what makes the flagship bitwise-equality
+        # contract (serve output == direct predict output) hold by
+        # construction instead of by luck — XLA does not promise identical
+        # ulps across *different* programs, only across runs of the same
+        # one.
+        arg_order = {n: i for i, n in enumerate(arg_names)}
+        _, _, fn, _ = _build_graph_fn(symbol, train=False)
+        self._param_slots = [arg_order[n] for n in self._param_names]
+        self._free_slots = [arg_order[n] for n in self._free_names]
+        self._data_slots = [arg_order[n] for n in self._data_names]
+        self._n_args = len(arg_names)
+
+        def wrapped(rng_key, arg_vals, aux_vals):
+            import jax.random as jr
+
+            from .. import random as _random
+
+            if hasattr(jr, "wrap_key_data") and \
+                    getattr(rng_key, "dtype", None) == jax.numpy.uint32:
+                rng_key = jr.wrap_key_data(rng_key)
+            with _random.trace_key_scope(rng_key):
+                return fn(arg_vals, aux_vals)
+
+        self._jitted = jax.jit(wrapped)
+        import jax.random as jr
+
+        key = jr.PRNGKey(0)  # eval mode draws nothing; fixed = deterministic
+        self._rng_data = jr.key_data(key) if hasattr(jr, "key_data") else key
+
+        # explicit program accounting (the fused-update cache-key idiom):
+        # one entry per distinct input signature ever compiled. The
+        # TraceLinter serve-retrace-churn rule audits this log.
+        self._programs: Dict[tuple, int] = {}   # sig -> execution count
+        self.compile_log: List[dict] = []
+        self._free_cache: Dict[tuple, tuple] = {}
+        self.exec_count = 0
+
+    # ------------------------------------------------------------------
+    # properties / stats
+    # ------------------------------------------------------------------
+    @property
+    def version(self) -> int:
+        """Monotonic parameter generation (bumped by :meth:`reload`)."""
+        return self._params.version
+
+    @property
+    def num_programs(self) -> int:
+        """Distinct compiled programs so far (the bounded quantity)."""
+        return len(self._programs)
+
+    @property
+    def data_names(self) -> List[str]:
+        return list(self._data_names)
+
+    def stats(self) -> dict:
+        return {
+            "version": self.version,
+            "buckets": list(self.buckets),
+            "num_programs": self.num_programs,
+            "executions": self.exec_count,
+            "programs": {repr(k): v for k, v in self._programs.items()},
+            "compiles": len(self.compile_log),
+        }
+
+    # ------------------------------------------------------------------
+    # bucketing
+    # ------------------------------------------------------------------
+    def bucket_for(self, n: int) -> Optional[int]:
+        """Smallest bucket ≥ n, or None when n exceeds the largest (the
+        caller chunks)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return None
+
+    def _free_vals(self, batch: int, data_shapes) -> tuple:
+        """Zero tensors for non-data, non-param graph arguments (labels),
+        shaped by shape inference at this bucket. Cached per signature."""
+        key = (batch, tuple(data_shapes))
+        vals = self._free_cache.get(key)
+        if vals is None:
+            import jax.numpy as jnp
+
+            if self._free_names:
+                from ..symbol.symbol import infer_shapes
+
+                shapes = dict(zip(self._data_names, data_shapes))
+                inferred, _ = infer_shapes(self.symbol, shapes)
+                missing = [n for n in self._free_names if n not in inferred]
+                if missing:
+                    raise ServeError(
+                        f"cannot infer shapes for unbound arguments "
+                        f"{missing}; pass them as arg_params or data_names")
+                vals = tuple(jnp.zeros(inferred[n], jnp.float32)
+                             for n in self._free_names)
+            else:
+                vals = ()
+            self._free_cache[key] = vals
+        return vals
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def infer(self, inputs, n_valid: Optional[int] = None
+              ) -> Tuple[List[np.ndarray], int]:
+        """Run one (possibly padded) batch. ``inputs``: one array per data
+        name, equal leading dim. Returns ``(outputs, param_version)`` with
+        outputs as host numpy sliced back to ``n_valid`` rows.
+
+        Batches larger than the top bucket are chunked internally (each
+        chunk still hits a bucketed program); the version is taken from the
+        first chunk's snapshot — chunks of one oversized request could in
+        principle straddle a reload, which is the documented cost of
+        sending a request bigger than max_batch_size.
+        """
+        import jax
+
+        from .. import profiler
+
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        if len(inputs) != len(self._data_names):
+            raise ServeError(
+                f"expected {len(self._data_names)} input(s) "
+                f"({self._data_names}), got {len(inputs)}")
+        arrays = [np.ascontiguousarray(np.asarray(x)) for x in inputs]
+        n = int(arrays[0].shape[0]) if arrays[0].ndim else 1
+        for a in arrays[1:]:
+            if int(a.shape[0]) != n:
+                raise ServeError("inputs disagree on batch dimension: "
+                                 f"{[x.shape for x in arrays]}")
+        if n == 0:
+            raise ServeError("empty request (0 rows)")
+        if n_valid is None:
+            n_valid = n
+        bucket = self.bucket_for(n)
+        if bucket is None:
+            # chunk an oversized batch through the top bucket
+            top = self.max_batch_size
+            pieces: List[List[np.ndarray]] = []
+            version = None
+            for lo in range(0, n, top):
+                outs, v = self.infer([a[lo:lo + top] for a in arrays])
+                version = v if version is None else version
+                pieces.append(outs)
+            merged = [np.concatenate([p[i] for p in pieces], axis=0)
+                      for i in range(len(pieces[0]))]
+            return [m[:n_valid] for m in merged], version
+
+        pad = bucket - n
+        if pad:
+            arrays = [np.concatenate(
+                [a, np.full((pad,) + a.shape[1:], self._pad_value, a.dtype)],
+                axis=0) for a in arrays]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        free_vals = self._free_vals(bucket, [a.shape for a in arrays])
+        snapshot = self._params  # atomic: old-or-new, never mixed
+
+        is_compile = sig not in self._programs
+        if is_compile:
+            self.compile_log.append({
+                "sig": sig, "bucket": bucket,
+                "param_avals": self._param_avals,
+                "version_at_compile": snapshot.version,
+            })
+        if profiler.counting_dispatches():
+            profiler.count_dispatch("compiled")
+            profiler.count_dispatch("h2d", len(arrays))
+        arg_vals: List = [None] * self._n_args
+        for slot, v in zip(self._param_slots, snapshot.arg_vals):
+            arg_vals[slot] = v
+        for slot, v in zip(self._free_slots, free_vals):
+            arg_vals[slot] = v
+        for slot, v in zip(self._data_slots, arrays):
+            arg_vals[slot] = v
+        rec = obs.enabled()
+        t0 = time.monotonic() if rec else 0.0
+        with obs.trace.span("serve.execute", bucket=bucket, rows=n_valid,
+                            compile=is_compile, version=snapshot.version):
+            outs, _new_aux = self._jitted(self._rng_data, arg_vals,
+                                          list(snapshot.aux_vals))
+            # materialize on host: the wire sends numpy, and an unwaited
+            # future would let the execute span under-report real latency
+            host = jax.device_get(list(outs))
+        if profiler.counting_dispatches():
+            profiler.count_dispatch("d2h", len(host))
+        if rec:
+            dt = time.monotonic() - t0
+            if is_compile:
+                obs.inc("serve.compile")
+                obs.observe("serve.compile_seconds", dt)
+            else:
+                obs.observe("serve.execute_seconds", dt)
+            obs.inc("serve.rows_executed", n_valid)
+            obs.inc("serve.rows_padding", bucket - n_valid)
+        self._programs[sig] = self._programs.get(sig, 0) + 1
+        self.exec_count += 1
+        return ([np.asarray(o)[:n_valid] if np.ndim(o) else np.asarray(o)
+                 for o in host], snapshot.version)
+
+    def predict(self, *inputs):
+        """Convenience single-call inference: numpy in, numpy out (one
+        array, or a list when the graph has multiple outputs)."""
+        outs, _version = self.infer(list(inputs))
+        return outs[0] if len(outs) == 1 else outs
+
+    def warmup(self, *feature_shapes, dtype=np.float32) -> int:
+        """Pre-compile every bucket for the given per-row feature shape(s)
+        (one tuple per data input; call once per distinct signature).
+        Returns the number of programs compiled. Servers call this before
+        flipping readiness so the first customer request never eats an XLA
+        compile."""
+        shapes = list(feature_shapes) or [()]
+        if len(shapes) != len(self._data_names):
+            raise ServeError(
+                f"warmup needs one feature shape per data input "
+                f"({len(self._data_names)}), got {len(shapes)}")
+        before = self.num_programs
+        for b in self.buckets:
+            self.infer([np.zeros((b,) + tuple(s), dtype) for s in shapes])
+        return self.num_programs - before
+
+    # ------------------------------------------------------------------
+    # hot reload
+    # ------------------------------------------------------------------
+    def reload(self, arg_params, aux_params=None) -> int:
+        """Swap in a new parameter generation without dropping in-flight
+        work. Validates that names, shapes, and dtypes match the serving
+        set — a drifted checkpoint would silently recompile every bucket
+        (and is almost always a deploy mistake). Returns the new version."""
+        arg_params = dict(arg_params or {})
+        aux_params = dict(aux_params or {})
+        missing = [n for n in self._param_names if n not in arg_params]
+        missing += [n for n in self._aux_names if n not in aux_params]
+        if missing:
+            raise ServeError(f"reload missing parameters: {missing}")
+        new_args = tuple(_to_device(arg_params[n])
+                         for n in self._param_names)
+        new_aux = tuple(_to_device(aux_params[n]) for n in self._aux_names)
+        for names, vals, avals in (
+                (self._param_names, new_args, self._param_avals),
+                (self._aux_names, new_aux, self._aux_avals)):
+            for name, v, (shape, dtype) in zip(names, vals, avals):
+                got = (tuple(v.shape), str(v.dtype))
+                if got != (shape, dtype):
+                    raise ServeError(
+                        f"reload aval mismatch for {name!r}: serving "
+                        f"{(shape, dtype)}, new checkpoint {got} — this "
+                        "would retrace every bucket; deploy a new engine "
+                        "for a changed architecture")
+        with self._lock:
+            version = self._params.version + 1
+            self._params = _ParamSet(version, new_args, new_aux)
+        obs.inc("serve.reloads")
+        obs.event("serve.reload", version=version)
+        return version

@@ -1,0 +1,364 @@
+"""Threaded socket front end for ``mxnet_tpu.serve``.
+
+Reference: MXNet Model Server's HTTP front end over CachedOp workers (TBV,
+SURVEY.md §1). This build reuses the parameter-server wire format
+(``kvstore/ps_server.py``: length-prefixed binary framing, the same
+``_pack_array`` array encoding) on a disjoint opcode range, so one set of
+framing/chaos/telemetry tooling covers both the training and serving
+planes.
+
+Wire protocol (little-endian, see ``kvstore/ps_server.py`` for framing):
+
+  INFER  request : f64 deadline_ms (0 = none) | u8 priority | packed arrays
+  INFER  reply   : u8 status | (ok: u32 param_version | packed arrays)
+                               (err: utf-8 message)
+  HEALTH reply   : u8 0 — process liveness only
+  READY  reply   : u8 status — 0 ready / DRAINING / NOT_READY
+  RELOAD request : utf-8 json {"path": ..., "epoch": ..., "prefix": ...}
+  RELOAD reply   : u8 status | (ok: u32 new_version; err: message)
+  STATS  reply   : u8 0 | utf-8 json (engine + batcher + server stats)
+  DRAIN  request : u8 stop_after (0/1)
+  DRAIN  reply   : u8 0 once queued + in-flight work finished
+
+Graceful degradation contract (tested in tests/test_serve.py):
+
+- a deadline-expired or shed request gets an explicit status, never a
+  hang;
+- ``drain()`` flips readiness, finishes in-flight work, then (optionally)
+  stops the listener — a rolling restart loses zero accepted requests;
+- hot reload swaps parameters atomically (engine contract): every reply
+  carries the parameter version it was computed with;
+- chaos (``MXNET_CHAOS_RPC`` on the client, ``MXNET_CHAOS_KILL`` at the
+  ``serve:pre_reply`` / ``serve:post_recv`` kill points here) exercises
+  the retry/failover paths deterministically.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import obs
+from ..chaos import rpc as _chaos_rpc
+from ..chaos.proc import kill_point
+from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
+                                 _unpack_arrays)
+from .batcher import DynamicBatcher
+from .engine import (DeadlineExceeded, Draining, InferenceEngine,
+                     RequestRejected, ServeError)
+
+__all__ = ["ServeServer", "OP_INFER", "OP_HEALTH", "OP_READY", "OP_RELOAD",
+           "OP_STATS", "OP_DRAIN", "OP_SHUTDOWN", "SERVE_OP_NAMES",
+           "STATUS_OK", "STATUS_REJECTED", "STATUS_DEADLINE",
+           "STATUS_BAD_REQUEST", "STATUS_DRAINING", "STATUS_INTERNAL",
+           "STATUS_NOT_READY"]
+
+# serve opcode range: disjoint from the kvstore PS opcodes (0–9), so the
+# chaos rule table (chaos/rpc.py OP_NAMES) can address both planes
+(OP_INFER, OP_HEALTH, OP_READY, OP_RELOAD, OP_STATS, OP_DRAIN,
+ OP_SHUTDOWN) = range(32, 39)
+
+SERVE_OP_NAMES = {OP_INFER: "infer", OP_HEALTH: "health", OP_READY: "ready",
+                  OP_RELOAD: "reload", OP_STATS: "stats", OP_DRAIN: "drain",
+                  OP_SHUTDOWN: "serve_shutdown"}
+
+# single source of truth for chaos rule names: MXNET_CHAOS_RPC rules match
+# these ops the moment the serving plane is imported (the client imports
+# this module, so on_send always sees registered names)
+_chaos_rpc.OP_NAMES.update(SERVE_OP_NAMES)
+
+(STATUS_OK, STATUS_REJECTED, STATUS_DEADLINE, STATUS_BAD_REQUEST,
+ STATUS_DRAINING, STATUS_INTERNAL, STATUS_NOT_READY) = range(7)
+
+_INFER_HDR = struct.Struct("<dB")  # deadline_ms (0 = none), priority
+
+
+def _err_payload(status: int, msg: str) -> bytes:
+    return struct.pack("<B", status) + msg.encode("utf-8", "replace")
+
+
+class ServeServer:
+    """A concurrent inference endpoint over an :class:`InferenceEngine`.
+
+    One accept loop + one thread per connection (the PSServer pattern);
+    every connection handler funnels INFERs into the shared
+    :class:`DynamicBatcher`, so concurrency turns into batch occupancy
+    instead of lock contention on the device.
+    """
+
+    def __init__(self, engine: Optional[InferenceEngine] = None,
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 batcher: Optional[DynamicBatcher] = None,
+                 max_linger_ms: float = 2.0, max_queue: int = 256,
+                 lanes: int = 2, default_timeout: float = 30.0):
+        self._engine = engine
+        if batcher is not None:
+            self._batcher = batcher
+        elif engine is not None:
+            self._batcher = DynamicBatcher(
+                engine, max_linger_ms=max_linger_ms, max_queue=max_queue,
+                lanes=lanes)
+        else:
+            self._batcher = None
+        self._default_timeout = float(default_timeout)
+        self._draining = False
+        self._started = time.monotonic()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads = []
+        self._conns = []
+
+    # ------------------------------------------------------------------
+    # lifecycle (PSServer idiom)
+    # ------------------------------------------------------------------
+    def serve_forever(self):
+        while not self._stop.is_set():
+            try:
+                self._sock.settimeout(0.5)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self._conns.append(conn)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads = [th for th in self._threads if th.is_alive()]
+            self._threads.append(t)
+
+    def start(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True,
+                             name="mxnet-tpu-serve-accept")
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # snapshot: handler threads concurrently .remove() from _conns, and
+        # iterating the live list would skip (and leave open) neighbors of
+        # a removed entry — a stopped server must look dead to EVERY client
+        for c in list(self._conns):
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._batcher is not None:
+            self._batcher.close(timeout=5)
+
+    def drain(self, stop: bool = False, timeout: float = 30.0) -> bool:
+        """Graceful shutdown, phase one: flip readiness off, let queued and
+        in-flight requests finish, refuse new ones. ``stop=True`` closes
+        the listener afterwards (phase two)."""
+        self._draining = True
+        obs.event("serve.drain", stop=stop)
+        ok = True
+        if self._batcher is not None:
+            ok = self._batcher.drain(timeout=timeout)
+        if stop:
+            self.stop()
+        return ok
+
+    def reload(self, path: str, epoch: Optional[int] = None,
+               prefix: str = "ckpt") -> int:
+        """Hot-swap parameters from a newer on-disk artifact (same graph).
+        In-flight requests keep the generation they started with."""
+        if self._engine is None:
+            raise ServeError("no engine loaded")
+        from . import load_params
+
+        arg, aux = load_params(path, epoch=epoch, prefix=prefix)
+        return self._engine.reload(arg, aux)
+
+    def stats(self) -> dict:
+        out = {"uptime_seconds": round(time.monotonic() - self._started, 3),
+               "draining": self._draining,
+               "connections": len(self._conns),
+               "pid": os.getpid()}
+        if self._engine is not None:
+            out["engine"] = self._engine.stats()
+        if self._batcher is not None:
+            out["batcher"] = self._batcher.stats()
+        return out
+
+    # ------------------------------------------------------------------
+    # connection handling
+    # ------------------------------------------------------------------
+    def _handle(self, conn: socket.socket):
+        try:
+            self._handle_loop(conn)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+
+    def _handle_loop(self, conn: socket.socket):
+        try:
+            while True:
+                opcode, key, payload = _recv_msg(conn)
+                kill_point("serve:post_recv")  # chaos: die with work read
+                rec = obs.enabled()
+                t0 = time.monotonic() if rec else 0.0
+                opname = SERVE_OP_NAMES.get(opcode, str(opcode))
+                try:
+                    with obs.trace.span("serve.rpc", op=opname):
+                        alive = self._handle_one(conn, opcode, key, payload)
+                finally:
+                    if rec:
+                        obs.observe(f"serve.rpc.{opname}_seconds",
+                                    time.monotonic() - t0)
+                if not alive:
+                    return
+        except (ConnectionError, OSError):
+            return
+
+    def _reply(self, conn, opcode: int, payload: bytes):
+        kill_point("serve:pre_reply")  # chaos: server dies before the ack
+        _send_msg(conn, opcode, "", payload)
+
+    def _handle_one(self, conn, opcode: int, key: str, payload) -> bool:
+        if opcode == OP_INFER:
+            self._reply(conn, OP_INFER, self._do_infer(payload))
+        elif opcode == OP_HEALTH:
+            # liveness only: answering at all is the signal
+            self._reply(conn, OP_HEALTH, struct.pack("<B", STATUS_OK))
+        elif opcode == OP_READY:
+            if self._engine is None or self._batcher is None:
+                status = STATUS_NOT_READY
+            elif self._draining:
+                status = STATUS_DRAINING
+            else:
+                status = STATUS_OK
+            self._reply(conn, OP_READY, struct.pack("<B", status))
+        elif opcode == OP_RELOAD:
+            try:
+                spec = json.loads(bytes(payload).decode("utf-8"))
+                version = self.reload(spec["path"],
+                                      epoch=spec.get("epoch"),
+                                      prefix=spec.get("prefix", "ckpt"))
+                self._reply(conn, OP_RELOAD,
+                            struct.pack("<BI", STATUS_OK, version))
+            except Exception as e:  # noqa: BLE001 — wire-reported
+                obs.inc("serve.reload_errors")
+                self._reply(conn, OP_RELOAD, _err_payload(
+                    STATUS_INTERNAL, f"{type(e).__name__}: {e}"))
+        elif opcode == OP_STATS:
+            blob = json.dumps(self.stats(), default=str).encode("utf-8")
+            self._reply(conn, OP_STATS, struct.pack("<B", STATUS_OK) + blob)
+        elif opcode == OP_DRAIN:
+            stop = bool(payload and payload[0])
+            drained = self.drain(stop=False)
+            self._reply(conn, OP_DRAIN, struct.pack(
+                "<B", STATUS_OK if drained else STATUS_INTERNAL))
+            if stop:
+                self.stop()
+                return False
+        elif opcode == OP_SHUTDOWN:
+            self._reply(conn, OP_SHUTDOWN, struct.pack("<B", STATUS_OK))
+            self.stop()
+            return False
+        else:
+            self._reply(conn, opcode,
+                        _err_payload(STATUS_BAD_REQUEST,
+                                     f"unknown opcode {opcode}"))
+        return True
+
+    def _do_infer(self, payload) -> bytes:
+        if self._engine is None or self._batcher is None:
+            return _err_payload(STATUS_NOT_READY, "no model loaded")
+        if self._draining:
+            obs.inc("serve.shed_draining")
+            return _err_payload(STATUS_DRAINING, "endpoint draining")
+        try:
+            deadline_ms, priority = _INFER_HDR.unpack_from(payload, 0)
+            arrays, _ = _unpack_arrays(payload[_INFER_HDR.size:])
+        except (struct.error, IndexError, KeyError, ValueError) as e:
+            return _err_payload(STATUS_BAD_REQUEST,
+                                f"malformed INFER frame: {e}")
+        try:
+            fut = self._batcher.submit(arrays,
+                                       deadline_ms=deadline_ms or None,
+                                       priority=int(priority))
+            wait = (deadline_ms / 1e3) if deadline_ms \
+                else self._default_timeout
+            outs, version = fut.result(timeout=wait + 1.0)
+        except RequestRejected as e:
+            return _err_payload(STATUS_REJECTED, str(e))
+        except DeadlineExceeded as e:
+            # DEADLINE means "your deadline passed, the work was shed"; a
+            # deadline-LESS request timing out the server-side wait is an
+            # internal condition (the work may still execute), not an SLO
+            # miss the client never asked for
+            if not deadline_ms:
+                return _err_payload(
+                    STATUS_INTERNAL,
+                    f"server wait exceeded {self._default_timeout}s: {e}")
+            return _err_payload(STATUS_DEADLINE, str(e))
+        except Draining as e:
+            return _err_payload(STATUS_DRAINING, str(e))
+        except ServeError as e:
+            return _err_payload(STATUS_INTERNAL, str(e))
+        with obs.trace.span("serve.serialize", outputs=len(outs)):
+            return (struct.pack("<BI", STATUS_OK, version)
+                    + _pack_arrays([np.ascontiguousarray(o) for o in outs]))
+
+
+def main():  # pragma: no cover - CLI shim
+    import argparse
+
+    import jax
+
+    # serving may legitimately target the accelerator; MXNET_SERVE_PLATFORM
+    # pins it (the PS server's MXNET_PS_PLATFORM idiom)
+    plat = os.environ.get("MXNET_SERVE_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    ap = argparse.ArgumentParser(description="mxnet_tpu serving endpoint")
+    ap.add_argument("model", help="artifact path (Module checkpoint prefix, "
+                    "gluon export path, or checkpoint directory)")
+    ap.add_argument("--epoch", type=int, default=None)
+    ap.add_argument("--port", type=int, default=9191)
+    ap.add_argument("--max-batch-size", type=int, default=32)
+    ap.add_argument("--max-linger-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue", type=int, default=256)
+    ap.add_argument("--warmup-shape", type=str, default=None,
+                    help="comma-separated per-row feature shape to "
+                         "pre-compile every bucket for, e.g. 3,224,224")
+    args = ap.parse_args()
+
+    from . import load
+
+    engine = load(args.model, epoch=args.epoch,
+                  max_batch_size=args.max_batch_size)
+    if args.warmup_shape:
+        feat = tuple(int(d) for d in args.warmup_shape.split(",") if d)
+        engine.warmup(feat)
+    srv = ServeServer(engine, port=args.port,
+                      max_linger_ms=args.max_linger_ms,
+                      max_queue=args.max_queue)
+    print(f"ServeServer listening on :{srv.port}", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

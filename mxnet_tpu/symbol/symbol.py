@@ -559,6 +559,22 @@ def load_json(s: str) -> Symbol:
                 ins.append(src if out_idx == 0 else src[out_idx])
             attrs = coerce_kwargs(nd_.get("attrs", nd_.get("param", {})))
             sym = Symbol(nd_["op"], nd_["name"], ins, attrs)
+            # Auxness is DERIVED, not serialized (tojson drops internal
+            # "__" attrs, and the reference json carries none either —
+            # graph.cc re-derives aux states from the op registry's
+            # mutable inputs): a variable feeding an op slot named in
+            # _AUX_ARGS (moving_mean/moving_var/...) is an aux state.
+            # Without this, a BatchNorm checkpoint reloads its moving
+            # stats as plain (zero-initialized) arguments — a silent
+            # eval-accuracy bug.
+            try:
+                slots = op_input_names(get_op(nd_["op"]))
+            except Exception:  # unknown/variadic op: nothing to derive
+                slots = []
+            for inp, slot in zip(ins, slots):
+                base = inp._base()
+                if base._op is None and slot in _AUX_ARGS:
+                    base._attrs["__aux__"] = True
         nodes.append(sym)
     heads = [nodes[h[0]] if h[1] == 0 else nodes[h[0]][h[1]]
              for h in d["heads"]]

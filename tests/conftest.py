@@ -37,6 +37,10 @@ def pytest_configure(config):
         "markers", "obs: runtime telemetry tests — span tracer, metrics "
         "registry, instrumented step (docs/OBSERVABILITY.md); run via "
         "`pytest -m obs` or `make obs`")
+    config.addinivalue_line(
+        "markers", "serve: inference-serving tests — compiled engine, "
+        "dynamic batcher, socket endpoint (docs/SERVING.md); run via "
+        "`pytest -m serve` or `make serve`")
 
 
 @pytest.fixture(autouse=True)

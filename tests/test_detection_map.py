@@ -171,3 +171,40 @@ def test_mcc_and_nll_metrics():
     expect = -(onp.log(0.9) + onp.log(0.8)) / 2
     assert abs(n.get()[1] - expect) < 1e-6
     assert isinstance(mx.metric.create("mcc"), mx.metric.MCC)
+
+
+def test_map_duplicate_hit_is_fp_not_second_best():
+    """VOC devkit semantics (ADVICE.md): a detection takes argmax IoU over
+    ALL GTs of its class; when that best GT is already matched the
+    detection is an FP — it must NOT fall back to a worse, unmatched GT."""
+    # two GTs; det A matches gt0 perfectly, det B overlaps gt0 best (but
+    # gt0 is taken) while ALSO clearing the threshold on gt1
+    gt = _labels([[0, 0.0, 0.0, 0.4, 0.4],
+                  [0, 0.3, 0.0, 0.7, 0.4]])
+    det = _dets([[0, 0.9, 0.0, 0.0, 0.4, 0.4],     # tp on gt0
+                 [0, 0.8, 0.02, 0.0, 0.42, 0.4]])  # best IoU: gt0 -> FP
+    m = MApMetric(ovp_thresh=0.3)
+    m.update([gt], [det])
+    rec = sorted(m._records[0], key=lambda t: -t[0])
+    assert [r[1] for r in rec] == [1, 0], \
+        "duplicate of a matched GT must be an FP, not re-matched to gt1"
+    # recall tops out at 0.5 (gt1 never matched): AP = area under
+    # [p=1 at r=0.5] = 0.5 exactly
+    assert abs(m.get()[1] - 0.5) < 1e-9
+
+
+def test_map_duplicate_fp_lowers_ap_vs_old_greedy():
+    """The old unmatched-only candidate set would score this scene 1.0
+    (the dup silently consumed the second GT); devkit scoring says the
+    second GT is missed and the dup costs precision."""
+    gt = _labels([[1, 0.1, 0.1, 0.5, 0.5],
+                  [1, 0.55, 0.1, 0.95, 0.5]])
+    det = _dets([[1, 0.95, 0.1, 0.1, 0.5, 0.5],
+                 [1, 0.90, 0.12, 0.1, 0.52, 0.5],   # dup of gt0
+                 [1, 0.10, 0.55, 0.1, 0.95, 0.5]])  # late tp on gt1
+    m = MApMetric(ovp_thresh=0.5)
+    m.update([gt], [det])
+    tps = [r[1] for r in sorted(m._records[1], key=lambda t: -t[0])]
+    assert tps == [1, 0, 1]
+    # PR points: (0.5, 1.0), (0.5, 0.5), (1.0, 2/3) -> AP = 0.5*1 + 0.5*(2/3)
+    assert abs(m.get()[1] - (0.5 + 0.5 * 2 / 3)) < 1e-9

@@ -250,3 +250,65 @@ def test_modifier_and_bidirectional_cells():
                             zoneout_states=0.3)
     outs, _ = zo.unroll(T, data, merge_outputs=True)
     assert "zo_i2h_weight" in outs.list_arguments()
+
+
+def test_zoneout_output_blend_tracks_prev_output():
+    """Output zoneout is the expectation blend prev*p + next*(1-p) with the
+    previous step's (blended) output — not an attenuating out*(1-p)
+    (ADVICE.md). Verified against a hand-rolled recurrence."""
+    p = 0.4
+    base = mx.rnn.RNNCell(3, prefix="zob_")
+    zo = mx.rnn.ZoneoutCell(base, zoneout_outputs=p)
+    T, B, C = 4, 2, 3
+    rng = np.random.RandomState(3)
+    xs = rng.randn(T, B, C).astype(np.float32)
+    args = None
+    # reference recurrence: run the BASE cell manually, blend outputs
+    states = base.begin_state()
+    base_outs = []
+    shapes = {}
+    sym_steps = []
+    x_syms = [mx.sym.Variable(f"x{t}") for t in range(T)]
+    st = base.begin_state()
+    for t in range(T):
+        o, st = base(x_syms[t], st)
+        sym_steps.append(o)
+    grp = mx.sym.Group(sym_steps)
+    import numpy as onp
+    feed = {f"x{t}": xs[t] for t in range(T)}
+    warg = {n: rng.randn(*s).astype(np.float32) * 0.3
+            for n, s in zip(grp.list_arguments(),
+                            grp.infer_shape(**{f"x{t}": (B, C)
+                                               for t in range(T)})[0])
+            if not n.startswith("x")}
+    exe = grp.simple_bind(grad_req="null",
+                          **{k: v.shape for k, v in {**feed, **warg}.items()})
+    outs = exe.forward(is_train=False, **feed, **warg)
+    expected = []
+    prev = onp.zeros((B, 3), onp.float32)
+    for t in range(T):
+        nxt = outs[t].asnumpy()
+        blended = prev * p + nxt * (1 - p)
+        expected.append(blended)
+        prev = blended  # reference tracks the BLENDED output
+    # now the ZoneoutCell path with the SAME weights
+    zo.reset()
+    st = zo.begin_state()
+    zo_steps = []
+    for t in range(T):
+        o, st = zo(x_syms[t], st)
+        zo_steps.append(o)
+    zgrp = mx.sym.Group(zo_steps)
+    zexe = zgrp.simple_bind(grad_req="null",
+                            **{k: v.shape for k, v in {**feed, **warg}.items()})
+    zouts = zexe.forward(is_train=False, **feed, **warg)
+    for t in range(T):
+        np.testing.assert_allclose(zouts[t].asnumpy(), expected[t],
+                                   rtol=1e-5, atol=1e-6)
+    # t=0 sanity: (1-p)*out, NOT out*(1-p)^1-only-forever
+    assert not np.allclose(zouts[1].asnumpy(),
+                           outs[1].asnumpy() * (1 - p)), \
+        "old attenuation formula detected at t=1"
+    # reset() must clear the tracked output (fresh sequence)
+    zo.reset()
+    assert zo._prev_output is None

@@ -1,0 +1,677 @@
+"""Serving subsystem suite (``pytest -m serve`` / ``make serve``).
+
+Covers the docs/SERVING.md contracts:
+
+1. engine — shape bucketing with a *provable* compiled-program bound
+   (``profiler.count_dispatches`` + the TraceLinter ``serve-retrace-churn``
+   rule), batched-vs-single bitwise equality, oversize chunking, warmup;
+2. batcher — linger coalescing, deadline-expired requests shed (never
+   executed), priority lanes immune to head-of-line blocking, watermark
+   load shedding;
+3. hot reload — concurrent traffic sees old-or-new parameters, never a
+   mix; aval drift is rejected;
+4. endpoint — health/readiness probes, draining shutdown, chaos
+   drop/dup on the serve socket degrades to a retry (not an error), and
+   the flagship: train a model-zoo CNN 2 batches → checkpoint →
+   ``serve.load`` → concurrent mixed-shape clients get outputs bitwise
+   identical to direct ``Module.predict``, with program count ≤ buckets
+   and a chrome trace carrying complete ``serve.*`` phase spans.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, obs, profiler, serve
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.analysis.trace import TraceLinter
+from mxnet_tpu.chaos import rpc as chaos_rpc
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+from mxnet_tpu.serve import (DeadlineExceeded, Draining, DynamicBatcher,
+                             InferenceEngine, RequestRejected, ServeClient,
+                             ServeError, ServeServer, default_buckets)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos_rpc.reset()
+    yield
+    chaos_rpc.reset()
+    obs.disable()
+    obs.reset()
+
+
+def _linear_engine(scale=1.0, dim=4, max_batch=8, **kw):
+    """y = x @ (scale * I): outputs are exactly scale * x (bitwise — each
+    row of the matmul has a single nonzero product), which makes
+    old-vs-new parameter provenance decidable per output."""
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=dim, no_bias=True, name="fc")
+    arg = {"fc_weight": np.eye(dim, dtype=np.float32) * scale}
+    return net, arg, InferenceEngine(net, arg, max_batch_size=max_batch,
+                                     lint="off", **kw)
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    arg = {"fc1_weight": rng.randn(16, 6).astype(np.float32) * 0.3,
+           "fc1_bias": rng.randn(16).astype(np.float32) * 0.1,
+           "fc2_weight": rng.randn(4, 16).astype(np.float32) * 0.3,
+           "fc2_bias": np.zeros(4, np.float32)}
+    return net, arg
+
+
+class _FakeEngine:
+    """Duck-typed engine for scheduler tests: deterministic, recordable,
+    optionally slow — so deadline/priority behavior is tested without
+    racing real XLA execution times."""
+
+    def __init__(self, delay=0.0, max_batch_size=8):
+        self.delay = delay
+        self.max_batch_size = max_batch_size
+        self.buckets = default_buckets(max_batch_size)
+        self.calls = []  # list of (rows, t_start)
+
+    def infer(self, inputs, n_valid=None):
+        x = inputs[0]
+        self.calls.append((int(x.shape[0]), time.monotonic()))
+        if self.delay:
+            time.sleep(self.delay)
+        return [np.asarray(x) * 2.0], 0
+
+
+# ---------------------------------------------------------------------------
+# 1. engine: bucketing, program bound, bitwise equality
+# ---------------------------------------------------------------------------
+
+def test_default_buckets():
+    assert default_buckets(1) == [1]
+    assert default_buckets(8) == [1, 2, 4, 8]
+    assert default_buckets(24) == [1, 2, 4, 8, 16, 24]
+    with pytest.raises(ValueError):
+        default_buckets(0)
+
+
+def test_bucketing_program_count_bound():
+    """Ragged request shapes never grow the program count past the bucket
+    bound — asserted three independent ways: the engine's own accounting,
+    profiler.count_dispatches (one compiled execution per infer, no hidden
+    retrace dispatches), and the TraceLinter churn rule."""
+    net, arg = _mlp()
+    engine = InferenceEngine(net, arg, max_batch_size=8, lint="off")
+    rng = np.random.RandomState(1)
+    ragged = [3, 1, 5, 2, 8, 7, 4, 6, 3, 1, 5]
+    for n in ragged:
+        engine.predict(rng.rand(n, 6).astype(np.float32))
+    assert engine.num_programs <= len(engine.buckets) == 4
+    assert engine.exec_count == len(ragged)
+    # steady state: a seen shape costs exactly ONE compiled execution and
+    # zero compilations
+    before = engine.num_programs
+    with profiler.count_dispatches() as c:
+        engine.predict(rng.rand(3, 6).astype(np.float32))
+    assert engine.num_programs == before
+    assert c.total_compiled == 1
+    # the linter-backed proof: an empty finding list
+    assert TraceLinter().check_serve_engine(engine) == []
+    # negative control: a duplicated compile_log signature must be flagged
+    engine.compile_log.append(engine.compile_log[0])
+    bad = TraceLinter().check_serve_engine(engine)
+    assert bad and bad[0].rule_id == "serve-retrace-churn"
+
+
+def test_batched_vs_single_request_bitwise():
+    """One 6-row batch vs six 1-row requests routed through the SAME
+    bucket program: row outputs are bitwise identical — rows are
+    independent in eval mode and padding never contaminates valid rows.
+    (The same-program condition is the honest contract: XLA only promises
+    identical ulps across runs of one executable, which is why the
+    batcher coalesces concurrent singles into one bucket instead of
+    running per-request programs.)"""
+    net, arg = _mlp()
+    engine = InferenceEngine(net, arg, buckets=(8,), lint="off")
+    rng = np.random.RandomState(2)
+    x = rng.rand(6, 6).astype(np.float32)
+    batched = engine.predict(x)
+    for i in range(6):
+        single = engine.predict(x[i:i + 1])
+        assert np.array_equal(single[0], batched[i]), f"row {i} differs"
+    assert engine.num_programs == 1
+
+
+def test_engine_oversize_request_chunks():
+    net, arg = _mlp()
+    engine = InferenceEngine(net, arg, max_batch_size=4, lint="off")
+    rng = np.random.RandomState(3)
+    x = rng.rand(11, 6).astype(np.float32)  # > top bucket: 4+4+3 chunks
+    out = engine.predict(x)
+    assert out.shape == (11, 4)
+    ref = engine.predict(x[:4])
+    assert np.array_equal(out[:4], ref)
+    assert engine.num_programs <= len(engine.buckets)
+
+
+def test_engine_warmup_precompiles_every_bucket():
+    net, arg = _mlp()
+    engine = InferenceEngine(net, arg, max_batch_size=8, lint="off")
+    compiled = engine.warmup((6,))
+    assert compiled == len(engine.buckets) == engine.num_programs
+    with profiler.count_dispatches() as c:
+        engine.predict(np.zeros((5, 6), np.float32))
+    assert c.total_compiled == 1 and engine.num_programs == compiled
+
+
+def test_engine_lint_preflight_runs_at_load():
+    net, arg = _mlp()
+    engine = InferenceEngine(net, arg, max_batch_size=2, lint="warn")
+    assert engine.lint_report is not None  # analyzer ran before serving
+
+
+def test_engine_rejects_missing_aux():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(sym.FullyConnected(data, num_hidden=4, name="fc"),
+                        name="bn")
+    rng = np.random.RandomState(0)
+    arg = {"fc_weight": rng.randn(4, 6).astype(np.float32),
+           "fc_bias": np.zeros(4, np.float32),
+           "bn_gamma": np.ones(4, np.float32),
+           "bn_beta": np.zeros(4, np.float32)}
+    with pytest.raises(ServeError, match="aux"):
+        InferenceEngine(net, arg, lint="off")
+
+
+# ---------------------------------------------------------------------------
+# 2. batcher: linger, deadlines, priorities, shedding
+# ---------------------------------------------------------------------------
+
+def test_batcher_linger_coalesces_requests():
+    fake = _FakeEngine(delay=0.0)
+    b = DynamicBatcher(fake, max_linger_ms=120.0, max_queue=64)
+    try:
+        futs = [b.submit(np.full((1, 3), i, np.float32)) for i in range(4)]
+        outs = [f.result(timeout=5)[0][0] for f in futs]
+    finally:
+        b.close()
+    # all four coalesced into one engine call (linger window >> submit gap)
+    assert [rows for rows, _t in fake.calls] == [4]
+    for i, o in enumerate(outs):
+        assert np.array_equal(o, np.full((1, 3), 2.0 * i))
+
+
+def test_deadline_expired_requests_shed_not_executed():
+    """A request whose deadline passes while the worker is busy is shed at
+    assembly — the engine must never see it."""
+    fake = _FakeEngine(delay=0.3)
+    b = DynamicBatcher(fake, max_linger_ms=0.0, max_queue=64)
+    try:
+        slow = b.submit(np.ones((2, 3), np.float32))          # occupies worker
+        time.sleep(0.05)  # ensure it was picked before the doomed one lands
+        doomed = b.submit(np.ones((1, 3), np.float32), deadline_ms=100)
+        slow.result(timeout=5)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=5)
+    finally:
+        b.close()
+    assert [rows for rows, _t in fake.calls] == [2], \
+        "expired request must be shed, not executed"
+    # dead-on-arrival (negative budget, e.g. propagated from an upstream
+    # hop that already blew it) is refused at submit
+    with pytest.raises(DeadlineExceeded):
+        DynamicBatcher(_FakeEngine(), max_linger_ms=0).submit(
+            np.ones((1, 3), np.float32), deadline_ms=-1.0)
+
+
+def test_tight_deadline_joining_mid_linger_caps_the_linger():
+    """A tight-deadline request that joins a batch DURING linger must cap
+    the remaining linger at its own deadline — otherwise the batch waits
+    out the full window and executes it late (regression: the cap used to
+    be computed only from the initial members)."""
+    fake = _FakeEngine(delay=0.0, max_batch_size=8)
+    b = DynamicBatcher(fake, max_linger_ms=500.0, max_queue=64)
+    t0 = time.monotonic()
+    try:
+        a = b.submit(np.ones((1, 3), np.float32))            # opens linger
+        time.sleep(0.05)
+        tight = b.submit(np.ones((1, 3), np.float32), deadline_ms=100)
+        a.result(timeout=5)
+        try:
+            tight.result(timeout=5)
+            late = time.monotonic() - t0 > 0.25  # executed, but on time?
+            assert not late, "tight request executed long past its deadline"
+        except DeadlineExceeded:
+            pass  # shed at the dispatch re-check: also within contract
+    finally:
+        b.close()
+    # the batch must have dispatched near the tight deadline (~0.15s),
+    # nowhere near the 0.5s linger window
+    assert fake.calls and fake.calls[0][1] - t0 < 0.35, \
+        f"linger was not capped by the joining deadline " \
+        f"(dispatched at +{fake.calls[0][1] - t0:.3f}s)"
+
+
+def test_priority_lane_beats_bulk_backlog():
+    """With a bulk backlog queued, a tight-SLO (priority 0) request is
+    dispatched in the very next batch — never behind remaining bulk."""
+    fake = _FakeEngine(delay=0.15, max_batch_size=2)
+    b = DynamicBatcher(fake, max_batch_size=2, max_linger_ms=0.0,
+                       max_queue=64)
+    order = []
+    try:
+        first = b.submit(np.full((2, 3), -1, np.float32), priority=1)
+        time.sleep(0.05)  # worker now busy with `first`
+        bulk = [b.submit(np.full((2, 3), i, np.float32), priority=1)
+                for i in range(4)]
+        urgent = b.submit(np.full((1, 3), 99, np.float32), priority=0)
+        done = {}
+        for name, f in [("first", first), ("urgent", urgent)] + \
+                [(f"bulk{i}", f) for i, f in enumerate(bulk)]:
+            f.result(timeout=10)
+            done[name] = True
+    finally:
+        b.close()
+    # engine call order: first, then urgent (lane 0), then the bulk queue
+    vals = [rows for rows, _t in fake.calls]
+    assert vals[0] == 2
+    assert vals[1] == 1, f"urgent not dispatched next: row trace {vals}"
+
+
+def test_queue_watermark_load_shedding():
+    fake = _FakeEngine(delay=0.3)
+    b = DynamicBatcher(fake, max_linger_ms=0.0, max_queue=3)
+    try:
+        b.submit(np.ones((1, 3), np.float32))   # in flight shortly
+        time.sleep(0.05)
+        kept = [b.submit(np.ones((1, 3), np.float32)) for _ in range(3)]
+        with pytest.raises(RequestRejected):
+            b.submit(np.ones((1, 3), np.float32))
+        assert b.stats()["shed"] == 1
+        for f in kept:
+            f.result(timeout=5)
+    finally:
+        b.close()
+
+
+def test_batcher_splits_results_exactly():
+    net, arg = _mlp()
+    # single bucket: a direct run and a coalesced run execute the same
+    # program, so the split results must be bitwise identical
+    engine = InferenceEngine(net, arg, buckets=(8,), lint="off")
+    b = DynamicBatcher(engine, max_linger_ms=80.0)
+    rng = np.random.RandomState(4)
+    xs = [rng.rand(n, 6).astype(np.float32) for n in (1, 3, 2)]
+    try:
+        futs = [b.submit(x) for x in xs]
+        outs = [f.result(timeout=10) for f in futs]
+    finally:
+        b.close()
+    for x, (o, _version) in zip(xs, outs):
+        assert np.array_equal(o[0], engine.predict(x)), \
+            "coalesced result differs from a direct run"
+
+
+# ---------------------------------------------------------------------------
+# 3. hot reload
+# ---------------------------------------------------------------------------
+
+def test_hot_reload_old_or_new_never_mixed():
+    """Concurrent traffic during repeated reloads: every output equals
+    exactly scale_old*x or scale_new*x — a mixed-generation output would
+    match neither."""
+    net, arg, engine = _linear_engine(scale=1.0)
+    engine.warmup((4,))
+    scales = [1.0, 3.0]
+    stop = threading.Event()
+    bad = []
+    rng = np.random.RandomState(5)
+    xs = [rng.rand(n, 4).astype(np.float32) for n in (1, 2, 3, 4)]
+    expected = {s: [x * np.float32(s) for x in xs] for s in scales}
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            k = i % len(xs)
+            out, ver = engine.infer([xs[k]])
+            o = out[0]
+            if not any(np.array_equal(o, expected[s][k]) for s in scales):
+                bad.append((k, ver, o))
+                return
+            i += 1
+
+    threads = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for gen in range(1, 9):
+        s = scales[gen % 2]
+        engine.reload({"fc_weight": np.eye(4, dtype=np.float32)
+                       * np.float32(s)})
+        time.sleep(0.01)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not bad, f"mixed/unknown-generation output observed: {bad[0]}"
+    assert engine.version == 8
+    # reloads must not have grown the program count
+    assert engine.num_programs == len(engine.buckets)
+    assert TraceLinter().check_serve_engine(engine) == []
+
+
+def test_reload_rejects_aval_drift():
+    _net, _arg, engine = _linear_engine(scale=1.0)
+    with pytest.raises(ServeError, match="aval mismatch"):
+        engine.reload({"fc_weight": np.eye(5, dtype=np.float32)})
+    with pytest.raises(ServeError, match="missing"):
+        engine.reload({})
+    assert engine.version == 0  # failed reloads leave the old generation
+
+
+# ---------------------------------------------------------------------------
+# 4. endpoint: probes, drain, chaos, flagship end-to-end
+# ---------------------------------------------------------------------------
+
+def test_server_probes_drain_lifecycle():
+    _net, _arg, engine = _linear_engine(scale=2.0)
+    srv = ServeServer(engine, port=0, max_linger_ms=0.5)
+    srv.start()
+    cli = ServeClient("127.0.0.1", srv.port, retries=2)
+    try:
+        assert cli.health() and cli.ready()
+        x = np.ones((2, 4), np.float32)
+        out = cli.infer(x, deadline_ms=5000)
+        assert np.array_equal(out, x * 2.0)
+        st = cli.stats()
+        assert st["engine"]["executions"] >= 1
+        assert st["batcher"]["completed"] >= 1
+        # drain: readiness flips, new work refused, probe still alive
+        assert cli.drain()
+        assert cli.health() and not cli.ready()
+        with pytest.raises(Draining):
+            cli.infer(x)
+    finally:
+        try:
+            cli.shutdown()
+        except ServeError:
+            pass
+        cli.close()
+        srv.stop()
+
+
+def test_chaos_drop_on_serve_socket_degrades_gracefully():
+    """A dropped INFER reply (lost ack) and a dropped request frame both
+    degrade to a client retry with the correct answer — inference is
+    stateless, so at-least-once is safe. The injection lands in the same
+    telemetry timeline as the retry."""
+    _net, _arg, engine = _linear_engine(scale=2.0)
+    srv = ServeServer(engine, port=0, max_linger_ms=0.0)
+    srv.start()
+    obs.enable()
+    x = np.ones((1, 4), np.float32)
+    try:
+        chaos_rpc.configure([chaos_rpc.Rule("infer", "drop_reply", {1})])
+        cli = ServeClient("127.0.0.1", srv.port, retries=3,
+                          retry_interval=0.05)
+        out = cli.infer(x)  # first reply dropped -> transparent retry
+        assert np.array_equal(out, x * 2.0)
+        cli.close()
+
+        chaos_rpc.configure([chaos_rpc.Rule("infer", "drop_request", {1})])
+        cli = ServeClient("127.0.0.1", srv.port, retries=3,
+                          retry_interval=0.05)
+        out = cli.infer(x)
+        assert np.array_equal(out, x * 2.0)
+        cli.close()
+    finally:
+        chaos_rpc.reset()
+        srv.stop()
+    snap = obs.metrics.snapshot()
+    assert snap["counters"].get("chaos.injected", 0) >= 2
+    assert snap["counters"].get("serve.client.retries", 0) >= 2
+    names = {e[1] for e in obs.trace.events()}
+    assert "chaos.rpc" in names and "serve.client.rpc" in names
+
+
+def test_serve_flagship_end_to_end():
+    """ISSUE 5 acceptance: model-zoo CNN, 2 training batches, checkpoint,
+    serve.load, concurrent mixed-shape clients — outputs bitwise equal to
+    direct Module.predict, program count ≤ buckets, chrome trace carries
+    complete serve.* phase spans for every request."""
+    import os
+    import tempfile
+
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    classes, img = 4, 16
+    zoo = get_model("resnet18_v1", classes=classes, thumbnail=True)
+    traced = zoo(sym.Variable("data"))
+    net = sym.SoftmaxOutput(traced, name="softmax")
+
+    rng = np.random.RandomState(7)
+    x = rng.rand(8, 3, img, img).astype(np.float32)
+    y = rng.randint(0, classes, 8).astype(np.float32)
+    mod = Module(net, data_names=("data",), label_names=("softmax_label",))
+    mod.fit(NDArrayIter(x, y, batch_size=4), num_epoch=1,  # 2 batches
+            optimizer_params={"learning_rate": 0.05})
+    tmp = tempfile.mkdtemp(prefix="mxtpu_serve_")
+    prefix = os.path.join(tmp, "cnn")
+    mod.save_checkpoint(prefix, 1)
+
+    engine = serve.load(prefix, epoch=1, buckets=(2, 4), lint="warn")
+    obs.enable()
+    srv = ServeServer(engine, port=0, max_linger_ms=1.0)
+    srv.start()
+
+    # Module.predict oracles, one per bucket: the engine's bucket-B
+    # program is the SAME executable predict runs at batch B (identical
+    # jaxpr — see engine.py), so a size-n request padded to bucket B must
+    # be bitwise equal to the batch-B predict of the same rows
+    qx = rng.rand(14, 3, img, img).astype(np.float32)
+    ref = {b: mod.predict(NDArrayIter(qx, None, batch_size=b)).asnumpy()
+           for b in (2, 4)}
+
+    sizes = [1, 2, 3, 4, 1, 3]  # mixed ragged shapes across threads
+    offsets = np.cumsum([0] + sizes)
+    results = {}
+    errors = []
+
+    def client_thread(i):
+        try:
+            cli = ServeClient("127.0.0.1", srv.port)
+            lo, hi = offsets[i], offsets[i] + sizes[i]
+            out, ver = cli.infer(qx[lo:hi], deadline_ms=60000,
+                                 priority=i % 2, return_version=True)
+            results[i] = (out, ver)
+            cli.close()
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=client_thread, args=(i,))
+               for i in range(len(sizes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    srv.stop()
+    assert not errors, f"client failures: {errors}"
+
+    for i, size in enumerate(sizes):
+        out, ver = results[i]
+        lo = offsets[i]
+        assert ver == 0
+        # the request executed in bucket 2 or 4 (depending on which
+        # concurrent requests it coalesced with) — its rows must be
+        # bitwise equal to the matching-batch Module.predict oracle
+        assert any(np.array_equal(out, ref[b][lo:lo + size])
+                   for b in (2, 4)), \
+            f"thread {i} (rows {lo}:{lo + size}) not bitwise equal to " \
+            "Module.predict at either bucket"
+
+    # program bound: ≤ one compiled program per shape bucket, proven by
+    # the engine log AND the linter rule
+    assert engine.num_programs <= len(engine.buckets) == 2
+    assert TraceLinter().check_serve_engine(engine) == []
+
+    # chrome trace: complete serve.* phase spans for every request
+    trace_path = os.path.join(tmp, "serve_trace.json")
+    obs.export(trace_path)
+    with open(trace_path) as f:
+        doc = json.load(f)
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+             and str(e.get("name", "")).startswith("serve.")]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    for phase in ("serve.queue_wait", "serve.batch_assembly",
+                  "serve.execute", "serve.serialize", "serve.rpc"):
+        assert phase in by_name, f"missing {phase} spans: {sorted(by_name)}"
+        assert all("dur" in e for e in by_name[phase])
+    # one queue_wait span per request, one rpc span per wire call
+    assert len(by_name["serve.queue_wait"]) == len(sizes)
+    assert len(by_name["serve.rpc"]) >= len(sizes)
+    # latency histogram made it into the exported metrics snapshot
+    hists = doc["otherData"]["metrics"]["histograms"]
+    assert "serve.latency_seconds" in hists
+    assert hists["serve.latency_seconds"]["count"] == len(sizes)
+
+
+def test_server_hot_reload_over_the_wire():
+    """RELOAD RPC: server swaps onto a newer checkpoint; replies carry the
+    new version; in-flight/old results stay self-consistent."""
+    import os
+    import tempfile
+
+    net, arg = _mlp()
+    tmp = tempfile.mkdtemp(prefix="mxtpu_reload_")
+    prefix = os.path.join(tmp, "m")
+    from mxnet_tpu.model import save_checkpoint
+
+    save_checkpoint(prefix, 0, net, {k: nd.array(v) for k, v in arg.items()},
+                    {})
+    arg2 = {k: v + np.float32(0.25) for k, v in arg.items()}
+    save_checkpoint(prefix, 1, net, {k: nd.array(v) for k, v in arg2.items()},
+                    {})
+
+    engine = serve.load(prefix, epoch=0, max_batch_size=4, lint="off")
+    srv = ServeServer(engine, port=0, max_linger_ms=0.0)
+    srv.start()
+    cli = ServeClient("127.0.0.1", srv.port)
+    rng = np.random.RandomState(8)
+    x = rng.rand(2, 6).astype(np.float32)
+    try:
+        out0, v0 = cli.infer(x, return_version=True)
+        assert v0 == 0
+        new_version = cli.reload(prefix, epoch=1)
+        assert new_version == 1
+        out1, v1 = cli.infer(x, return_version=True)
+        assert v1 == 1
+        assert not np.array_equal(out0, out1)
+        # old-or-new proof at the engine level: out1 equals a fresh engine
+        # loaded directly from epoch 1
+        direct = serve.load(prefix, epoch=1, max_batch_size=4,
+                            lint="off").predict(x)
+        assert np.array_equal(out1, direct)
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_serve_load_checkpoint_dir_and_symbol_required():
+    import os
+    import tempfile
+
+    net, arg = _mlp()
+    tmp = tempfile.mkdtemp(prefix="mxtpu_ckdir_")
+    ckdir = os.path.join(tmp, "ck")
+    rng = np.random.RandomState(9)
+    x = rng.rand(8, 6).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.float32)
+    mod = Module(net, data_names=("data",), label_names=("softmax_label",))
+    mod.fit(NDArrayIter(x, y, batch_size=4), num_epoch=1,
+            optimizer_params={"learning_rate": 0.1}, checkpoint=ckdir)
+    with pytest.raises(ServeError, match="symbol"):
+        serve.load(ckdir)
+    engine = serve.load(ckdir, symbol=net, max_batch_size=4, lint="off")
+    ref = mod.predict(NDArrayIter(x[:3], None, batch_size=3)).asnumpy()
+    assert np.array_equal(engine.predict(x[:3]), ref)
+
+
+def test_gluon_export_serves_bitwise():
+    """HybridBlock.export now embeds the traced graph + param map, so the
+    export is directly servable and bitwise-faithful to the block."""
+    import os
+    import tempfile
+
+    from mxnet_tpu import gluon
+
+    mx.random.seed(10)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(12, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    rng = np.random.RandomState(10)
+    x = rng.rand(5, 7).astype(np.float32)
+    ref = net(nd.array(x)).asnumpy()
+    tmp = tempfile.mkdtemp(prefix="mxtpu_gluon_")
+    path = os.path.join(tmp, "dense")
+    net.export(path, epoch=0)
+    with open(f"{path}-symbol.json") as f:
+        meta = json.load(f)
+    assert "symbol" in meta and "param_map" in meta
+    engine = serve.load(path, epoch=0, max_batch_size=8, lint="off")
+    assert np.array_equal(engine.predict(x), ref)
+
+
+def test_symbol_json_roundtrip_preserves_aux_states():
+    """Regression (found by the serve-load path): tojson drops internal
+    ``__`` attrs, so auxness must be re-derived on load from the op
+    registry's aux slot names — otherwise a reloaded BatchNorm checkpoint
+    rebinds its moving stats as plain zero-initialized arguments and
+    serves wrong (and Module.load silently evals wrong, too)."""
+    data = sym.Variable("data")
+    net = sym.BatchNorm(
+        sym.Convolution(data, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                        name="c"), name="bn")
+    net = sym.SoftmaxOutput(sym.FullyConnected(sym.Flatten(net),
+                                               num_hidden=3, name="fc"),
+                            name="softmax")
+    loaded = mx.sym.load_json(net.tojson())
+    assert loaded.list_auxiliary_states() == net.list_auxiliary_states()
+    assert loaded.list_arguments() == net.list_arguments()
+
+    # end-to-end: a served checkpoint of a symbolic-BN model is bitwise
+    # faithful to the live module (moving stats actually restored)
+    import os
+    import tempfile
+
+    rng = np.random.RandomState(11)
+    x = rng.rand(8, 3, 8, 8).astype(np.float32)
+    y = rng.randint(0, 3, 8).astype(np.float32)
+    mod = Module(net, data_names=("data",), label_names=("softmax_label",))
+    mod.fit(NDArrayIter(x, y, batch_size=4), num_epoch=1,
+            optimizer_params={"learning_rate": 0.05})
+    prefix = os.path.join(tempfile.mkdtemp(prefix="mxtpu_aux_"), "bn")
+    mod.save_checkpoint(prefix, 1)
+    engine = serve.load(prefix, epoch=1, buckets=(4,), lint="off")
+    ref = mod.predict(NDArrayIter(x[:4], None, batch_size=4)).asnumpy()
+    assert np.array_equal(engine.predict(x[:4]), ref)
+
+
+def test_engine_rejects_missing_weights():
+    """A checkpoint missing (or misnaming) a WEIGHT must be refused at
+    load — zero-filling it would serve wrong predictions silently (only
+    label-like training-head leftovers may be zero-filled)."""
+    net, arg = _mlp()
+    bad = dict(arg)
+    del bad["fc2_weight"]
+    with pytest.raises(ServeError, match="fc2_weight"):
+        InferenceEngine(net, bad, lint="off")

@@ -1,0 +1,254 @@
+"""Load generator for the mxnet_tpu serving endpoint (docs/SERVING.md).
+
+Two drive modes against an in-process endpoint (or ``--connect host:port``
+for an external one):
+
+- **closed-loop** (``--mode closed``): N client threads, each sending the
+  next request the moment the previous reply lands. Measures the
+  throughput ceiling and the latency the system settles at under maximum
+  sustainable pressure.
+- **open-loop** (``--mode open``): requests arrive on a Poisson process at
+  ``--qps`` offered load, regardless of completions — the honest way to
+  measure tail latency under a traffic model (closed-loop self-throttles
+  and hides queueing collapse). Sheds (429s / deadline misses) are counted,
+  not retried: under overload, shedding IS the designed behavior.
+
+Reports p50/p95/p99 latency, achieved throughput vs offered load, shed
+rate, and the engine's compiled-program count (the bucketing bound), as a
+table and one JSON line (``--json``). ``bench.py`` imports ``run_bench``
+for the ``serve_qps`` / ``serve_p99_ms`` headline gains.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return float("nan")
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _build_model(model: str, classes: int = 10):
+    """Return (symbol, arg_params, aux_params, feature_shape)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym
+
+    rng = np.random.RandomState(0)
+    if model == "mlp":
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=64, name="fc1")
+        net = sym.Activation(net, act_type="relu", name="relu1")
+        net = sym.FullyConnected(net, num_hidden=classes, name="fc2")
+        net = sym.softmax(net, name="prob")
+        arg = {"fc1_weight": rng.randn(64, 32).astype(np.float32) * 0.1,
+               "fc1_bias": np.zeros(64, np.float32),
+               "fc2_weight": rng.randn(classes, 64).astype(np.float32) * 0.1,
+               "fc2_bias": np.zeros(classes, np.float32)}
+        return net, arg, {}, (32,)
+    # model-zoo CNN traced to a symbol
+    from mxnet_tpu.gluon.model_zoo import get_model
+    from mxnet_tpu import nd
+
+    mx.random.seed(0)
+    img = int(os.environ.get("SERVE_BENCH_IMAGE_SIZE", 32))
+    zoo = get_model(model, classes=classes, thumbnail=True)
+    zoo.initialize()
+    zoo(nd.array(rng.rand(1, 3, img, img).astype(np.float32)))  # shapes
+    traced = zoo(sym.Variable("data"))
+    net = sym.softmax(traced, name="prob")
+    # split by the traced graph's own arg/aux view (shared helper)
+    from mxnet_tpu.serve import _split_arg_aux
+
+    all_params = {p.name: p.data() for p in zoo._iter_params()}
+    arg, aux = _split_arg_aux(all_params, net)
+    return net, arg, aux, (3, img, img)
+
+
+def run_bench(model="mlp", mode="closed", duration=5.0, clients=4, qps=200.0,
+              max_batch_size=8, max_linger_ms=2.0, deadline_ms=None,
+              request_rows=1, connect=None, warmup=True):
+    """Drive the endpoint; returns the result dict (see module doc)."""
+    from mxnet_tpu import serve
+
+    srv = None
+    feat = None
+    if connect:
+        host, _, port = connect.partition(":")
+        addr = (host, int(port))
+        engine = None
+        feat_env = os.environ.get("SERVE_BENCH_FEATURE", "32")
+        feat = tuple(int(d) for d in feat_env.split(",") if d)
+    else:
+        net, arg, aux, feat = _build_model(model)
+        engine = serve.InferenceEngine(net, arg, aux,
+                                       max_batch_size=max_batch_size,
+                                       lint="off")
+        if warmup:
+            engine.warmup(feat)  # compiles never pollute latency numbers
+        srv = serve.ServeServer(engine, port=0, max_linger_ms=max_linger_ms)
+        srv.start()
+        addr = ("127.0.0.1", srv.port)
+
+    rng = np.random.RandomState(1)
+    payload = rng.rand(request_rows, *feat).astype(np.float32)
+    lat_lock = threading.Lock()
+    latencies: list = []
+    shed = [0]
+    errors = [0]
+    stop_at = [0.0]
+
+    def one_request(cli):
+        t0 = time.perf_counter()
+        try:
+            cli.infer(payload, deadline_ms=deadline_ms)
+        except (serve.RequestRejected, serve.DeadlineExceeded):
+            with lat_lock:
+                shed[0] += 1
+            return
+        except serve.ServeError:
+            with lat_lock:
+                errors[0] += 1
+            return
+        dt = time.perf_counter() - t0
+        with lat_lock:
+            latencies.append(dt)
+
+    t_start = time.perf_counter()
+    stop_at[0] = t_start + duration
+    if mode == "closed":
+        def closed_worker():
+            cli = serve.ServeClient(*addr)
+            while time.perf_counter() < stop_at[0]:
+                one_request(cli)
+            cli.close()
+
+        threads = [threading.Thread(target=closed_worker)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        offered = None
+    elif mode == "open":
+        # Poisson arrivals: a dispatcher sleeps exponential gaps and hands
+        # each request to a pooled connection — arrivals NEVER wait on
+        # completions (that would quietly turn the experiment closed-loop
+        # and hide queueing collapse), so the pool grows on exhaustion
+        pool = [serve.ServeClient(*addr) for _ in range(max(clients, 8))]
+        free = list(range(len(pool)))
+        free_lock = threading.Lock()
+        inflight = []
+        n_sent = 0
+
+        def fire(idx):
+            one_request(pool[idx])
+            with free_lock:
+                free.append(idx)
+
+        while time.perf_counter() < stop_at[0]:
+            gap = rng.exponential(1.0 / qps)
+            time.sleep(gap)
+            with free_lock:
+                if free:
+                    idx = free.pop()
+                else:  # all connections busy: open another, don't stall
+                    pool.append(serve.ServeClient(*addr))
+                    idx = len(pool) - 1
+            th = threading.Thread(target=fire, args=(idx,))
+            th.start()
+            inflight.append(th)
+            n_sent += 1
+        for th in inflight:
+            th.join(timeout=30)
+        for cli in pool:
+            cli.close()
+        offered = n_sent / (time.perf_counter() - t_start)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+    wall = time.perf_counter() - t_start
+
+    lat = sorted(latencies)
+    n_ok = len(lat)
+    out = {
+        "model": model, "mode": mode, "clients": clients,
+        "request_rows": request_rows, "duration_s": round(wall, 2),
+        "completed": n_ok, "shed": shed[0], "errors": errors[0],
+        "qps": round(n_ok * request_rows / wall, 2),
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3) if lat else None,
+        "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3) if lat else None,
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3) if lat else None,
+        "max_ms": round(lat[-1] * 1e3, 3) if lat else None,
+    }
+    if offered is not None:
+        out["offered_qps"] = round(offered * request_rows, 2)
+        out["shed_rate"] = round(shed[0] / max(shed[0] + n_ok, 1), 4)
+    if engine is not None:
+        out["compiled_programs"] = engine.num_programs
+        out["buckets"] = list(engine.buckets)
+    if srv is not None:
+        srv.stop()
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="closed/open-loop load generator for mxnet_tpu.serve")
+    ap.add_argument("--model", default="mlp",
+                    help="mlp or a model-zoo name (e.g. resnet18_v1)")
+    ap.add_argument("--mode", default="both",
+                    choices=("closed", "open", "both"))
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--qps", type=float, default=200.0,
+                    help="offered load for open-loop mode")
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--request-rows", type=int, default=1)
+    ap.add_argument("--max-batch-size", type=int, default=8)
+    ap.add_argument("--max-linger-ms", type=float, default=2.0)
+    ap.add_argument("--connect", default=None,
+                    help="host:port of an external endpoint (skips the "
+                         "in-process server)")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per mode instead of the table")
+    args = ap.parse_args(argv)
+
+    modes = ("closed", "open") if args.mode == "both" else (args.mode,)
+    results = []
+    for mode in modes:
+        res = run_bench(model=args.model, mode=mode, duration=args.duration,
+                        clients=args.clients, qps=args.qps,
+                        max_batch_size=args.max_batch_size,
+                        max_linger_ms=args.max_linger_ms,
+                        deadline_ms=args.deadline_ms,
+                        request_rows=args.request_rows,
+                        connect=args.connect)
+        results.append(res)
+        if args.json:
+            print(json.dumps(res))
+    if not args.json:
+        cols = ("qps", "offered_qps", "p50_ms", "p95_ms", "p99_ms",
+                "max_ms", "completed", "shed", "errors",
+                "compiled_programs")
+        print(f"{'metric':<18}" + "".join(f"{m:>14}" for m in modes))
+        for c in cols:
+            vals = [r.get(c, "-") for r in results]
+            if all(v in ("-", None) for v in vals):
+                continue
+            print(f"{c:<18}" + "".join(
+                f"{('-' if v is None else v):>14}" for v in vals))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
