@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos perf obs serve serve-bench
+.PHONY: lint lint-tests test test-fast chaos chaos-serve perf obs serve serve-bench
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -26,6 +26,15 @@ test-fast: lint
 # exactly-once checks, CRC corruption fallback (docs/ROBUSTNESS.md)
 chaos:
 	$(PYTHON) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+# serving-fleet + platform-outage chaos (docs/ROBUSTNESS.md "Serving
+# fleet"): the full fleet/platform suite incl. the slow SIGKILL flagship,
+# then a measured availability run — open-loop load over a 3-replica fleet,
+# one replica hard-killed mid-run, error rate + p50/p99 reported
+# before/during/after the kill window
+chaos-serve:
+	$(PYTHON) -m pytest tests/test_fleet.py tests/test_platform.py -q -p no:cacheprovider
+	$(PYTHON) tools/serve_bench.py --chaos --duration 9 --qps 80
 
 # dispatch-overhead guarantees (docs/PERFORMANCE.md): the perf-marked tests
 # assert a Trainer.step updates all params in <=2 compiled programs, then

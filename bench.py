@@ -521,40 +521,29 @@ def bench_lm_long(platform):
 
 
 def main():
-    import threading
-
-    import jax
+    from mxnet_tpu import platform as mxplatform
 
     # The axon tunnel can go fully unresponsive for hours (observed
-    # 2026-07-30: >3 h; jax.devices() then blocks forever). A hung bench
-    # looks like a driver-capture timeout with no artifact — fail loudly
-    # with one parseable JSON line instead.
-    devs = []
-    enum_exc = []
-
-    def _enum():
-        try:
-            devs.extend(jax.devices())
-        except Exception as e:  # noqa: BLE001 — reported distinctly below
-            enum_exc.append(f"{type(e).__name__}: {e}")
-
-    th = threading.Thread(target=_enum, daemon=True)
-    th.start()
-    th.join(timeout=float(os.environ.get("BENCH_DEVICE_TIMEOUT", 300)))
-    if not devs:
-        # a RAISE is a real init failure (plugin/config) and must not be
-        # triaged as the known tunnel hang
-        err = (f"device enumeration raised {enum_exc[0]}" if enum_exc
-               else "device enumeration timed out — axon tunnel "
-                    "unresponsive (not a framework failure; see "
-                    "BASELINE.md escalation log)")
+    # 2026-07-30: >3 h; jax.devices() then blocks forever). The platform
+    # watchdog (mxnet_tpu/platform.py) turns that hang — or a real init
+    # raise, reported distinctly so it is never triaged as the known
+    # outage — into one parseable JSON line instead of a capture timeout.
+    # BENCH_DEVICE_TIMEOUT (legacy knob) wins when set; otherwise the
+    # platform default applies — which honors MXNET_PLATFORM_TIMEOUT, so
+    # the repo-wide bounded-exit contract isn't silently overridden here
+    bench_to = os.environ.get("BENCH_DEVICE_TIMEOUT")
+    try:
+        devs = mxplatform.devices(
+            timeout=float(bench_to) if bench_to else None)
+    except mxplatform.PlatformUnavailable as e:
         print(json.dumps({
             "metric": "resnet50_v1 fp32 train throughput (batch=64, "
                       "224x224, 1 tpu chip)",
             "value": None,
             "unit": "images/sec",
             "vs_baseline": None,
-            "error": err[:300],
+            "error": f"device enumeration: {e.kind}: {e.detail}"[:300],
+            "platform_error": e.artifact(driver="bench.py"),
         }))
         sys.exit(1)
 

@@ -94,6 +94,7 @@ def __getattr__(name):
         "monitor": ".monitor",
         "mon": ".monitor",
         "obs": ".obs",
+        "platform": ".platform",
         "serve": ".serve",
         "native": ".native",
         "viz": ".visualization",

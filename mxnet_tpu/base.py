@@ -23,7 +23,22 @@ __all__ = [
     "set_env",
     "dtype_np",
     "dtype_name",
+    "capped_backoff",
 ]
+
+
+def capped_backoff(attempt: int, base_interval: float,
+                   max_interval: float) -> float:
+    """Capped exponential backoff with full-range jitter: attempt 0 →
+    ~base_interval, doubling up to max_interval, scaled by a uniform draw
+    in [0.5, 1.0]. The ONE retry-delay policy shared by the PS client and
+    the serving plane (client reconnects, replica-pool restarts): jitter
+    decorrelates a fleet hammering a restarting peer, and sharing the
+    helper keeps the two planes from ever drifting apart."""
+    import random
+
+    delay = min(float(max_interval), float(base_interval) * (2.0 ** attempt))
+    return delay * (0.5 + random.random() / 2.0)
 
 
 class MXNetError(RuntimeError):

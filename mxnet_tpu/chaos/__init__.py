@@ -7,8 +7,15 @@ lookup when chaos is off:
   RPCs at exact occurrence counts (``MXNET_CHAOS_RPC`` or programmatic
   rules). Hooks live in ``kvstore/ps_client.py``.
 - :mod:`mxnet_tpu.chaos.proc` — SIGKILL the current process at named code
-  points (``MXNET_CHAOS_KILL``, e.g. the checkpoint writer mid-rename), and
-  helpers to run a training subprocess and kill it at a chosen step.
+  points (``MXNET_CHAOS_KILL``, e.g. the checkpoint writer mid-rename or a
+  serve replica's ``serve:pre_reply``), and helpers to run a training
+  subprocess and kill it at a chosen step. The serving fleet
+  (``serve/fleet.py``) forwards ``MXNET_CHAOS_KILL_REPLICA<i>`` to replica
+  *i* as its ``MXNET_CHAOS_KILL``, so one env var SIGKILLs exactly one
+  member of a fleet at a named point.
+- :mod:`mxnet_tpu.chaos.platform` — hang the guarded platform entry points
+  (``MXNET_CHAOS_TUNNEL_HANG``) the way a dead accelerator tunnel does, so
+  every driver's bounded-exit + platform-error-artifact path is testable.
 
 Determinism is the point: a chaos test that flakes is worse than no test.
 Every injector fires on a counted occurrence of a named event, never on a
@@ -16,6 +23,6 @@ timer or a random draw.
 """
 from __future__ import annotations
 
-from . import proc, rpc
+from . import platform, proc, rpc
 
-__all__ = ["rpc", "proc"]
+__all__ = ["rpc", "proc", "platform"]

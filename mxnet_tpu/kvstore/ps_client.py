@@ -14,7 +14,6 @@ duplicate frames at the marked points below (one dict lookup when disabled).
 from __future__ import annotations
 
 import os
-import random
 import socket
 import struct
 import threading
@@ -23,7 +22,7 @@ import time
 import numpy as np
 
 from .. import obs
-from ..base import MXNetError
+from ..base import MXNetError, capped_backoff
 from ..chaos import rpc as chaos_rpc
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
                         OP_PUSH, OP_PUSH_SEQ, OP_PUSH_SPARSE,
@@ -63,12 +62,11 @@ class PSClient:
                                               timeout=self._timeout)
 
     def _backoff(self, attempt: int) -> float:
-        """Capped exponential backoff with full-range jitter: attempt 0 →
-        ~interval, doubling up to retry_max_interval; jitter in [0.5, 1.0]×
-        decorrelates a worker fleet hammering a restarting server."""
-        delay = min(self._retry_max_interval,
-                    self._retry_interval * (2.0 ** attempt))
-        return delay * (0.5 + random.random() / 2.0)
+        """Capped exponential backoff with full-range jitter (shared policy:
+        ``base.capped_backoff`` — the serve client and replica pool use the
+        same curve, so no plane reconnects in lockstep)."""
+        return capped_backoff(attempt, self._retry_interval,
+                              self._retry_max_interval)
 
     def _rpc(self, opcode, key="", payload=b"", timeout=None, retries=None):
         with self._lock:

@@ -14,7 +14,13 @@ Layers
 - :class:`~mxnet_tpu.serve.server.ServeServer` /
   :class:`~mxnet_tpu.serve.client.ServeClient` — a threaded socket front
   end on the parameter-server wire format, with health/readiness probes,
-  draining shutdown, and hot model reload (``server.py`` / ``client.py``).
+  draining shutdown, and hot model reload (``server.py`` / ``client.py``);
+- :class:`~mxnet_tpu.serve.fleet.ReplicaPool` /
+  :class:`~mxnet_tpu.serve.fleet.Router` /
+  :class:`~mxnet_tpu.serve.fleet.FleetServer` — supervised replica fleet:
+  restart-with-backoff, per-replica circuit breakers, failover + hedging,
+  and fleet-atomic two-phase hot reload (``fleet.py``,
+  docs/ROBUSTNESS.md "Serving fleet").
 
 Typical session::
 
@@ -53,11 +59,14 @@ from .engine import (DeadlineExceeded, Draining, InferenceEngine,
                      RequestRejected, ServeError, default_buckets)
 from .server import ServeServer
 from .client import ServeClient
+from .fleet import (CircuitBreaker, FleetServer, LocalReplica, ProcReplica,
+                    ReplicaPool, Router)
 
 __all__ = ["load", "load_params", "InferenceEngine", "DynamicBatcher",
            "Future", "ServeServer", "ServeClient", "ServeError",
            "RequestRejected", "DeadlineExceeded", "Draining",
-           "default_buckets"]
+           "default_buckets", "CircuitBreaker", "FleetServer",
+           "LocalReplica", "ProcReplica", "ReplicaPool", "Router"]
 
 
 def _newest_epoch(path: str) -> int:

@@ -124,6 +124,11 @@ class DynamicBatcher:
         self.submitted = 0
         self.completed = 0
         self.shed = 0
+        # sheds counted by cause (queue_full / deadline / draining):
+        # "the endpoint shed 40 requests" is an alert, "38 deadline-expired
+        # vs 2 queue-overflow" is a diagnosis — and the fleet STATS endpoint
+        # surfaces this per replica
+        self.shed_by_reason = {"queue_full": 0, "deadline": 0, "draining": 0}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="mxnet-tpu-serve-batcher")
         self._thread.start()
@@ -151,16 +156,20 @@ class DynamicBatcher:
             if not self._running:
                 raise ServeError("batcher is closed")
             if self._draining:
+                self.shed += 1  # the aggregate must equal sum(by_reason)
+                self.shed_by_reason["draining"] += 1
                 obs.inc("serve.shed_draining")
                 raise Draining("endpoint is draining; request refused")
             if self._qsize >= self.max_queue:
                 self.shed += 1
+                self.shed_by_reason["queue_full"] += 1
                 obs.inc("serve.shed_queue_full")
                 raise RequestRejected(
                     f"queue over watermark ({self.max_queue} requests); "
                     "back off and retry")
             if deadline is not None and deadline <= now:
                 self.shed += 1
+                self.shed_by_reason["deadline"] += 1
                 obs.inc("serve.shed_deadline")
                 raise DeadlineExceeded("deadline expired before enqueue")
             self._lanes[lane].append(req)
@@ -176,6 +185,7 @@ class DynamicBatcher:
     # ------------------------------------------------------------------
     def _shed_locked(self, req: _Request, why: str) -> None:
         self.shed += 1
+        self.shed_by_reason[why] = self.shed_by_reason.get(why, 0) + 1
         obs.inc(f"serve.shed_{why}")
         req.future._set_error(DeadlineExceeded(
             f"deadline expired while queued ({why}); request shed, "
@@ -330,7 +340,8 @@ class DynamicBatcher:
 
     def stats(self) -> dict:
         return {"submitted": self.submitted, "completed": self.completed,
-                "shed": self.shed, "queue_depth": self._qsize,
+                "shed": self.shed, "shed_by_reason": dict(self.shed_by_reason),
+                "queue_depth": self._qsize,
                 "inflight": self._inflight, "lanes": len(self._lanes),
                 "max_batch_size": self.max_batch_size,
                 "max_linger_ms": self.max_linger * 1e3,

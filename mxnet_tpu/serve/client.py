@@ -1,10 +1,19 @@
 """Client for the ``mxnet_tpu.serve`` socket endpoint.
 
 Mirrors ``kvstore/ps_client.py``: every RPC has a socket timeout and a
-reconnect-retry loop with capped exponential backoff + jitter, and the
-chaos layer (``mxnet_tpu.chaos.rpc``) can deterministically drop / delay /
-duplicate frames at the marked points — so the degradation paths the
-server promises are *tested* against a real flaky wire, not hoped for.
+reconnect-retry loop with capped exponential backoff + jitter (the delay
+policy is literally shared — ``base.capped_backoff`` — so the training and
+serving planes can never drift apart), and the chaos layer
+(``mxnet_tpu.chaos.rpc``) can deterministically drop / delay / duplicate
+frames at the marked points — so the degradation paths the server promises
+are *tested* against a real flaky wire, not hoped for.
+
+Connection is **lazy**: the constructor records the address and the first
+RPC connects, inside the jittered retry loop. An eager ``__init__``
+connect would make a fleet of clients reconnect in lockstep after a
+replica restart (every constructor fails at the same instant, every
+caller's retry clock starts together); routing the very first connect
+through the same backoff+jitter path decorrelates the herd.
 
 Inference is stateless, so retrying an INFER whose reply was lost is safe
 (the server may execute it twice; both executions return the same answer
@@ -16,21 +25,22 @@ retry loop gives up once the deadline passes and surfaces
 from __future__ import annotations
 
 import json
-import random
 import socket
 import struct
 import threading
 import time
-from typing import List, Optional, Union
+from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
 from .. import obs
+from ..base import capped_backoff
 from ..chaos import rpc as chaos_rpc
 from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
                                  _unpack_arrays)
 from .engine import (DeadlineExceeded, Draining, RequestRejected, ServeError)
-from .server import (OP_DRAIN, OP_HEALTH, OP_INFER, OP_READY, OP_RELOAD,
+from .server import (OP_ABORT_RELOAD, OP_COMMIT_RELOAD, OP_DRAIN, OP_HEALTH,
+                     OP_INFER, OP_PREPARE_RELOAD, OP_READY, OP_RELOAD,
                      OP_SHUTDOWN, OP_STATS, SERVE_OP_NAMES, STATUS_BAD_REQUEST,
                      STATUS_DEADLINE, STATUS_DRAINING, STATUS_INTERNAL,
                      STATUS_NOT_READY, STATUS_OK, STATUS_REJECTED, _INFER_HDR)
@@ -57,8 +67,9 @@ class ServeClient:
         self._retry_interval = retry_interval
         self._retry_max_interval = retry_max_interval
         self._lock = threading.Lock()
+        # lazy connect: the first RPC dials inside the jittered retry loop
+        # (see the module docstring — no reconnect lockstep after restarts)
         self._sock: Optional[socket.socket] = None
-        self._connect()
 
     # ------------------------------------------------------------------
     def _connect(self):
@@ -71,16 +82,20 @@ class ServeClient:
                                               timeout=self._timeout)
 
     def _backoff(self, attempt: int) -> float:
-        delay = min(self._retry_max_interval,
-                    self._retry_interval * (2.0 ** attempt))
-        return delay * (0.5 + random.random() / 2.0)
+        return capped_backoff(attempt, self._retry_interval,
+                              self._retry_max_interval)
 
     def _rpc(self, opcode: int, payload: bytes = b"",
-             deadline: Optional[float] = None):
+             deadline: Optional[float] = None,
+             retries: Optional[int] = None,
+             timeout: Optional[float] = None):
         """Send one frame, return the reply payload. Reconnect-retries on
         connection errors; gives up early once ``deadline`` (monotonic
-        seconds) has passed — retrying past the SLO only adds load."""
-        retries = self._retries
+        seconds) has passed — retrying past the SLO only adds load.
+        ``timeout`` overrides the socket timeout for this one RPC (the
+        fleet router bounds each failover attempt by the request's
+        remaining deadline, not the connection default)."""
+        retries = self._retries if retries is None else max(1, int(retries))
         last_err = None
         opname = SERVE_OP_NAMES.get(opcode, str(opcode))
         with self._lock:
@@ -92,6 +107,8 @@ class ServeClient:
                 try:
                     if self._sock is None:
                         self._connect()
+                    if timeout is not None:
+                        self._sock.settimeout(timeout)
                     rec = obs.enabled()
                     t0 = time.monotonic() if rec else 0.0
                     with obs.trace.span("serve.client.rpc", op=opname,
@@ -107,6 +124,8 @@ class ServeClient:
                     if rec:
                         obs.observe(f"serve.client.{opname}_seconds",
                                     time.monotonic() - t0)
+                    if timeout is not None:
+                        self._sock.settimeout(self._timeout)
                     return reply[2]
                 except (ConnectionError, OSError) as e:
                     last_err = e
@@ -119,6 +138,7 @@ class ServeClient:
                     delay = self._backoff(attempt)
                     if obs.enabled():
                         obs.inc("serve.client.retries")
+                        obs.observe("serve.client.backoff_seconds", delay)
                         obs.trace.event("serve.client.retry", op=opname,
                                         attempt=attempt, error=str(e))
                     time.sleep(delay)
@@ -139,20 +159,24 @@ class ServeClient:
     # API
     # ------------------------------------------------------------------
     def infer(self, *inputs, deadline_ms: Optional[float] = None,
-              priority: int = 1, return_version: bool = False
+              priority: int = 1, return_version: bool = False,
+              rpc_timeout: Optional[float] = None
               ) -> Union[np.ndarray, List[np.ndarray], tuple]:
         """Run inference on one request batch (one array per model input).
         ``deadline_ms`` propagates to the server's scheduler — an expired
         request is shed there, never executed late. ``priority`` 0 is the
-        tight-SLO lane. Returns the output array (or list), plus the
-        serving parameter version when ``return_version``."""
+        tight-SLO lane. ``rpc_timeout`` caps this call's socket wait (the
+        fleet router keeps a hung replica from eating the whole deadline).
+        Returns the output array (or list), plus the serving parameter
+        version when ``return_version``."""
         arrays = [np.ascontiguousarray(np.asarray(x)) for x in inputs]
         payload = (_INFER_HDR.pack(float(deadline_ms or 0.0),
                                    min(max(int(priority), 0), 255))
                    + _pack_arrays(arrays))
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms else None)
-        reply = self._check(self._rpc(OP_INFER, payload, deadline=deadline),
+        reply = self._check(self._rpc(OP_INFER, payload, deadline=deadline,
+                                      timeout=rpc_timeout),
                             "inference failed")
         (version,) = struct.unpack_from("<I", reply, 0)
         outs, _ = _unpack_arrays(reply[4:])
@@ -175,6 +199,20 @@ class ServeClient:
         except ServeError:
             return False
 
+    def ready_version(self) -> Tuple[bool, int]:
+        """Readiness plus the serving parameter version in one probe — the
+        fleet router gates a rejoining replica on version coherence with
+        this (a replica restarted mid-reload must rejoin at the committed
+        fleet version, never a stale one)."""
+        try:
+            reply = self._rpc(OP_READY)
+            if len(reply) >= 5:
+                status, version = struct.unpack_from("<BI", reply, 0)
+                return status == STATUS_OK, int(version)
+            return reply[0] == STATUS_OK, 0
+        except ServeError:
+            return False, -1
+
     def stats(self) -> dict:
         reply = self._check(self._rpc(OP_STATS), "stats failed")
         return json.loads(bytes(reply).decode("utf-8"))
@@ -189,6 +227,40 @@ class ServeClient:
             "reload failed")
         (version,) = struct.unpack_from("<I", reply, 0)
         return version
+
+    def prepare_reload(self, path: str, epoch: Optional[int] = None,
+                       prefix: str = "ckpt", *,
+                       version: Optional[int] = None,
+                       token: Optional[Tuple[int, int]] = None,
+                       retries: Optional[int] = None) -> int:
+        """Phase one of the fleet-atomic reload: the replica loads,
+        validates, and stages the new generation without flipping. Returns
+        the staged version (the fleet-stamped ``version`` when given)."""
+        spec = {"path": path, "epoch": epoch, "prefix": prefix,
+                "version": version,
+                "token": list(token) if token is not None else None}
+        reply = self._check(
+            self._rpc(OP_PREPARE_RELOAD, json.dumps(spec).encode("utf-8"),
+                      retries=retries),
+            "prepare_reload failed")
+        (staged,) = struct.unpack_from("<I", reply, 0)
+        return staged
+
+    def commit_reload(self, token: Tuple[int, int],
+                      retries: Optional[int] = None) -> int:
+        """Phase two: flip the staged generation. Safe to retry — the
+        server dedups the token, so a lost ack cannot double-flip."""
+        reply = self._check(
+            self._rpc(OP_COMMIT_RELOAD, struct.pack("<QQ", *token),
+                      retries=retries),
+            "commit_reload failed")
+        (ver,) = struct.unpack_from("<I", reply, 0)
+        return ver
+
+    def abort_reload(self, token: Tuple[int, int]) -> None:
+        """Discard a staged generation (idempotent rollback)."""
+        self._check(self._rpc(OP_ABORT_RELOAD, struct.pack("<QQ", *token)),
+                    "abort_reload failed")
 
     def drain(self, stop: bool = False) -> bool:
         """Ask the server to finish in-flight work and refuse new requests

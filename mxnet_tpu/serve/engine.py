@@ -199,6 +199,7 @@ class InferenceEngine:
 
         # -- device-resident parameters -----------------------------------
         self._lock = threading.Lock()
+        self._staged: Optional[_ParamSet] = None  # prepared, not yet serving
         self._params = _ParamSet(
             0,
             tuple(_to_device(arg_params[n]) for n in self._param_names),
@@ -268,8 +269,10 @@ class InferenceEngine:
         return list(self._data_names)
 
     def stats(self) -> dict:
+        staged = self._staged
         return {
             "version": self.version,
+            "staged_version": staged.version if staged is not None else None,
             "buckets": list(self.buckets),
             "num_programs": self.num_programs,
             "executions": self.exec_count,
@@ -438,11 +441,11 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # hot reload
     # ------------------------------------------------------------------
-    def reload(self, arg_params, aux_params=None) -> int:
-        """Swap in a new parameter generation without dropping in-flight
-        work. Validates that names, shapes, and dtypes match the serving
-        set — a drifted checkpoint would silently recompile every bucket
-        (and is almost always a deploy mistake). Returns the new version."""
+    def _validated_param_set(self, arg_params, aux_params):
+        """Shared reload validation: names, shapes, and dtypes must match
+        the serving set — a drifted checkpoint would silently recompile
+        every bucket (and is almost always a deploy mistake). Returns the
+        device-resident ``(new_args, new_aux)`` tuples."""
         arg_params = dict(arg_params or {})
         aux_params = dict(aux_params or {})
         missing = [n for n in self._param_names if n not in arg_params]
@@ -463,9 +466,58 @@ class InferenceEngine:
                         f"{(shape, dtype)}, new checkpoint {got} — this "
                         "would retrace every bucket; deploy a new engine "
                         "for a changed architecture")
+        return new_args, new_aux
+
+    def prepare_reload(self, arg_params, aux_params=None, *,
+                       version: Optional[int] = None) -> int:
+        """Phase one of a two-phase reload: do ALL fallible work now —
+        validate against the serving avals, place the new generation on
+        device — and stage it without flipping. :meth:`commit_reload` is
+        then a pure pointer swap that only process death can stop, which is
+        what makes a *fleet-wide* flip atomic (serve/fleet.py): every
+        replica prepares, then every live replica's commit is infallible.
+
+        ``version`` pins the staged generation number (the fleet stamps its
+        own coherent version across replicas); default is current + 1.
+        Returns the staged version."""
+        new_args, new_aux = self._validated_param_set(arg_params, aux_params)
         with self._lock:
-            version = self._params.version + 1
-            self._params = _ParamSet(version, new_args, new_aux)
+            v = int(version) if version is not None \
+                else self._params.version + 1
+            self._staged = _ParamSet(v, new_args, new_aux)
+        obs.event("serve.reload_prepared", version=v)
+        return v
+
+    def commit_reload(self) -> int:
+        """Phase two: flip the staged generation live (one reference swap;
+        in-flight executions keep the snapshot they started with). Raises
+        when nothing is staged. Returns the now-serving version."""
+        with self._lock:
+            if self._staged is None:
+                raise ServeError("no prepared reload to commit")
+            self._params, self._staged = self._staged, None
+            version = self._params.version
         obs.inc("serve.reloads")
         obs.event("serve.reload", version=version)
         return version
+
+    def abort_reload(self) -> None:
+        """Discard a staged generation (two-phase rollback; idempotent)."""
+        with self._lock:
+            self._staged = None
+
+    def reload(self, arg_params, aux_params=None, *,
+               version: Optional[int] = None) -> int:
+        """Swap in a new parameter generation without dropping in-flight
+        work (single-replica path). One lock acquisition, and the staged
+        slot is untouched — a legacy reload racing a two-phase fleet flip
+        can neither clobber the staged generation nor be half-applied.
+        Returns the new version."""
+        new_args, new_aux = self._validated_param_set(arg_params, aux_params)
+        with self._lock:
+            v = int(version) if version is not None \
+                else self._params.version + 1
+            self._params = _ParamSet(v, new_args, new_aux)
+        obs.inc("serve.reloads")
+        obs.event("serve.reload", version=v)
+        return v

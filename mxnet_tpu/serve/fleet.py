@@ -1,0 +1,1005 @@
+"""Fault-tolerant serving fleet — supervised replicas behind a failover
+router (docs/ROBUSTNESS.md "Serving fleet", docs/SERVING.md).
+
+PR 5 made one serve process degrade gracefully; this layer makes the
+*service* survive the process. The reference stack tolerates worker churn
+by design (ps-lite retries RPCs past dead peers — PAPER.md §1); the
+serving plane earns the same property here, plus the thing the reference
+never had: a **fleet-atomic** model flip.
+
+Layers (bottom up):
+
+- **Replica handles** — :class:`LocalReplica` (an in-process
+  :class:`~mxnet_tpu.serve.server.ServeServer`, "killed" by severing its
+  sockets — crash-equivalent to a client) and :class:`ProcReplica` (a real
+  subprocess, killed with SIGKILL). One supervision/routing code path
+  covers both, so the fast tier-1 tests and the subprocess chaos flagship
+  exercise the same logic.
+- :class:`ReplicaPool` — supervision: liveness via the existing
+  health/readiness probes, restart-with-capped-backoff+jitter on death
+  (``base.capped_backoff`` — the PS client's curve), and **target
+  tracking**: a replica restarted after a fleet reload is resynced to the
+  committed ``(artifact, version)`` *before* it is marked ready, so a
+  rejoin can never reintroduce a stale generation.
+- :class:`Router` — spreads traffic over ready replicas (round-robin),
+  with per-replica **circuit breakers** (trip on consecutive
+  failures/timeouts, half-open probe recovery), client-side **failover**
+  (INFER is read-only, so a retry on another replica is idempotent by
+  construction), optional **tail-latency hedging** (duplicate a request on
+  a second replica once it exceeds ``hedge_ms`` and the deadline still
+  allows; first reply wins), and the **fleet-atomic two-phase reload**.
+- :class:`FleetServer` — a :class:`ServeServer` whose "batcher" is the
+  Router: same wire protocol, so ``ServeClient`` / ``serve_bench`` /
+  chaos rules drive a fleet exactly like a single replica, and the STATS
+  endpoint reports per-replica breaker/failover state.
+
+Fleet-atomic reload (the two-phase flip)
+----------------------------------------
+``Router.reload`` reuses the PS plane's coordination idioms
+(``kvstore/ps_server.py``): the prepare wave is a *barrier* — no commit is
+sent until every ready replica has staged the new generation — and the
+commit carries a ``(controller_id, epoch)`` token the replica dedups in an
+LRU, so a retried commit whose ack was lost applies exactly once (the
+``(client_id, seq)`` push idiom). Phase one does ALL fallible work
+(disk load, device placement, aval validation); phase two is a pure
+pointer swap that only process death can stop. The router then pauses
+intake, drains in-flight work, commits everywhere, and stamps the fleet
+version — so:
+
+- a replica that dies during phase two serves *nothing* (not old params),
+  and the pool restarts it onto the already-committed target;
+- every reply carries its parameter version and the router rejects a
+  stale one (failing over instead of returning it);
+- ⇒ a mixed-version fleet is unreachable, asserted under chaos in
+  tests/test_fleet.py.
+
+Chaos hooks: ``MXNET_CHAOS_KILL_REPLICA<i>`` becomes replica *i*'s
+``MXNET_CHAOS_KILL`` (SIGKILL at ``serve:post_recv`` / ``serve:pre_reply``
+/ ``serve:pre_commit``); the router has ``fleet:post_prepare`` /
+``fleet:pre_commit`` kill points of its own.
+
+Telemetry: ``fleet.ready_replicas`` gauge, ``fleet.failovers`` /
+``fleet.hedges`` / ``fleet.hedge_wins`` / ``fleet.breaker_trips`` /
+``fleet.replica_deaths`` / ``fleet.replica_restarts`` counters,
+``fleet.rpc.replica<i>_seconds`` histograms, ``fleet.route`` spans — all
+in the same timeline as the serve spans (docs/OBSERVABILITY.md).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import queue
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..base import capped_backoff
+from ..chaos.proc import kill_point
+from .batcher import Future
+from .client import ServeClient
+from .engine import (DeadlineExceeded, Draining, RequestRejected, ServeError)
+from .server import ServeServer
+
+__all__ = ["CircuitBreaker", "LocalReplica", "ProcReplica", "ReplicaPool",
+           "Router", "FleetServer"]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-replica circuit breaker: ``threshold`` consecutive hard failures
+    trip it OPEN (requests skip the replica instead of queueing behind a
+    corpse); after ``cooldown`` seconds it goes HALF-OPEN and admits one
+    probe request — success closes it, failure re-opens it for another
+    cooldown. Thread-safe; shed replies (429/draining) are *answers*, not
+    failures, and reset the streak."""
+
+    def __init__(self, threshold: int = 3, cooldown: float = 1.0):
+        self.threshold = max(1, int(threshold))
+        self.cooldown = float(cooldown)
+        self.trips = 0
+        self._state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_out = False
+        self._lock = threading.Lock()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request go to this replica now? HALF-OPEN admits exactly
+        one in-flight probe per cooldown window."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if (self._state == "open"
+                    and time.monotonic() - self._opened_at >= self.cooldown):
+                self._state = "half_open"
+                self._probe_out = False
+            if self._state == "half_open" and not self._probe_out:
+                self._probe_out = True
+                return True
+            return False
+
+    def success(self) -> None:
+        with self._lock:
+            self._state = "closed"
+            self._consecutive = 0
+            self._probe_out = False
+
+    def release(self) -> None:
+        """An admitted request ended with NO verdict on the replica's
+        health (deadline expired client-side, dispatch never happened).
+        Free the half-open probe slot so the next request can probe —
+        without this, a deadline during half-open would blackhole the
+        replica forever."""
+        with self._lock:
+            self._probe_out = False
+
+    def failure(self) -> bool:
+        """Record a hard failure; True when this call tripped the breaker
+        open (the caller counts trips once, not per rejected request)."""
+        with self._lock:
+            self._consecutive += 1
+            if self._state == "half_open" or (
+                    self._state == "closed"
+                    and self._consecutive >= self.threshold):
+                self._state = "open"
+                self._opened_at = time.monotonic()
+                self._probe_out = False
+                self.trips += 1
+                return True
+            return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self._state, "consecutive": self._consecutive,
+                    "trips": self.trips, "threshold": self.threshold,
+                    "cooldown_s": self.cooldown}
+
+
+# ---------------------------------------------------------------------------
+# replica handles
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class LocalReplica:
+    """In-process replica: ``factory()`` must return a *started*
+    :class:`ServeServer`. ``kill()`` severs its listener and every live
+    connection without draining — to clients this is indistinguishable
+    from SIGKILL, which makes the failover paths testable at tier-1
+    speed."""
+
+    def __init__(self, factory: Callable[[], ServeServer]):
+        self._factory = factory
+        self.server: Optional[ServeServer] = None
+        self.idx = -1  # assigned by the pool
+
+    def start(self) -> Tuple[str, int]:
+        self.server = self._factory()
+        return ("127.0.0.1", self.server.port)
+
+    def alive(self) -> bool:
+        return self.server is not None and not self.server._stop.is_set()
+
+    def kill(self) -> None:
+        if self.server is not None:
+            self.server.abort()
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+
+class ProcReplica:
+    """Subprocess replica: ``python -m mxnet_tpu.serve.server <model>`` on
+    a pre-picked port. ``kill()`` is a real SIGKILL. Per-replica chaos:
+    ``MXNET_CHAOS_KILL_REPLICA<idx>`` in the parent environment becomes the
+    child's ``MXNET_CHAOS_KILL``, so one fleet member can be killed at a
+    named code point while its peers stay healthy."""
+
+    def __init__(self, model: str, *, args: Sequence[str] = (),
+                 env: Optional[dict] = None, log_path: Optional[str] = None):
+        self.model = model
+        self._args = list(args)
+        self._env = dict(env or {})
+        self._log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.idx = -1  # assigned by the pool
+
+    def start(self) -> Tuple[str, int]:
+        port = _free_port()
+        env = dict(os.environ)
+        env.update(self._env)
+        # the child must import mxnet_tpu regardless of the caller's cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        chaos = env.pop(f"MXNET_CHAOS_KILL_REPLICA{self.idx}",
+                        os.environ.get(f"MXNET_CHAOS_KILL_REPLICA{self.idx}"))
+        if chaos:
+            env["MXNET_CHAOS_KILL"] = chaos
+        out = open(self._log_path, "ab") if self._log_path \
+            else subprocess.DEVNULL
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.serve.server", self.model,
+                 "--port", str(port)] + self._args,
+                env=env, stdout=out, stderr=subprocess.STDOUT)
+        finally:
+            if out is not subprocess.DEVNULL:
+                out.close()
+        return ("127.0.0.1", port)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        if self.alive():
+            os.kill(self.proc.pid, signal.SIGKILL)
+
+    def stop(self) -> None:
+        if self.proc is not None:
+            if self.proc.poll() is None:
+                self.proc.terminate()
+                try:
+                    self.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    self.proc.kill()
+            self.proc.wait()  # reap
+
+
+# ---------------------------------------------------------------------------
+# replica pool (supervision)
+# ---------------------------------------------------------------------------
+
+class _Member:
+    __slots__ = ("idx", "handle", "state", "addr", "incarnation", "restarts",
+                 "restart_at", "restarting", "version", "rpcs", "errors",
+                 "sheds", "last_error")
+
+    def __init__(self, idx: int, handle):
+        self.idx = idx
+        self.handle = handle
+        self.state = "new"  # new|starting|ready|resync|dead|stopped
+        self.addr: Optional[Tuple[str, int]] = None
+        self.incarnation = 0
+        self.restarts = 0
+        self.restart_at = 0.0
+        self.restarting = False
+        self.version = 0
+        self.rpcs = 0
+        self.errors = 0
+        self.sheds = 0
+        self.last_error = ""
+
+
+class ReplicaPool:
+    """Supervise N serve replicas: bring-up, liveness probes, restart with
+    capped backoff + jitter, and reload-target tracking so restarts rejoin
+    at the committed fleet version (never a stale one)."""
+
+    def __init__(self, replicas: Sequence, *, probe_interval: float = 0.5,
+                 backoff_base: float = 0.2, backoff_cap: float = 5.0,
+                 ready_timeout: float = 120.0, probe_timeout: float = 3.0):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self._members = [_Member(i, h) for i, h in enumerate(replicas)]
+        for m in self._members:
+            m.handle.idx = m.idx
+        self.probe_interval = float(probe_interval)
+        self.backoff_base = float(backoff_base)
+        self.backoff_cap = float(backoff_cap)
+        self.ready_timeout = float(ready_timeout)
+        self.probe_timeout = float(probe_timeout)
+        self._target: Optional[Tuple[str, Optional[int], str, int]] = None
+        self._lock = threading.RLock()
+        self._pool_id = int.from_bytes(os.urandom(8), "little")
+        self._resync_seq = 0
+        self._stop_evt = threading.Event()
+        self._supervisor: Optional[threading.Thread] = None
+
+    @classmethod
+    def local(cls, factory: Callable[[], ServeServer], n: int,
+              **kw) -> "ReplicaPool":
+        return cls([LocalReplica(factory) for _ in range(n)], **kw)
+
+    @classmethod
+    def spawn(cls, model: str, n: int, *, args: Sequence[str] = (),
+              env: Optional[dict] = None, **kw) -> "ReplicaPool":
+        return cls([ProcReplica(model, args=args, env=env)
+                    for _ in range(n)], **kw)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, wait_ready: bool = True) -> "ReplicaPool":
+        threads = [threading.Thread(target=self._bring_up, args=(m,),
+                                    daemon=True) for m in self._members]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.ready_timeout)
+        self._stop_evt.clear()
+        self._supervisor = threading.Thread(target=self._supervise,
+                                            daemon=True,
+                                            name="mxtpu-fleet-supervisor")
+        self._supervisor.start()
+        if wait_ready and not self.ready_members():
+            self.stop()
+            errs = {m.idx: m.last_error for m in self._members}
+            raise ServeError(f"no replica became ready: {errs}")
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
+        for m in self._members:
+            try:
+                m.handle.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+            m.state = "stopped"
+        self._gauge()
+
+    # -- views ----------------------------------------------------------
+    def members(self) -> List[_Member]:
+        return list(self._members)
+
+    def ready_members(self) -> List[_Member]:
+        return [m for m in self._members if m.state == "ready"]
+
+    @property
+    def target(self):
+        with self._lock:
+            return self._target
+
+    def set_target(self, path: str, epoch: Optional[int], prefix: str,
+                   version: int) -> None:
+        """Record the committed reload target. Called by the router BEFORE
+        phase-two commits begin, so a replica killed mid-flip restarts onto
+        the new generation — the invariant that keeps a mixed-version fleet
+        unreachable."""
+        with self._lock:
+            self._target = (path, epoch, prefix, int(version))
+
+    def request_resync(self, idx: int) -> None:
+        """Ask the supervisor to re-drive a live replica onto the committed
+        target (a commit that errored on an alive replica)."""
+        m = self._members[idx]
+        if m.state == "ready":
+            m.state = "resync"
+
+    def kill(self, idx: int) -> None:
+        """Chaos helper: hard-kill one replica (SIGKILL / socket sever).
+        The supervisor detects and restarts it."""
+        obs.event("fleet.chaos_kill", replica=idx)
+        self._members[idx].handle.kill()
+
+    def stats(self) -> dict:
+        return {"replicas": len(self._members),
+                "ready": len(self.ready_members()),
+                "target_version": self._target[3] if self._target else None,
+                "restarts": sum(m.restarts for m in self._members)}
+
+    # -- internals ------------------------------------------------------
+    def _gauge(self) -> None:
+        obs.set_gauge("fleet.ready_replicas", len(self.ready_members()))
+
+    def _client(self, m: _Member, timeout: Optional[float] = None
+                ) -> ServeClient:
+        return ServeClient(m.addr[0], m.addr[1],
+                           timeout=timeout or self.probe_timeout, retries=1)
+
+    def _bring_up(self, m: _Member) -> None:
+        m.state = "starting"
+        try:
+            m.addr = m.handle.start()
+            m.incarnation += 1
+            deadline = time.monotonic() + self.ready_timeout
+            ready = False
+            while time.monotonic() < deadline and not self._stop_evt.is_set():
+                if not m.handle.alive():
+                    raise ServeError("replica process died during bring-up")
+                cli = self._client(m)
+                try:
+                    ready, m.version = cli.ready_version()
+                finally:
+                    cli.close()
+                if ready:
+                    break
+                time.sleep(min(0.05 * (1 + m.restarts), 0.5))
+            if not ready:
+                raise ServeError(
+                    f"replica {m.idx} not ready within {self.ready_timeout}s")
+            self._resync_member(m)  # rejoin at the committed fleet version
+            m.state = "ready"
+            obs.event("fleet.replica_ready", replica=m.idx,
+                      incarnation=m.incarnation, version=m.version)
+        except Exception as e:  # noqa: BLE001 — supervised: schedule retry
+            m.last_error = f"{type(e).__name__}: {e}"
+            self._schedule_restart(m)
+        self._gauge()
+
+    def _resync_member(self, m: _Member) -> None:
+        tgt = self.target
+        if tgt is None or m.version == tgt[3]:
+            return
+        path, epoch, prefix, version = tgt
+        with self._lock:
+            self._resync_seq += 1
+            token = (self._pool_id, self._resync_seq)
+        cli = self._client(m, timeout=max(self.probe_timeout, 10.0))
+        try:
+            cli.prepare_reload(path, epoch=epoch, prefix=prefix,
+                               version=version, token=token, retries=3)
+            cli.commit_reload(token, retries=3)
+        finally:
+            cli.close()
+        m.version = version
+        obs.event("fleet.replica_resynced", replica=m.idx, version=version)
+
+    def _probe_ok(self, m: _Member) -> bool:
+        cli = self._client(m)
+        try:
+            return cli.health()
+        finally:
+            cli.close()
+
+    def _mark_dead(self, m: _Member) -> None:
+        m.state = "dead"
+        obs.inc("fleet.replica_deaths")
+        obs.event("fleet.replica_dead", replica=m.idx,
+                  incarnation=m.incarnation)
+        self._schedule_restart(m)
+        self._gauge()
+
+    def _schedule_restart(self, m: _Member) -> None:
+        m.state = "dead"
+        delay = capped_backoff(m.restarts, self.backoff_base,
+                               self.backoff_cap)
+        m.restart_at = time.monotonic() + delay
+
+    def _restart(self, m: _Member) -> None:
+        try:
+            m.restarts += 1
+            obs.inc("fleet.replica_restarts")
+            try:
+                m.handle.stop()  # reap the corpse / release the old socket
+            except Exception:  # noqa: BLE001 — it is already dead
+                pass
+            self._bring_up(m)
+        finally:
+            m.restarting = False
+
+    def _probe_ready_members(self) -> None:
+        """Probe every ready member CONCURRENTLY: a wedged replica blocks
+        its own probe for probe_timeout, not the detection and restart of
+        its dead peers (serial probing would head-of-line-block the whole
+        supervision cycle behind one corpse)."""
+        ready = [m for m in self._members if m.state == "ready"]
+        if not ready:
+            return
+        verdicts = {}
+
+        def probe(m):
+            try:
+                verdicts[m.idx] = m.handle.alive() and self._probe_ok(m)
+            except Exception:  # noqa: BLE001 — a broken probe is a death
+                verdicts[m.idx] = False
+
+        threads = [threading.Thread(target=probe, args=(m,), daemon=True)
+                   for m in ready]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.probe_timeout + 1.0)
+        for m in ready:
+            # no verdict (probe thread still stuck) = not answering = dead
+            if m.state == "ready" and not verdicts.get(m.idx, False):
+                self._mark_dead(m)
+
+    def _supervise(self) -> None:
+        while not self._stop_evt.wait(self.probe_interval):
+            self._probe_ready_members()
+            for m in self._members:
+                if self._stop_evt.is_set():
+                    return
+                if m.state == "resync":
+                    try:
+                        self._resync_member(m)
+                        m.state = "ready"
+                    except Exception as e:  # noqa: BLE001 — degrade to dead
+                        m.last_error = f"{type(e).__name__}: {e}"
+                        self._mark_dead(m)
+                elif (m.state == "dead" and not m.restarting
+                        and time.monotonic() >= m.restart_at):
+                    m.restarting = True
+                    threading.Thread(target=self._restart, args=(m,),
+                                     daemon=True).start()
+            self._gauge()
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+
+class _ConnPool:
+    """Free-list of ServeClients for one replica incarnation (one socket
+    per concurrent request, not one serialized socket per replica)."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float):
+        self._addr = addr
+        self._timeout = timeout
+        self._free: List[ServeClient] = []
+        self._lock = threading.Lock()
+
+    def acquire(self) -> ServeClient:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+        return ServeClient(self._addr[0], self._addr[1],
+                           timeout=self._timeout, retries=1)
+
+    def release(self, cli: ServeClient) -> None:
+        with self._lock:
+            self._free.append(cli)
+
+    def close(self) -> None:
+        with self._lock:
+            for cli in self._free:
+                cli.close()
+            self._free.clear()
+
+
+class Router:
+    """Spread INFER traffic across a :class:`ReplicaPool` with breakers,
+    failover, hedging, and the fleet-atomic two-phase reload. Duck-types
+    the :class:`DynamicBatcher` surface (``submit``/``stats``/``drain``/
+    ``close`` + ``ready``/``version``), so a :class:`ServeServer` front
+    can mount it directly as its batcher (:class:`FleetServer`)."""
+
+    def __init__(self, pool: ReplicaPool, *, hedge_ms: Optional[float] = None,
+                 breaker_threshold: int = 3, breaker_cooldown: float = 1.0,
+                 client_timeout: float = 30.0, gate_timeout: float = 10.0,
+                 flip_timeout: float = 30.0):
+        self._pool = pool
+        self.hedge_ms = hedge_ms
+        self._client_timeout = float(client_timeout)
+        self._gate_timeout = float(gate_timeout)
+        self._flip_timeout = float(flip_timeout)
+        self._breakers = {m.idx: CircuitBreaker(breaker_threshold,
+                                                breaker_cooldown)
+                          for m in pool.members()}
+        self._pools: dict = {}
+        self._lock = threading.Lock()
+        self._rr = 0
+        # intake gate: cleared only for the phase-two flip window
+        self._gate = threading.Event()
+        self._gate.set()
+        self._cv = threading.Condition()
+        self._inflight = 0
+        tgt = pool.target
+        self._fleet_version = tgt[3] if tgt else 0
+        self._reload_lock = threading.Lock()
+        self._controller_id = int.from_bytes(os.urandom(8), "little")
+        self._reload_epoch = 0
+        self._commit_hook: Optional[Callable] = None  # test injection point
+        # unconditional counters (the STATS endpoint works with obs off)
+        self.failovers = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.stale_rejected = 0
+
+    # -- plumbing -------------------------------------------------------
+    def _breaker(self, m: _Member) -> CircuitBreaker:
+        br = self._breakers.get(m.idx)
+        if br is None:
+            br = self._breakers.setdefault(m.idx, CircuitBreaker())
+        return br
+
+    @contextlib.contextmanager
+    def _conn(self, m: _Member):
+        key = (m.idx, m.incarnation)
+        pool = self._pools.get(key)
+        if pool is None:
+            with self._lock:
+                pool = self._pools.get(key)
+                if pool is None:
+                    pool = _ConnPool(m.addr, self._client_timeout)
+                    self._pools[key] = pool
+                    for k in [k for k in self._pools
+                              if k[0] == m.idx and k != key]:
+                        self._pools.pop(k).close()  # stale incarnation
+        cli = pool.acquire()
+        try:
+            yield cli
+        except BaseException:
+            cli.close()  # unknown wire state: never back into the pool
+            raise
+        else:
+            pool.release(cli)
+
+    def _candidates(self) -> List[_Member]:
+        members = self._pool.ready_members()
+        if not members:
+            return []
+        with self._lock:
+            start = self._rr % len(members)
+            self._rr += 1
+        return members[start:] + members[:start]
+
+    # -- the per-replica attempt ---------------------------------------
+    def _attempt(self, m: _Member, arrays, deadline: Optional[float],
+                 priority: int):
+        """One replica, one try. Returns ``(True, (outs, version))`` or
+        ``(False, exception)``. Hard failures feed the breaker; shed
+        replies are answers (the replica is alive) and reset it."""
+        rem = None if deadline is None else deadline - time.monotonic()
+        if rem is not None and rem <= 0:
+            return False, DeadlineExceeded("deadline expired before dispatch")
+        br = self._breaker(m)
+        if not br.allow():
+            return False, RequestRejected(
+                f"replica {m.idx} circuit breaker open")
+        rpc_timeout = self._client_timeout if rem is None \
+            else min(self._client_timeout, rem + 0.5)
+        t0 = time.monotonic()
+        try:
+            with obs.trace.span("fleet.route", replica=m.idx,
+                                priority=priority):
+                with self._conn(m) as cli:
+                    result, version = cli.infer(
+                        *arrays,
+                        deadline_ms=rem * 1e3 if rem is not None else None,
+                        priority=priority, return_version=True,
+                        rpc_timeout=rpc_timeout)
+        except (RequestRejected, Draining) as e:
+            br.success()  # an answering replica is a healthy replica
+            m.sheds += 1
+            return False, e
+        except DeadlineExceeded as e:
+            # no health verdict (the budget ran out, the replica may be
+            # fine) — but the half-open probe slot must not leak
+            br.release()
+            return False, e
+        except (ServeError, ConnectionError, OSError) as e:
+            if br.failure():
+                obs.inc("fleet.breaker_trips")
+                obs.event("fleet.breaker_trip", replica=m.idx)
+            m.errors += 1
+            m.last_error = f"{type(e).__name__}: {e}"
+            return False, e
+        br.success()
+        m.rpcs += 1
+        obs.observe(f"fleet.rpc.replica{m.idx}_seconds",
+                    time.monotonic() - t0)
+        outs = result if isinstance(result, list) else [result]
+        return True, (outs, int(version))
+
+    def _attempt_hedged(self, primary: _Member, secondary: _Member, arrays,
+                        deadline: Optional[float], priority: int):
+        """Race a slow primary against a hedge on a second replica: wait
+        ``hedge_ms`` for the primary, then duplicate the request (INFER is
+        read-only — the loser's work is wasted capacity, not corruption)
+        and take the first success."""
+        q: "queue.Queue" = queue.Queue()
+
+        def run(member):
+            q.put((member, self._attempt(member, arrays, deadline, priority)))
+
+        threading.Thread(target=run, args=(primary,), daemon=True).start()
+        try:
+            member, (ok, val) = q.get(timeout=self.hedge_ms / 1e3)
+            if ok:
+                return True, val
+            # primary failed FAST (conn refused, shed): that is plain
+            # failover to the secondary, not a hedge
+            self.failovers += 1
+            obs.inc("fleet.failovers")
+            return self._attempt(secondary, arrays, deadline, priority)
+        except queue.Empty:
+            pass
+        self.hedges += 1
+        obs.inc("fleet.hedges")
+        obs.event("fleet.hedge", primary=primary.idx,
+                  secondary=secondary.idx)
+        threading.Thread(target=run, args=(secondary,), daemon=True).start()
+        budget = self._client_timeout if deadline is None \
+            else max(deadline - time.monotonic(), 0.0)
+        end = time.monotonic() + budget + 0.5
+        last = None
+        for _ in range(2):
+            try:
+                member, (ok, val) = q.get(
+                    timeout=max(end - time.monotonic(), 0.01))
+            except queue.Empty:
+                break
+            if ok:
+                if member is secondary:
+                    self.hedge_wins += 1
+                    obs.inc("fleet.hedge_wins")
+                return True, val
+            last = val
+        return False, (last if last is not None
+                       else DeadlineExceeded("hedged attempts timed out"))
+
+    # -- public API -----------------------------------------------------
+    def infer(self, inputs, deadline_ms: Optional[float] = None,
+              priority: int = 1) -> Tuple[List[np.ndarray], int]:
+        """Route one request; failover across replicas within the deadline.
+        Returns ``(outputs, param_version)`` like ``InferenceEngine.infer``.
+        Raises the last shed error only when every replica shed; a hard
+        failure on every replica raises :class:`ServeError`."""
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        arrays = [np.ascontiguousarray(np.asarray(x)) for x in inputs]
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms else None)
+        # gate-check and inflight-increment must be one atomic step from
+        # the flip's point of view: check the gate again under _cv after
+        # counting ourselves, so either the reload's drain sees us (and
+        # waits) or we see the cleared gate (and back off) — a request can
+        # never slip between the gate clearing and the commit wave
+        gate_deadline = time.monotonic() + self._gate_timeout
+        while True:
+            budget = gate_deadline - time.monotonic()
+            if deadline is not None:
+                budget = min(budget, deadline - time.monotonic())
+            if budget <= 0 or not self._gate.wait(timeout=budget):
+                raise RequestRejected("fleet reload flip in progress; retry")
+            with self._cv:
+                if self._gate.is_set():
+                    self._inflight += 1
+                    break
+        try:
+            return self._infer_routed(arrays, deadline, priority)
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()
+
+    def _infer_routed(self, arrays, deadline, priority):
+        cands = self._candidates()
+        if not cands:
+            raise RequestRejected("no ready replicas")
+        shed_err = None
+        hard_err = None
+        i = 0
+        while i < len(cands):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DeadlineExceeded(
+                    "deadline expired during fleet failover")
+            hedge_ok = (self.hedge_ms is not None and i + 1 < len(cands)
+                        and (deadline is None
+                             or (deadline - time.monotonic()) * 1e3
+                             > 2 * self.hedge_ms))
+            if hedge_ok:
+                ok, val = self._attempt_hedged(cands[i], cands[i + 1],
+                                               arrays, deadline, priority)
+                i += 2
+            else:
+                ok, val = self._attempt(cands[i], arrays, deadline, priority)
+                i += 1
+            if ok:
+                outs, version = val
+                if version != self._fleet_version:
+                    # a reply from a generation the fleet no longer serves
+                    # must never escape — reject and fail over (the pool
+                    # resyncs the straggler)
+                    self.stale_rejected += 1
+                    obs.inc("fleet.stale_version_rejected")
+                    hard_err = ServeError(
+                        f"stale param version {version} "
+                        f"(fleet at {self._fleet_version})")
+                    continue
+                return outs, version
+            if isinstance(val, DeadlineExceeded):
+                raise val
+            if isinstance(val, (RequestRejected, Draining)):
+                shed_err = val
+            else:
+                hard_err = val
+            if i < len(cands):
+                self.failovers += 1
+                obs.inc("fleet.failovers")
+        if hard_err is not None:
+            raise ServeError(
+                f"all {len(cands)} replicas failed; last: {hard_err}")
+        raise shed_err if shed_err is not None \
+            else RequestRejected("no replica accepted the request")
+
+    # -- DynamicBatcher duck-type (FleetServer mounts this) -------------
+    def submit(self, inputs, deadline_ms: Optional[float] = None,
+               priority: int = 1) -> Future:
+        """Route inline and return a resolved Future (concurrency comes
+        from the front's thread-per-connection handlers); shed/deadline
+        errors raise here, matching ``DynamicBatcher.submit`` fail-fast."""
+        fut = Future()
+        fut._set_result(self.infer(inputs, deadline_ms=deadline_ms,
+                                   priority=priority))
+        return fut
+
+    def ready(self) -> bool:
+        return self._gate.is_set() and bool(self._pool.ready_members())
+
+    @property
+    def version(self) -> int:
+        return self._fleet_version
+
+    def queue_depth(self) -> int:
+        return 0  # routing is synchronous; queues live in the replicas
+
+    def stats(self) -> dict:
+        replicas = {}
+        for m in self._pool.members():
+            replicas[str(m.idx)] = {
+                "state": m.state,
+                "addr": f"{m.addr[0]}:{m.addr[1]}" if m.addr else None,
+                "incarnation": m.incarnation, "restarts": m.restarts,
+                "version": m.version, "rpcs": m.rpcs, "errors": m.errors,
+                "sheds": m.sheds, "last_error": m.last_error,
+                "breaker": self._breaker(m).snapshot(),
+            }
+        return {"fleet_version": self._fleet_version,
+                "ready_replicas": len(self._pool.ready_members()),
+                "failovers": self.failovers, "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "stale_rejected": self.stale_rejected,
+                "breaker_trips": sum(b.trips
+                                     for b in self._breakers.values()),
+                "inflight": self._inflight,
+                "intake_paused": not self._gate.is_set(),
+                "hedge_ms": self.hedge_ms,
+                "replicas": replicas}
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(timeout=remaining)
+        return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        self.drain(timeout)
+        with self._lock:
+            for pool in self._pools.values():
+                pool.close()
+            self._pools.clear()
+
+    def _wait_inflight_zero(self, timeout: float) -> bool:
+        return self.drain(timeout)
+
+    # -- fleet-atomic reload -------------------------------------------
+    def reload(self, path: str, epoch: Optional[int] = None,
+               prefix: str = "ckpt") -> int:
+        """Two-phase fleet flip: every replica serves the new generation or
+        none does (see the module docstring for the atomicity argument).
+        Returns the new fleet version."""
+        with self._reload_lock:
+            members = self._pool.ready_members()
+            if not members:
+                raise ServeError("no ready replicas to reload")
+            new_version = self._fleet_version + 1
+            self._reload_epoch += 1
+            token = (self._controller_id, self._reload_epoch)
+            with obs.trace.span("fleet.reload", version=new_version,
+                                replicas=len(members)):
+                self._prepare_all(members, token, path, epoch, prefix,
+                                  new_version)
+                kill_point("fleet:post_prepare")
+                self._commit_all(members, token, path, epoch, prefix,
+                                 new_version)
+            obs.inc("fleet.reloads")
+            obs.event("fleet.reload", version=new_version)
+            return new_version
+
+    def _prepare_all(self, members, token, path, epoch, prefix, version):
+        """Phase one — a barrier: every ready replica stages the new
+        generation (all fallible work happens here) or the whole reload
+        aborts and nothing changed anywhere."""
+        prepared = []
+        try:
+            for m in members:
+                with self._conn(m) as cli:
+                    cli.prepare_reload(path, epoch=epoch, prefix=prefix,
+                                       version=version, token=token,
+                                       retries=3)
+                prepared.append(m)
+        except Exception as e:
+            for p in prepared:
+                try:
+                    with self._conn(p) as cli:
+                        cli.abort_reload(token)
+                except Exception:  # noqa: BLE001 — rollback is best-effort
+                    pass
+            raise ServeError(f"fleet reload prepare failed "
+                             f"(rolled back on {len(prepared)} replicas): "
+                             f"{type(e).__name__}: {e}")
+
+    def _commit_all(self, members, token, path, epoch, prefix, version):
+        """Phase two — pause intake, drain in-flight, flip every live
+        replica (a pure pointer swap), stamp the fleet version. A replica
+        that dies mid-phase serves nothing and restarts onto the committed
+        target; one that errors while alive is resynced and version-gated
+        until it is."""
+        self._gate.clear()
+        try:
+            if not self._wait_inflight_zero(self._flip_timeout):
+                for m in members:
+                    try:
+                        with self._conn(m) as cli:
+                            cli.abort_reload(token)
+                    except Exception:  # noqa: BLE001 — best-effort rollback
+                        pass
+                raise ServeError(
+                    f"fleet reload: in-flight requests did not drain within "
+                    f"{self._flip_timeout}s flip window; aborted (still "
+                    f"serving v{self._fleet_version} everywhere)")
+            # commit point: from here the reload WILL happen. Restarts must
+            # land on the new generation even if every commit RPC dies.
+            self._pool.set_target(path, epoch, prefix, version)
+            for m in members:
+                kill_point("fleet:pre_commit")
+                if self._commit_hook is not None:
+                    self._commit_hook(m)  # chaos injection for tests
+                try:
+                    with self._conn(m) as cli:
+                        cli.commit_reload(token, retries=3)
+                    m.version = version
+                except (ServeError, ConnectionError, OSError) as e:
+                    # dead mid-flip → serves nothing; alive-but-errored →
+                    # resynced by the pool and version-gated meanwhile
+                    obs.inc("fleet.commit_failures")
+                    obs.event("fleet.commit_failure", replica=m.idx,
+                              error=str(e)[:160])
+                    m.last_error = f"commit: {type(e).__name__}: {e}"
+                    self._pool.request_resync(m.idx)
+            self._fleet_version = version
+        finally:
+            self._gate.set()
+
+
+# ---------------------------------------------------------------------------
+# socket front
+# ---------------------------------------------------------------------------
+
+class FleetServer(ServeServer):
+    """One socket endpoint for the whole fleet: the Router is mounted as
+    the server's batcher, so INFER routes with failover/hedging, READY
+    reflects live replicas + the fleet version, RELOAD is the fleet-atomic
+    two-phase flip, and STATS returns per-replica breaker/failover state —
+    all on the unchanged serve wire protocol (``ServeClient``,
+    ``serve_bench``, and the chaos rule table work as-is)."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0, *, default_timeout: float = 30.0):
+        super().__init__(engine=None, batcher=router, host=host, port=port,
+                         default_timeout=default_timeout)
+        self._router = router
+
+    def reload(self, path: str, epoch: Optional[int] = None,
+               prefix: str = "ckpt") -> int:
+        return self._router.reload(path, epoch=epoch, prefix=prefix)

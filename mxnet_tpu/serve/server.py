@@ -19,6 +19,17 @@ Wire protocol (little-endian, see ``kvstore/ps_server.py`` for framing):
   STATS  reply   : u8 0 | utf-8 json (engine + batcher + server stats)
   DRAIN  request : u8 stop_after (0/1)
   DRAIN  reply   : u8 0 once queued + in-flight work finished
+  PREPARE_RELOAD : utf-8 json {"path", "epoch", "prefix", "version",
+                   "token": [cid, epoch]} — phase one of the fleet-atomic
+                   reload (serve/fleet.py): load + validate + stage, do NOT
+                   flip. reply u8 status | (ok: u32 staged_version)
+  COMMIT_RELOAD  : u64 cid | u64 epoch (the prepare's token). Flips the
+                   staged set — a pure pointer swap, infallible short of
+                   process death. Exactly-once: a retried COMMIT whose ack
+                   was lost re-acks from the token LRU without re-flipping
+                   (the kvstore (client_id, seq) dedup idiom). reply
+                   u8 status | (ok: u32 version)
+  ABORT_RELOAD   : u64 cid | u64 epoch — discard the staged set (idempotent)
 
 Graceful degradation contract (tested in tests/test_serve.py):
 
@@ -54,7 +65,8 @@ from .engine import (DeadlineExceeded, Draining, InferenceEngine,
                      RequestRejected, ServeError)
 
 __all__ = ["ServeServer", "OP_INFER", "OP_HEALTH", "OP_READY", "OP_RELOAD",
-           "OP_STATS", "OP_DRAIN", "OP_SHUTDOWN", "SERVE_OP_NAMES",
+           "OP_STATS", "OP_DRAIN", "OP_SHUTDOWN", "OP_PREPARE_RELOAD",
+           "OP_COMMIT_RELOAD", "OP_ABORT_RELOAD", "SERVE_OP_NAMES",
            "STATUS_OK", "STATUS_REJECTED", "STATUS_DEADLINE",
            "STATUS_BAD_REQUEST", "STATUS_DRAINING", "STATUS_INTERNAL",
            "STATUS_NOT_READY"]
@@ -62,11 +74,15 @@ __all__ = ["ServeServer", "OP_INFER", "OP_HEALTH", "OP_READY", "OP_RELOAD",
 # serve opcode range: disjoint from the kvstore PS opcodes (0–9), so the
 # chaos rule table (chaos/rpc.py OP_NAMES) can address both planes
 (OP_INFER, OP_HEALTH, OP_READY, OP_RELOAD, OP_STATS, OP_DRAIN,
- OP_SHUTDOWN) = range(32, 39)
+ OP_SHUTDOWN, OP_PREPARE_RELOAD, OP_COMMIT_RELOAD,
+ OP_ABORT_RELOAD) = range(32, 42)
 
 SERVE_OP_NAMES = {OP_INFER: "infer", OP_HEALTH: "health", OP_READY: "ready",
                   OP_RELOAD: "reload", OP_STATS: "stats", OP_DRAIN: "drain",
-                  OP_SHUTDOWN: "serve_shutdown"}
+                  OP_SHUTDOWN: "serve_shutdown",
+                  OP_PREPARE_RELOAD: "prepare_reload",
+                  OP_COMMIT_RELOAD: "commit_reload",
+                  OP_ABORT_RELOAD: "abort_reload"}
 
 # single source of truth for chaos rule names: MXNET_CHAOS_RPC rules match
 # these ops the moment the serving plane is imported (the client imports
@@ -109,6 +125,14 @@ class ServeServer:
         self._default_timeout = float(default_timeout)
         self._draining = False
         self._started = time.monotonic()
+        self._shed_draining = 0  # server-level sheds (pre-batcher)
+        # two-phase reload bookkeeping: staged token + committed-token LRU
+        # (the kvstore exactly-once idiom — a retried COMMIT re-acks, never
+        # re-flips); one lock serializes prepare/commit/abort
+        self._reload_lock = threading.Lock()
+        self._staged_token = None
+        from collections import OrderedDict
+        self._committed_tokens: "OrderedDict" = OrderedDict()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -160,6 +184,23 @@ class ServeServer:
         if self._batcher is not None:
             self._batcher.close(timeout=5)
 
+    def abort(self):
+        """Crash-style stop: sever the listener and every live connection
+        WITHOUT draining queued or in-flight work — to a client this is
+        indistinguishable from the process being SIGKILLed, which is
+        exactly what the fleet tests need from an in-process replica
+        (serve/fleet.py LocalReplica.kill)."""
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in list(self._conns):
+            try:
+                c.close()
+            except OSError:
+                pass
+
     def drain(self, stop: bool = False, timeout: float = 30.0) -> bool:
         """Graceful shutdown, phase one: flip readiness off, let queued and
         in-flight requests finish, refuse new ones. ``stop=True`` closes
@@ -176,18 +217,70 @@ class ServeServer:
     def reload(self, path: str, epoch: Optional[int] = None,
                prefix: str = "ckpt") -> int:
         """Hot-swap parameters from a newer on-disk artifact (same graph).
-        In-flight requests keep the generation they started with."""
+        In-flight requests keep the generation they started with.
+        Serialized against the two-phase prepare/commit path so a legacy
+        RELOAD can't interleave with a fleet flip."""
         if self._engine is None:
             raise ServeError("no engine loaded")
         from . import load_params
 
         arg, aux = load_params(path, epoch=epoch, prefix=prefix)
-        return self._engine.reload(arg, aux)
+        with self._reload_lock:
+            return self._engine.reload(arg, aux)
+
+    def prepare_reload(self, path: str, epoch: Optional[int] = None,
+                       prefix: str = "ckpt", *,
+                       version: Optional[int] = None, token=None) -> int:
+        """Phase one of the fleet-atomic reload: load, validate, and stage
+        the new generation without flipping (all fallible work happens
+        here; the commit left is a pure pointer swap)."""
+        if self._engine is None:
+            raise ServeError("no engine loaded")
+        from . import load_params
+
+        arg, aux = load_params(path, epoch=epoch, prefix=prefix)
+        with self._reload_lock:
+            staged = self._engine.prepare_reload(arg, aux, version=version)
+            self._staged_token = tuple(token) if token is not None else None
+        return staged
+
+    def commit_reload(self, token=None) -> int:
+        """Phase two: flip the staged generation. Exactly-once under
+        retries — a token seen in the committed LRU re-acks with the
+        version it flipped to, without flipping again."""
+        if self._engine is None:
+            raise ServeError("no engine loaded")
+        tok = tuple(token) if token is not None else None
+        with self._reload_lock:
+            if tok is not None and tok in self._committed_tokens:
+                return self._committed_tokens[tok]  # retried frame: re-ack
+            if tok is not None and self._staged_token not in (None, tok):
+                raise ServeError(
+                    f"commit token {tok} does not match staged "
+                    f"{self._staged_token}")
+            version = self._engine.commit_reload()
+            self._staged_token = None
+            if tok is not None:
+                self._committed_tokens[tok] = version
+                while len(self._committed_tokens) > 4096:
+                    self._committed_tokens.popitem(last=False)
+        return version
+
+    def abort_reload(self, token=None) -> None:
+        """Discard a staged generation (idempotent rollback)."""
+        if self._engine is None:
+            return
+        tok = tuple(token) if token is not None else None
+        with self._reload_lock:
+            if tok is None or self._staged_token in (None, tok):
+                self._engine.abort_reload()
+                self._staged_token = None
 
     def stats(self) -> dict:
         out = {"uptime_seconds": round(time.monotonic() - self._started, 3),
                "draining": self._draining,
                "connections": len(self._conns),
+               "sheds": {"draining": self._shed_draining},
                "pid": os.getpid()}
         if self._engine is not None:
             out["engine"] = self._engine.stats()
@@ -242,13 +335,28 @@ class ServeServer:
             # liveness only: answering at all is the signal
             self._reply(conn, OP_HEALTH, struct.pack("<B", STATUS_OK))
         elif opcode == OP_READY:
-            if self._engine is None or self._batcher is None:
+            # the fleet front (serve/fleet.py FleetServer) has no engine:
+            # the Router IS the batcher, and its ready() gates on live
+            # replicas instead of a loaded model
+            if self._batcher is None or (
+                    self._engine is None
+                    and not hasattr(self._batcher, "ready")):
                 status = STATUS_NOT_READY
             elif self._draining:
                 status = STATUS_DRAINING
+            elif self._engine is None and not self._batcher.ready():
+                status = STATUS_NOT_READY
             else:
                 status = STATUS_OK
-            self._reply(conn, OP_READY, struct.pack("<B", status))
+            # the serving param version rides along (u32 appended — old
+            # clients read byte 0 only), so a fleet router can gate a
+            # replica on version coherence from one probe
+            if self._engine is not None:
+                version = self._engine.version
+            else:
+                version = int(getattr(self._batcher, "version", 0) or 0)
+            self._reply(conn, OP_READY,
+                        struct.pack("<BI", status, version))
         elif opcode == OP_RELOAD:
             try:
                 spec = json.loads(bytes(payload).decode("utf-8"))
@@ -261,6 +369,36 @@ class ServeServer:
                 obs.inc("serve.reload_errors")
                 self._reply(conn, OP_RELOAD, _err_payload(
                     STATUS_INTERNAL, f"{type(e).__name__}: {e}"))
+        elif opcode == OP_PREPARE_RELOAD:
+            try:
+                spec = json.loads(bytes(payload).decode("utf-8"))
+                staged = self.prepare_reload(
+                    spec["path"], epoch=spec.get("epoch"),
+                    prefix=spec.get("prefix", "ckpt"),
+                    version=spec.get("version"), token=spec.get("token"))
+                self._reply(conn, OP_PREPARE_RELOAD,
+                            struct.pack("<BI", STATUS_OK, staged))
+            except Exception as e:  # noqa: BLE001 — wire-reported
+                obs.inc("serve.reload_errors")
+                self._reply(conn, OP_PREPARE_RELOAD, _err_payload(
+                    STATUS_INTERNAL, f"{type(e).__name__}: {e}"))
+        elif opcode == OP_COMMIT_RELOAD:
+            try:
+                token = struct.unpack_from("<QQ", payload, 0) \
+                    if len(payload) >= 16 else None
+                kill_point("serve:pre_commit")  # chaos: die mid-phase-2
+                version = self.commit_reload(token)
+                self._reply(conn, OP_COMMIT_RELOAD,
+                            struct.pack("<BI", STATUS_OK, version))
+            except Exception as e:  # noqa: BLE001 — wire-reported
+                obs.inc("serve.reload_errors")
+                self._reply(conn, OP_COMMIT_RELOAD, _err_payload(
+                    STATUS_INTERNAL, f"{type(e).__name__}: {e}"))
+        elif opcode == OP_ABORT_RELOAD:
+            token = struct.unpack_from("<QQ", payload, 0) \
+                if len(payload) >= 16 else None
+            self.abort_reload(token)
+            self._reply(conn, OP_ABORT_RELOAD, struct.pack("<B", STATUS_OK))
         elif opcode == OP_STATS:
             blob = json.dumps(self.stats(), default=str).encode("utf-8")
             self._reply(conn, OP_STATS, struct.pack("<B", STATUS_OK) + blob)
@@ -283,9 +421,10 @@ class ServeServer:
         return True
 
     def _do_infer(self, payload) -> bytes:
-        if self._engine is None or self._batcher is None:
+        if self._batcher is None:
             return _err_payload(STATUS_NOT_READY, "no model loaded")
         if self._draining:
+            self._shed_draining += 1
             obs.inc("serve.shed_draining")
             return _err_payload(STATUS_DRAINING, "endpoint draining")
         try:
@@ -318,8 +457,13 @@ class ServeServer:
         except ServeError as e:
             return _err_payload(STATUS_INTERNAL, str(e))
         with obs.trace.span("serve.serialize", outputs=len(outs)):
-            return (struct.pack("<BI", STATUS_OK, version)
-                    + _pack_arrays([np.ascontiguousarray(o) for o in outs]))
+            reply = (struct.pack("<BI", STATUS_OK, version)
+                     + _pack_arrays([np.ascontiguousarray(o) for o in outs]))
+        # chaos: die with the answer computed but unsent — the INFER-specific
+        # twin of serve:pre_reply (which also fires on probe replies, so a
+        # fleet test could never target "kill mid-INFER-reply" with it)
+        kill_point("serve:infer_pre_reply")
+        return reply
 
 
 def main():  # pragma: no cover - CLI shim

@@ -1,0 +1,552 @@
+"""Serving-fleet suite (``pytest -m serve`` / ``make chaos-serve``) —
+docs/ROBUSTNESS.md "Serving fleet".
+
+Covers the serve/fleet.py contracts:
+
+1. circuit breaker — consecutive-failure trip, open rejection, half-open
+   probe recovery;
+2. router — failover on replica death (the request succeeds, the client
+   never sees the corpse), tail-latency hedging with first-reply-wins;
+3. pool — death detection, restart with capped backoff, readiness
+   recovery, restarts rejoin at the committed fleet version;
+4. fleet-atomic reload — two-phase prepare/commit under concurrent
+   traffic: versions flip monotonically (old-then-new, never interleaved),
+   outputs always match their reply's version, prepare failure rolls back
+   everywhere, commit tokens are exactly-once, and a replica killed during
+   phase two cannot reintroduce a stale generation;
+5. the FleetServer front — one wire endpoint whose STATS exposes
+   per-replica breaker/failover state;
+6. (subprocess, chaos) kill-mid-INFER-reply → the client fails over within
+   its deadline; flagship (slow): 3-replica SIGKILL under mixed-shape
+   open-loop load with zero lost requests and bitwise outputs.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, serve
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import capped_backoff
+from mxnet_tpu.model import save_checkpoint
+from mxnet_tpu.serve import (DeadlineExceeded, DynamicBatcher, Draining,
+                             RequestRejected, ServeClient, ServeError,
+                             ServeServer)
+from mxnet_tpu.serve.fleet import (CircuitBreaker, FleetServer, LocalReplica,
+                                   ProcReplica, ReplicaPool, Router)
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _save_linear_ckpt(tmpdir, scales=(1.0,)):
+    """Checkpoint per scale: y = x @ (scale·I) — output provenance is
+    decidable per reply (which generation computed this?)."""
+    prefix = os.path.join(str(tmpdir), "lin")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+    for epoch, scale in enumerate(scales):
+        save_checkpoint(prefix, epoch, net,
+                        {"fc_weight": nd.array(
+                            np.eye(4, dtype=np.float32) * scale)}, {})
+    return prefix
+
+
+def _linear_factory(scale=1.0, delay=0.0):
+    def factory():
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+        arg = {"fc_weight": np.eye(4, dtype=np.float32) * scale}
+        engine = serve.InferenceEngine(net, arg, max_batch_size=8,
+                                       lint="off")
+        if delay:
+            real = engine.infer
+
+            def slow_infer(inputs, n_valid=None):
+                time.sleep(delay)
+                return real(inputs, n_valid=n_valid)
+
+            engine.infer = slow_infer
+        srv = ServeServer(engine, port=0, max_linger_ms=0.0)
+        srv.start()
+        return srv
+    return factory
+
+
+def _ckpt_factory(prefix, epoch=0):
+    def factory():
+        engine = serve.load(prefix, epoch=epoch, max_batch_size=8,
+                            lint="off")
+        srv = ServeServer(engine, port=0, max_linger_ms=0.0)
+        srv.start()
+        return srv
+    return factory
+
+
+def _local_pool(n=2, scale=1.0, **kw):
+    kw.setdefault("probe_interval", 0.1)
+    kw.setdefault("backoff_base", 0.05)
+    kw.setdefault("backoff_cap", 0.5)
+    kw.setdefault("ready_timeout", 60.0)
+    return ReplicaPool.local(_linear_factory(scale), n, **kw).start()
+
+
+X = np.arange(8, dtype=np.float32).reshape(2, 4)
+
+
+# ---------------------------------------------------------------------------
+# 1. circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_trip_and_halfopen_recovery():
+    br = CircuitBreaker(threshold=3, cooldown=0.1)
+    assert br.state == "closed" and br.allow()
+    assert not br.failure() and not br.failure()
+    assert br.failure()  # third consecutive failure trips it
+    assert br.state == "open" and br.trips == 1
+    assert not br.allow()  # open: requests skip this replica
+    time.sleep(0.12)
+    assert br.allow()        # half-open admits exactly one probe
+    assert not br.allow()    # ... and only one
+    assert br.failure()      # failed probe re-opens (counts a trip)
+    assert br.state == "open"
+    time.sleep(0.12)
+    assert br.allow()
+    br.success()             # successful probe closes it
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_shed_replies_reset_streak():
+    br = CircuitBreaker(threshold=2, cooldown=1.0)
+    br.failure()
+    br.success()  # an answering replica resets the consecutive count
+    assert not br.failure()
+    assert br.state in ("closed",)
+
+
+def test_capped_backoff_bounds():
+    for attempt in range(8):
+        d = capped_backoff(attempt, 0.2, 2.0)
+        cap = min(2.0, 0.2 * 2 ** attempt)
+        assert cap / 2 <= d <= cap  # jitter in [0.5, 1.0]×
+    # two fleets of draws must not be identical (jitter present)
+    draws = {round(capped_backoff(3, 0.2, 2.0), 6) for _ in range(16)}
+    assert len(draws) > 1
+
+
+# ---------------------------------------------------------------------------
+# 2/3. router failover + pool supervision
+# ---------------------------------------------------------------------------
+
+def test_failover_on_replica_death_and_pool_restart():
+    # probe interval slow enough that the corpse is still listed "ready"
+    # when the next requests arrive — the router, not the supervisor, must
+    # absorb the death
+    pool = _local_pool(2, probe_interval=2.0)
+    try:
+        router = Router(pool, breaker_cooldown=0.2)
+        outs, ver = router.infer([X])
+        np.testing.assert_array_equal(outs[0], X)
+        pool.kill(0)
+        # every request keeps succeeding through the survivor
+        for _ in range(4):
+            outs, _ = router.infer([X], deadline_ms=5000)
+            np.testing.assert_array_equal(outs[0], X)
+        assert router.failovers >= 1
+        # the supervisor notices, restarts the corpse, readiness recovers
+        m0 = pool.members()[0]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not (
+                m0.restarts >= 1 and m0.state == "ready"):
+            time.sleep(0.1)
+        assert m0.restarts >= 1 and m0.state == "ready"
+        assert len(pool.ready_members()) == 2
+        # restarted replica serves again (breaker recovers via its probe)
+        for _ in range(6):
+            outs, _ = router.infer([X])
+            np.testing.assert_array_equal(outs[0], X)
+        assert pool.members()[0].state == "ready"
+    finally:
+        pool.stop()
+
+
+def test_breaker_trips_after_consecutive_failures():
+    pool = _local_pool(2, backoff_base=5.0, backoff_cap=5.0,
+                       probe_interval=5.0)  # restart far away: corpse stays
+    try:
+        router = Router(pool, breaker_threshold=2, breaker_cooldown=30.0)
+        pool.kill(1)
+        # the dead replica eats consecutive failures until its breaker
+        # opens; afterwards requests skip it without paying a connect
+        for _ in range(6):
+            router.infer([X], deadline_ms=5000)
+        snap = router.stats()["replicas"]["1"]["breaker"]
+        assert snap["state"] == "open"
+        assert router.stats()["breaker_trips"] >= 1
+    finally:
+        pool.stop()
+
+
+def test_hedging_slow_primary_fast_secondary():
+    replicas = [LocalReplica(_linear_factory(delay=0.8)),
+                LocalReplica(_linear_factory())]
+    pool = ReplicaPool(replicas, probe_interval=0.2,
+                       ready_timeout=60).start()
+    try:
+        router = Router(pool, hedge_ms=60.0)
+        # pin the rotation so the SLOW replica is the primary
+        router._rr = 0
+        t0 = time.monotonic()
+        outs, _ = router.infer([X], deadline_ms=10000)
+        dt = time.monotonic() - t0
+        np.testing.assert_array_equal(outs[0], X)
+        assert router.hedges == 1
+        assert router.hedge_wins == 1  # the fast secondary answered first
+        assert dt < 0.8  # did NOT wait out the slow primary
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. fleet-atomic two-phase reload
+# ---------------------------------------------------------------------------
+
+def _assert_version_coherent(seen):
+    """Every reply's output must match its version's generation (scale =
+    1 + version here), and versions must flip monotonically: old, old, …,
+    new, new — one interleaving is a broken fleet."""
+    for ver, scale in seen:
+        assert np.isclose(scale, 1.0 + ver), (ver, scale)
+    vers = [v for v, _ in seen]
+    if 1 in vers:
+        first = vers.index(1)
+        assert all(v == 1 for v in vers[first:]), "mixed-version serving!"
+
+
+def test_fleet_reload_atomic_under_concurrent_traffic(tmp_path):
+    prefix = _save_linear_ckpt(tmp_path, scales=(1.0, 2.0))
+    pool = ReplicaPool.local(_ckpt_factory(prefix, epoch=0), 3,
+                             probe_interval=0.1, ready_timeout=60).start()
+    try:
+        router = Router(pool)
+        one = np.ones((1, 4), np.float32)
+        stop = threading.Event()
+        seen, errors = [], []
+
+        def load():
+            while not stop.is_set():
+                try:
+                    outs, ver = router.infer([one], deadline_ms=3000)
+                except ServeError as e:  # noqa: PERF203 — collecting
+                    errors.append(repr(e))
+                    continue
+                seen.append((ver, float(outs[0][0, 0])))
+
+        threads = [threading.Thread(target=load) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        new_version = router.reload(prefix, epoch=1)
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert new_version == 1 == router.version
+        assert len(seen) > 20
+        assert not errors, errors[:3]
+        _assert_version_coherent(seen)
+        # every replica committed
+        assert all(m.version == 1 for m in pool.ready_members())
+    finally:
+        pool.stop()
+
+
+def test_fleet_reload_prepare_failure_rolls_back(tmp_path):
+    prefix = _save_linear_ckpt(tmp_path, scales=(1.0,))
+    pool = ReplicaPool.local(_ckpt_factory(prefix, epoch=0), 2,
+                             probe_interval=0.2, ready_timeout=60).start()
+    try:
+        router = Router(pool)
+        with pytest.raises(ServeError, match="prepare failed"):
+            router.reload(os.path.join(str(tmp_path), "nope"))
+        assert router.version == 0
+        outs, ver = router.infer([X])
+        assert ver == 0
+        np.testing.assert_array_equal(outs[0], X)
+        # nothing left staged on any replica
+        for m in pool.ready_members():
+            cli = ServeClient(*m.addr)
+            assert cli.stats()["engine"]["staged_version"] is None
+            cli.close()
+    finally:
+        pool.stop()
+
+
+def test_commit_token_exactly_once(tmp_path):
+    prefix = _save_linear_ckpt(tmp_path, scales=(1.0, 2.0))
+    srv = _ckpt_factory(prefix, epoch=0)()
+    try:
+        cli = ServeClient("127.0.0.1", srv.port)
+        token = (77, 1)
+        staged = cli.prepare_reload(prefix, epoch=1, version=5, token=token)
+        assert staged == 5
+        assert cli.commit_reload(token) == 5
+        # retried commit (lost ack): re-acks from the LRU, no double flip
+        assert cli.commit_reload(token) == 5
+        with pytest.raises(ServeError):
+            cli.commit_reload((77, 2))  # unknown token, nothing staged
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_kill_during_phase2_no_mixed_versions(tmp_path):
+    """Chaos: one replica dies BETWEEN its peers' commits (the worst
+    instant). The dead replica serves nothing, the reload completes, the
+    pool restarts the corpse onto the committed target — and no reply ever
+    carries the stale generation."""
+    prefix = _save_linear_ckpt(tmp_path, scales=(1.0, 2.0))
+    pool = ReplicaPool.local(_ckpt_factory(prefix, epoch=0), 3,
+                             probe_interval=0.1, backoff_base=0.05,
+                             backoff_cap=0.5, ready_timeout=60).start()
+    try:
+        router = Router(pool)
+        victim = pool.members()[1]
+        fired = []
+
+        def kill_mid_commit(member):
+            if member is victim and not fired:
+                fired.append(True)
+                pool.kill(victim.idx)  # SIGKILL-equivalent mid-phase-2
+
+        router._commit_hook = kill_mid_commit
+        new_version = router.reload(prefix, epoch=1)
+        assert new_version == 1 and fired
+        # from the flip on, EVERY reply is the new generation
+        for _ in range(12):
+            outs, ver = router.infer([np.ones((1, 4), np.float32)],
+                                     deadline_ms=5000)
+            assert ver == 1
+            assert np.isclose(float(outs[0][0, 0]), 2.0)
+        # the corpse rejoins AT THE COMMITTED VERSION (resynced from the
+        # pool target before readiness), then serves the new generation
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if victim.state == "ready" and victim.version == 1:
+                break
+            time.sleep(0.1)
+        assert victim.state == "ready" and victim.version == 1
+        for _ in range(8):
+            outs, ver = router.infer([np.ones((1, 4), np.float32)])
+            assert ver == 1 and np.isclose(float(outs[0][0, 0]), 2.0)
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. FleetServer front + STATS
+# ---------------------------------------------------------------------------
+
+def test_fleet_server_front_stats_and_ready():
+    pool = _local_pool(2)
+    front = None
+    try:
+        router = Router(pool, hedge_ms=250.0)
+        front = FleetServer(router, port=0)
+        front.start()
+        cli = ServeClient("127.0.0.1", front.port)
+        res = cli.infer(X)
+        np.testing.assert_array_equal(res, X)
+        ok, ver = cli.ready_version()
+        assert ok and ver == 0
+        st = cli.stats()
+        fleet = st["batcher"]  # the router mounts the batcher slot
+        assert fleet["ready_replicas"] == 2
+        assert fleet["fleet_version"] == 0
+        assert set(fleet["replicas"]) == {"0", "1"}
+        for rep in fleet["replicas"].values():
+            assert rep["state"] == "ready"
+            assert rep["breaker"]["state"] == "closed"
+        # kill one: the front keeps answering, STATS shows the death
+        pool.kill(1)
+        res = cli.infer(X, deadline_ms=5000)
+        np.testing.assert_array_equal(res, X)
+        st = cli.stats()["batcher"]
+        assert st["failovers"] >= 1
+        cli.close()
+    finally:
+        if front is not None:
+            front.stop()
+        pool.stop()
+
+
+def test_shed_by_reason_counters():
+    class SlowEngine:
+        max_batch_size = 4
+        buckets = [1, 2, 4]
+
+        def infer(self, inputs, n_valid=None):
+            time.sleep(0.2)
+            return [np.asarray(inputs[0]) * 2.0], 0
+
+    b = DynamicBatcher(SlowEngine(), max_queue=1, max_linger_ms=0.0)
+    try:
+        one = np.ones((1, 4), np.float32)
+        with pytest.raises(DeadlineExceeded):
+            b.submit(one, deadline_ms=1e-9)  # dead on arrival
+        b.submit(one)          # occupies the worker
+        time.sleep(0.05)
+        b.submit(one)          # fills the queue (watermark 1)
+        with pytest.raises(RequestRejected):
+            b.submit(one)      # over watermark
+        b.drain(timeout=10)
+        with pytest.raises(Draining):
+            b.submit(one)
+        reasons = b.stats()["shed_by_reason"]
+        assert reasons["deadline"] >= 1
+        assert reasons["queue_full"] >= 1
+        assert reasons["draining"] >= 1
+    finally:
+        b.close(timeout=5)
+
+
+def test_client_lazy_connect_is_nonfatal():
+    """A fleet of clients constructed against a restarting replica must not
+    crash in __init__ — the first RPC dials inside the jittered retry loop
+    (lockstep-reconnect satellite)."""
+    cli = ServeClient("127.0.0.1", 1, timeout=0.5, retries=2,
+                      retry_interval=0.01)
+    assert not cli.health()  # fails cleanly, after backoff, not at init
+    cli.close()
+
+
+# ---------------------------------------------------------------------------
+# 6. subprocess chaos
+# ---------------------------------------------------------------------------
+
+def _proc_env():
+    env = {"MXNET_SERVE_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}
+    return env
+
+
+@pytest.mark.chaos
+def test_kill_mid_infer_reply_fails_over_within_deadline(tmp_path):
+    """Satellite: SIGKILL a replica AFTER it computed an answer but BEFORE
+    the reply frame (serve:infer_pre_reply) — the client's request still
+    succeeds within its deadline via failover, and the pool restarts the
+    corpse."""
+    prefix = _save_linear_ckpt(tmp_path, scales=(1.0,))
+    env = _proc_env()
+    env["MXNET_CHAOS_KILL_REPLICA0"] = "serve:infer_pre_reply@1"
+    replicas = [ProcReplica(prefix, env=env),
+                LocalReplica(_ckpt_factory(prefix, epoch=0))]
+    pool = ReplicaPool(replicas, probe_interval=0.2, backoff_base=0.1,
+                       backoff_cap=1.0, ready_timeout=120).start()
+    try:
+        router = Router(pool, breaker_cooldown=0.3)
+        t0 = time.monotonic()
+        deadline_ms = 20000.0
+        for _ in range(6):
+            outs, _ = router.infer([X], deadline_ms=deadline_ms)
+            np.testing.assert_array_equal(outs[0], X)
+        assert (time.monotonic() - t0) * 1e3 < deadline_ms
+        assert router.failovers >= 1  # the mid-reply kill was absorbed
+        # the killed subprocess comes back
+        deadline = time.monotonic() + 90
+        while len(pool.ready_members()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert len(pool.ready_members()) == 2
+        assert pool.members()[0].restarts >= 1
+    finally:
+        pool.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_flagship_fleet_sigkill_under_load_zero_lost(tmp_path):
+    """Flagship: 3 subprocess replicas behind a FleetServer under
+    concurrent mixed-shape load; SIGKILL one replica mid-run → zero
+    accepted requests lost (every request either succeeds or sheds
+    cleanly — no hard error reaches a client), outputs stay bitwise equal
+    to the engine's own predict, the pool restarts the corpse and
+    readiness recovers; a fleet reload under the same load is
+    version-atomic."""
+    prefix = _save_linear_ckpt(tmp_path, scales=(3.0,))
+    pool = ReplicaPool.spawn(prefix, 3, env=_proc_env(),
+                             probe_interval=0.2, backoff_base=0.1,
+                             backoff_cap=1.0, ready_timeout=180).start()
+    front = None
+    try:
+        router = Router(pool, breaker_cooldown=0.3)
+        front = FleetServer(router, port=0)
+        front.start()
+        addr = ("127.0.0.1", front.port)
+        rng = np.random.RandomState(0)
+        shapes = [rng.rand(n, 4).astype(np.float32) for n in (1, 2, 5, 8)]
+
+        stop = threading.Event()
+        lost, ok, shed, timeline = [], [], [], []
+
+        def load(worker):
+            cli = ServeClient(*addr)
+            i = 0
+            while not stop.is_set():
+                x = shapes[(worker + i) % len(shapes)]
+                i += 1
+                try:
+                    out, ver = cli.infer(x, deadline_ms=10000,
+                                         return_version=True)
+                except (RequestRejected, Draining, DeadlineExceeded):
+                    shed.append(1)  # clean, designed degradation
+                except ServeError as e:
+                    lost.append(repr(e))  # a hard error IS a lost request
+                else:
+                    # bitwise: y = scale·x exactly, scale keyed by the
+                    # reply's OWN version (v0 ckpt = 3·I, v1 ckpt = 4·I)
+                    if not np.array_equal(out, x * (3.0 + ver)):
+                        lost.append(f"wrong bits v{ver}")
+                    ok.append(1)
+                    timeline.append((time.monotonic(), ver))
+            cli.close()
+
+        workers = [threading.Thread(target=load, args=(w,))
+                   for w in range(4)]
+        for t in workers:
+            t.start()
+        time.sleep(1.5)
+        pool.kill(0)  # real SIGKILL mid-run
+        m0 = pool.members()[0]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and not (
+                m0.restarts >= 1 and m0.state == "ready"):
+            time.sleep(0.3)
+        time.sleep(0.5)
+        # fleet reload UNDER the same load: publish a new generation and
+        # two-phase flip the whole fleet through the front's RELOAD RPC
+        _save_linear_ckpt(tmp_path, scales=(3.0, 4.0))
+        ctl = ServeClient(*addr)
+        assert ctl.reload(prefix, epoch=1) == 1
+        ctl.close()
+        time.sleep(0.8)
+        stop.set()
+        for t in workers:
+            t.join()
+        assert not lost, lost[:5]
+        assert len(ok) > 50
+        assert len(pool.ready_members()) == 3  # readiness recovered
+        assert pool.members()[0].restarts >= 1
+        assert router.failovers >= 1
+        # version-atomic: ordered by completion time, versions are
+        # old…old, new…new — the two-phase flip never interleaves
+        vers = [v for _, v in sorted(timeline)]
+        assert vers[-1] == 1  # the flip happened under load
+        first_new = vers.index(1)
+        assert all(v == 1 for v in vers[first_new:]), "mixed versions!"
+    finally:
+        if front is not None:
+            front.stop()
+        pool.stop()

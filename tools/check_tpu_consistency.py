@@ -772,7 +772,13 @@ def main(argv=None):
     from mxnet_tpu.test_utils import check_consistency
 
     if not args.self_check:
-        platforms = {d.platform for d in jax.devices()}
+        from mxnet_tpu import platform as mxplatform
+
+        # watchdogged enumeration: a dead tunnel yields the parseable
+        # platform-error artifact in bounded time, not a hung sweep
+        devs = mxplatform.devices_or_exit(
+            what="tools/check_tpu_consistency.py")
+        platforms = {d.platform for d in devs}
         if not platforms & {"tpu", "axon"}:
             print("no TPU visible — nothing to cross-check")
             return 0

@@ -21,9 +21,11 @@ from tools._chiptime import slope_time  # noqa: E402
 
 
 def main():
+    from mxnet_tpu import platform as mxplatform
     from mxnet_tpu.ops.flash_attention import flash_attention
     from mxnet_tpu.ops.attention import plain_attention
 
+    mxplatform.devices_or_exit(what="tools/profile_lm.py")
     B = int(os.environ.get("PROF_B", 4))
     S = int(os.environ.get("PROF_S", 2048))
     H, D = 12, 64

@@ -86,6 +86,9 @@ def build_lm(impl, seq=2048, batch=4):
 
 
 def main():
+    from mxnet_tpu import platform as mxplatform
+
+    mxplatform.devices_or_exit(what="tools/profile_lm_step.py")
     out = {}
     seq = int(os.environ.get("PROF_SEQ", 2048))
     batch = int(os.environ.get("PROF_BATCH", 4))

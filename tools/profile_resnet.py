@@ -25,8 +25,10 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu import nd
     from mxnet_tpu import parallel as par
+    from mxnet_tpu import platform as mxplatform
     from mxnet_tpu.gluon.model_zoo import get_model
 
+    mxplatform.devices_or_exit(what="tools/profile_resnet.py")
     batch = int(os.environ.get("PROF_BATCH", 64))
     size = int(os.environ.get("PROF_SIZE", 224))
     out = {"batch": batch, "size": size}

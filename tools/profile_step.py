@@ -107,6 +107,9 @@ def main(argv=None):
                          "(with the metrics snapshot) as chrome-trace JSON "
                          "— view in Perfetto or tools/trace_report.py")
     args = ap.parse_args(argv)
+    from mxnet_tpu import platform as mxplatform
+
+    mxplatform.devices_or_exit(what="tools/profile_step.py")
     if args.trace_out:
         from mxnet_tpu import obs
 

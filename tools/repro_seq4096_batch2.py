@@ -22,6 +22,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
+    from mxnet_tpu import platform as mxplatform
+
+    mxplatform.devices_or_exit(what="tools/repro_seq4096_batch2.py")
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 2
     seq = int(os.environ.get("REPRO_SEQ", 4096))
 

@@ -17,6 +17,15 @@ Reports p50/p95/p99 latency, achieved throughput vs offered load, shed
 rate, and the engine's compiled-program count (the bucketing bound), as a
 table and one JSON line (``--json``). ``bench.py`` imports ``run_bench``
 for the ``serve_qps`` / ``serve_p99_ms`` headline gains.
+
+**Chaos mode** (``--chaos``, ``make chaos-serve``): the same open-loop
+Poisson load is driven through a supervised replica fleet
+(``serve/fleet.py``: pool + failover router + one socket front), one
+replica is hard-killed a third of the way in, and the pool restarts it.
+The report buckets every request into before / during / after windows
+around the kill→recovery interval and prints error rate and p50/p99 per
+window — degradation under replica death is a measured number, not a
+claim.
 """
 from __future__ import annotations
 
@@ -201,6 +210,141 @@ def run_bench(model="mlp", mode="closed", duration=5.0, clients=4, qps=200.0,
     return out
 
 
+def run_chaos_bench(model="mlp", duration=12.0, qps=120.0, replicas=3,
+                    max_batch_size=8, max_linger_ms=2.0, deadline_ms=500.0,
+                    request_rows=1, hedge_ms=None, kill_replica=0):
+    """Availability under replica death, measured: open-loop Poisson load
+    through a FleetServer front over ``replicas`` supervised in-process
+    replicas; at duration/3 one replica is hard-killed (crash-equivalent:
+    its sockets sever mid-work); the pool restarts it with backoff. Every
+    request is timestamped and bucketed into before / during (kill →
+    readiness recovered) / after windows. Returns the result dict."""
+    from mxnet_tpu import serve
+    from mxnet_tpu.serve.fleet import FleetServer, ReplicaPool, Router
+
+    net, arg, aux, feat = _build_model(model)
+
+    def factory():
+        engine = serve.InferenceEngine(net, arg, aux,
+                                       max_batch_size=max_batch_size,
+                                       lint="off")
+        engine.warmup(feat)
+        srv = serve.ServeServer(engine, port=0,
+                                max_linger_ms=max_linger_ms)
+        srv.start()
+        return srv
+
+    pool = ReplicaPool.local(factory, replicas, probe_interval=0.15,
+                             backoff_base=0.1, backoff_cap=1.0)
+    pool.start()
+    router = Router(pool, hedge_ms=hedge_ms, breaker_cooldown=0.3)
+    front = FleetServer(router, port=0)
+    front.start()
+    addr = ("127.0.0.1", front.port)
+
+    rng = np.random.RandomState(1)
+    payload = rng.rand(request_rows, *feat).astype(np.float32)
+    lock = threading.Lock()
+    records = []  # (t_sent, outcome, latency)
+    pool_clients = [serve.ServeClient(*addr) for _ in range(8)]
+    free = list(range(len(pool_clients)))
+
+    def fire(idx, t_sent):
+        t0 = time.perf_counter()
+        try:
+            pool_clients[idx].infer(payload, deadline_ms=deadline_ms)
+            outcome = "ok"
+        except (serve.RequestRejected, serve.Draining):
+            outcome = "shed"
+        except serve.DeadlineExceeded:
+            outcome = "deadline"
+        except serve.ServeError:
+            outcome = "error"
+        with lock:
+            records.append((t_sent, outcome, time.perf_counter() - t0))
+            free.append(idx)
+
+    t_start = time.perf_counter()
+    kill_at = t_start + duration / 3.0
+    t_kill = [None]
+    t_recovered = [None]
+    killed = [False]
+    dipped = [False]  # readiness must visibly drop before "recovered"
+    inflight = []
+    while time.perf_counter() < t_start + duration:
+        now = time.perf_counter()
+        if not killed[0] and now >= kill_at:
+            pool.kill(kill_replica)
+            t_kill[0] = now
+            killed[0] = True
+        if killed[0] and t_recovered[0] is None:
+            ready = len(pool.ready_members())
+            if ready < replicas:
+                dipped[0] = True
+            elif dipped[0]:
+                t_recovered[0] = now
+        time.sleep(rng.exponential(1.0 / qps))
+        with lock:
+            if free:
+                idx = free.pop()
+            else:
+                pool_clients.append(serve.ServeClient(*addr))
+                free_idx = len(pool_clients) - 1
+                idx = free_idx
+        th = threading.Thread(target=fire,
+                              args=(idx, time.perf_counter() - t_start))
+        th.start()
+        inflight.append(th)
+    for th in inflight:
+        th.join(timeout=30)
+    if killed[0] and t_recovered[0] is None and dipped[0] \
+            and len(pool.ready_members()) >= replicas:
+        t_recovered[0] = time.perf_counter()
+    fleet_stats = router.stats()
+    front.stop()
+    pool.stop()
+    for cli in pool_clients:
+        cli.close()
+
+    kill_off = (t_kill[0] - t_start) if t_kill[0] else None
+    rec_off = (t_recovered[0] - t_start) if t_recovered[0] else None
+
+    def window(name, lo, hi):
+        rows = [r for r in records if lo <= r[0] < hi]
+        lat = sorted(r[2] for r in rows if r[1] == "ok")
+        n = len(rows)
+        bad = sum(1 for r in rows if r[1] == "error")
+        shed = sum(1 for r in rows if r[1] in ("shed", "deadline"))
+        return {"window": name, "sent": n, "ok": len(lat), "shed": shed,
+                "errors": bad,
+                "error_rate": round(bad / n, 4) if n else None,
+                "p50_ms": round(_percentile(lat, 0.5) * 1e3, 2) if lat
+                else None,
+                "p99_ms": round(_percentile(lat, 0.99) * 1e3, 2) if lat
+                else None}
+
+    end = duration + 1e9
+    out = {
+        "mode": "chaos", "model": model, "replicas": replicas,
+        "offered_qps": qps, "duration_s": duration,
+        "deadline_ms": deadline_ms, "hedge_ms": hedge_ms,
+        "kill_at_s": round(kill_off, 2) if kill_off else None,
+        "recovered_at_s": round(rec_off, 2) if rec_off else None,
+        "recovery_s": round(rec_off - kill_off, 2)
+        if (kill_off and rec_off) else None,
+        "windows": [window("before", 0.0, kill_off or end),
+                    window("during", kill_off or end, rec_off or end),
+                    window("after", rec_off or end, end)],
+        "failovers": fleet_stats["failovers"],
+        "breaker_trips": fleet_stats["breaker_trips"],
+        "hedges": fleet_stats["hedges"],
+        "restarts": sum(r["restarts"]
+                        for r in fleet_stats["replicas"].values()),
+        "lost": sum(1 for r in records if r[1] == "error"),
+    }
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="closed/open-loop load generator for mxnet_tpu.serve")
@@ -221,7 +365,34 @@ def main(argv=None):
                          "in-process server)")
     ap.add_argument("--json", action="store_true",
                     help="one JSON line per mode instead of the table")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fleet availability bench: open-loop load over a "
+                         "supervised replica fleet, hard-kill one replica "
+                         "mid-run, report error rate + p99 before/during/"
+                         "after (always prints JSON)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="fleet size for --chaos")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="fleet tail-latency hedge threshold for --chaos")
     args = ap.parse_args(argv)
+
+    if not args.connect:
+        # building an in-process engine touches the device; a dead tunnel
+        # must cost one watchdog budget + one parseable artifact
+        from mxnet_tpu import platform as mxplatform
+
+        mxplatform.devices_or_exit(what="tools/serve_bench.py")
+
+    if args.chaos:
+        res = run_chaos_bench(model=args.model, duration=args.duration,
+                              qps=args.qps, replicas=args.replicas,
+                              max_batch_size=args.max_batch_size,
+                              max_linger_ms=args.max_linger_ms,
+                              deadline_ms=args.deadline_ms or 500.0,
+                              request_rows=args.request_rows,
+                              hedge_ms=args.hedge_ms)
+        print(json.dumps(res, indent=1))
+        return 0
 
     modes = ("closed", "open") if args.mode == "both" else (args.mode,)
     results = []

@@ -58,6 +58,9 @@ def sweep(S, B=4, H=12, D=64, causal=True, dtype=jnp.bfloat16):
 
 
 def main():
+    from mxnet_tpu import platform as mxplatform
+
+    mxplatform.devices_or_exit(what="tools/tune_flash.py")
     seqs = [int(a) for a in sys.argv[1:]] or [1024, 2048, 4096]
     out = {}
     for S in seqs:

@@ -22,6 +22,9 @@ from tools._chiptime import chain_total  # noqa: E402
 
 
 def main():
+    from mxnet_tpu import platform as mxplatform
+
+    mxplatform.devices_or_exit(what="tools/tunnel_cost_probe.py")
     out = {}
     key = jax.random.PRNGKey(0)
 

@@ -19,7 +19,15 @@ import numpy as np
 def main():
     import jax
 
-    dev = jax.devices()[0]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu import platform as mxplatform
+
+    # guarded enumeration + guarded first-touch upload: a tunnel that hangs
+    # (or enumerates but no longer moves bytes) costs one watchdog budget
+    # and one parseable artifact, never a hung probe
+    dev = mxplatform.devices_or_exit(what="tools/wire_probe.py")[0]
+    mxplatform.device_put(np.zeros(1, np.uint8), dev)
     rng = np.random.RandomState(7)
     mb = 9.0  # ~one uint8 (64,3,224,224) batch
     nbuf = 16
