@@ -43,10 +43,15 @@ perf:
 	$(PYTHON) -m pytest tests/ -q -m perf -p no:cacheprovider
 	$(PYTHON) tools/profile_step.py --model resnet50_v1
 
-# runtime telemetry suite: span tracer, metrics registry, instrumented
-# step phases, chaos-event tagging (docs/OBSERVABILITY.md)
+# runtime telemetry suite (docs/OBSERVABILITY.md): span tracer, metrics
+# registry, instrumented step phases, chaos-event tagging, PLUS the
+# distributed plane — trace-context propagation over both wires, the
+# OP_TELEMETRY collection plane, Prometheus exposition, SLO math, and the
+# cross-process chaos flagship (2 ProcReplicas, one SIGKILLed, one merged
+# timeline); then the measured cost of leaving tracing on (sample 0.1)
 obs:
 	$(PYTHON) -m pytest tests/ -q -m obs -p no:cacheprovider
+	$(PYTHON) tools/serve_bench.py --obs-overhead --duration 4
 
 # serving suite: compiled engine program bound, SLO scheduler, endpoint
 # lifecycle + chaos degradation (docs/SERVING.md)

@@ -445,6 +445,29 @@ def bench_serve(platform):
             "buckets": res.get("buckets")}
 
 
+def bench_obs_overhead(platform):
+    """Tracing overhead on the serve path (docs/OBSERVABILITY.md): the
+    serve bench twice — telemetry off vs on at head-sampling 0.1 — and the
+    qps delta as ``obs_overhead_pct``, asserted under the 5% budget. The
+    number that justifies leaving distributed tracing on in production."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    model = os.environ.get("BENCH_SERVE_MODEL",
+                           "resnet18_v1" if platform == "tpu" else "mlp")
+    duration = float(os.environ.get("BENCH_OBS_DURATION",
+                                    6 if platform == "tpu" else 3))
+    sample = float(os.environ.get("BENCH_OBS_SAMPLE", 0.1))
+    res = serve_bench.run_obs_overhead(model=model, duration=duration,
+                                       sample=sample)
+    assert res["ok"], (
+        f"obs_overhead_pct={res['obs_overhead_pct']} >= "
+        f"{res['threshold_pct']}% at sample={sample} — tracing is too "
+        f"expensive to leave on (qps {res['qps_off']} -> {res['qps_on']})")
+    return res
+
+
 def bench_update_engine_dispatches():
     """Compiled executions per optimizer step (tools/profile_step.py
     counters): the fused engine must stay at 1 program regardless of the
@@ -653,6 +676,14 @@ def main():
             extra["serve"] = bench_serve(platform)
         except Exception as e:
             extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("obs_overhead"):
+        try:
+            # tracing must be cheap enough to stay ON under load — measure
+            # it, don't assume it (docs/OBSERVABILITY.md): same serve path,
+            # telemetry off vs on at head-sampling 0.1, <5% qps cost gated
+            extra["obs_overhead"] = bench_obs_overhead(platform)
+        except Exception as e:
+            extra["obs_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
             and not over_budget("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's
@@ -697,6 +728,7 @@ def main():
         "lm_seq2048": "lm_seq2048_bf16",
         "lm_seq4096": "lm_seq4096_bf16",
         "serve": "serve",
+        "obs_overhead": "obs_overhead",
     }
     leg_error_key = {"bert_base_bf16": "bert_error"}  # irregular names
     extra["legs_run"] = [l for l, k in leg_result_key.items() if k in extra]

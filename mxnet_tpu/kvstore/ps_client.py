@@ -22,6 +22,7 @@ import time
 import numpy as np
 
 from .. import obs
+from ..obs import context as obs_context
 from ..base import MXNetError, capped_backoff
 from ..chaos import rpc as chaos_rpc
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
@@ -89,10 +90,20 @@ class PSClient:
                 t0 = time.monotonic() if rec else 0.0
                 with obs.trace.span("kvstore.rpc", op=opname, key=key,
                                     attempt=attempt):
+                    # distributed tracing: inside a traced flow the key
+                    # carries the kvstore.rpc span's context after \x1f
+                    # (obs/context.py) — the python server strips it
+                    # before any key lookup. Outside a trace (every plain
+                    # training step) the key is untouched, so peers that
+                    # predate context (the native C++ server) only ever
+                    # see suffixed keys under an explicitly traced run;
+                    # MXNET_OBS_WIRE=0 suppresses even that.
+                    wire_key = obs_context.inject_key(
+                        key, obs_context.current())
                     dup = chaos_rpc.on_send(opcode, key)
-                    _send_msg(self._sock, opcode, key, payload)
+                    _send_msg(self._sock, opcode, wire_key, payload)
                     if dup == "dup":  # chaos: duplicated frame on the wire
-                        _send_msg(self._sock, opcode, key, payload)
+                        _send_msg(self._sock, opcode, wire_key, payload)
                     reply = _recv_msg(self._sock)
                     if dup == "dup":
                         reply = _recv_msg(self._sock)  # drain the 2nd reply

@@ -32,6 +32,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .. import obs
+from ..obs import context as obs_context
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
 
 (OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
@@ -233,12 +234,25 @@ class PSServer:
         try:
             while True:
                 opcode, key, payload = _recv_msg(conn)
+                # strip wire trace context BEFORE any key lookup — a
+                # context-bearing key must hit the same weight/lock/seq
+                # tables as its plain form (old-format frames: no
+                # separator, nothing stripped)
+                key, wctx = obs_context.extract_key(key)
                 rec = obs.enabled()
                 t0 = time.monotonic() if rec else 0.0
                 if rec:
                     obs.inc("kvstore.server.bytes_received", len(payload))
                 try:
-                    alive = self._handle_one(conn, opcode, key, payload)
+                    # server-side span joins the worker's trace, so a PS
+                    # RPC shows both halves (client wait vs server apply)
+                    # on the merged timeline
+                    with obs_context.use(wctx), \
+                            obs.trace.span(
+                                "kvstore.server.rpc",
+                                op=OP_NAMES.get(opcode, str(opcode)),
+                                key=key):
+                        alive = self._handle_one(conn, opcode, key, payload)
                 finally:
                     if rec:
                         # per-RPC service time, server side (lock wait +

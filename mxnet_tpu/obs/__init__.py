@@ -32,10 +32,18 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from . import metrics, trace
+from . import context, metrics, trace
+# NOTE: the package attribute `obs.export` is the (pre-existing)
+# chrome-trace export FUNCTION below; the export MODULE (prometheus text
+# + multi-process merge) is reachable as `obs.export_mod` or via its full
+# dotted path: `from mxnet_tpu.obs.export import to_prometheus` (python
+# resolves that through sys.modules, not the shadowed attribute)
+from . import export as export_mod
+from . import slo  # SLO monitor over merged telemetry
 
-__all__ = ["trace", "metrics", "enable", "disable", "enabled", "span",
-           "event", "inc", "observe", "set_gauge", "export", "reset"]
+__all__ = ["trace", "metrics", "context", "export_mod", "slo", "enable",
+           "disable", "enabled", "span", "event", "inc", "observe",
+           "set_gauge", "export", "reset", "telemetry_part"]
 
 # re-exported hot-path helpers (obs.span is obs.trace.span)
 span = trace.span
@@ -50,10 +58,12 @@ def enabled() -> bool:
 def enable(jsonl: Optional[str] = None) -> None:
     """Turn telemetry on. ``jsonl`` additionally streams every completed
     span/event to that path (appended, flushed per event — survives
-    SIGKILL, tail-able on headless workers)."""
+    SIGKILL, tail-able on headless workers). A literal ``%p`` in the path
+    expands to this process's pid — how a fleet of ProcReplicas sharing
+    one ``MXNET_OBS_JSONL`` template each get their own evidence file."""
     trace._ENABLED = True
     if jsonl:
-        trace.stream_to(jsonl)
+        trace.stream_to(jsonl.replace("%p", str(os.getpid())))
 
 
 def disable() -> None:
@@ -97,6 +107,22 @@ def export(path: str) -> str:
     snapshot in ``otherData``) to ``path``. Load it in Perfetto, or feed it
     to ``tools/trace_report.py`` for a terminal breakdown."""
     return trace.export_chrome_trace(path, metrics=metrics.snapshot())
+
+
+def telemetry_part(drain: bool = True, role: Optional[str] = None) -> dict:
+    """This process's contribution to a fleet-wide telemetry collection:
+    the drained span ring (or a copy with ``drain=False``), the metrics
+    snapshot, and the clock anchor that lets collectors merge many
+    processes onto one timeline (obs/export.py ``merge_chrome_parts``).
+    This is what a server returns over ``OP_TELEMETRY``."""
+    if drain:
+        spans = trace.tracer.drain()
+    else:
+        spans = [trace.tracer._event_dict(r) for r in trace.tracer.events()]
+    return {"pid": os.getpid(), "role": role,
+            "wall_epoch": trace.tracer.wall_epoch,
+            "sample_rate": context.sample_rate(),
+            "spans": spans, "metrics": metrics.snapshot()}
 
 
 # environment switches: MXNET_OBS=1 enables at import, MXNET_OBS_JSONL

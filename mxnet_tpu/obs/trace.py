@@ -25,6 +25,15 @@ Overhead contract (tested in tests/test_obs.py):
 
 Spans nest per thread (a thread-local stack records depth); the context
 manager is reentrant across threads because each thread owns its stack.
+
+Distributed tracing (obs/context.py): when a :class:`~.context.TraceContext`
+is active on the thread, each span allocates its own ``span_id``, records
+``trace_id``/``span_id``/``parent_id`` in its attrs, and re-activates itself
+as the current context for its body — so spans on the far side of an RPC
+become children of the exact span that sent it. An active-but-UNSAMPLED
+context short-circuits ``span()`` to the shared no-op (head-based sampling:
+the whole trace is either recorded on every hop or costs one thread-local
+read per span site).
 """
 from __future__ import annotations
 
@@ -35,8 +44,11 @@ import time
 from collections import deque
 from typing import IO, List, Optional
 
+from . import context as _context
+
 __all__ = ["Tracer", "span", "event", "complete", "events", "reset",
-           "stream_to", "to_chrome_trace", "export_chrome_trace", "tracer"]
+           "drain", "stream_to", "to_chrome_trace", "export_chrome_trace",
+           "tracer"]
 
 # THE module flag: obs.enable()/disable() flip it; every instrumentation
 # entry point checks it first. Plain module global — one LOAD_GLOBAL on the
@@ -65,16 +77,25 @@ _NOOP = _NoopSpan()
 
 class _Span:
     """A live span: records (name, start, duration, thread, depth, attrs)
-    on exit. Created only while tracing is enabled."""
+    on exit. Created only while tracing is enabled. When a (sampled)
+    trace context is active, the span allocates a child span_id, runs its
+    body AS the current context, and stamps trace/span/parent ids into its
+    attrs — the cross-process parent chain."""
 
-    __slots__ = ("_tracer", "name", "attrs", "t0")
+    __slots__ = ("_tracer", "name", "attrs", "t0", "_ctx", "_parent")
 
-    def __init__(self, tracer: "Tracer", name: str, attrs: Optional[dict]):
+    def __init__(self, tracer: "Tracer", name: str, attrs: Optional[dict],
+                 parent=None):
         self._tracer = tracer
         self.name = name
         self.attrs = attrs
+        self._parent = parent
+        self._ctx = None
 
     def __enter__(self):
+        if self._parent is not None:
+            self._ctx = self._parent.child()
+            _context._set(self._ctx)
         self._tracer._stack().append(self)
         self.t0 = time.monotonic()
         return self
@@ -89,9 +110,16 @@ class _Span:
                 stack.pop()
             if stack:
                 stack.pop()
+        attrs = self.attrs
+        if self._ctx is not None:
+            _context._set(self._parent)
+            attrs = dict(attrs) if attrs else {}
+            attrs["trace_id"] = self._ctx.trace_id
+            attrs["span_id"] = self._ctx.span_id
+            attrs["parent_id"] = self._parent.span_id
         self._tracer._record(
             ("X", self.name, self.t0, t1 - self.t0,
-             threading.get_ident(), len(stack), self.attrs))
+             threading.get_ident(), len(stack), attrs))
         return False
 
 
@@ -109,9 +137,26 @@ class Tracer:
         self.capacity = int(capacity)
         self._events: deque = deque(maxlen=self.capacity)
         self._local = threading.local()
+        # the two epochs are taken at the same instant: an event's unix
+        # time is wall_epoch + ts — how multi-process traces merge onto
+        # one timeline (obs/export.py, tools/trace_report.py)
         self._epoch = _trace_epoch()
+        self._wall_epoch = time.time()
         self._stream: Optional[IO[str]] = None
+        self._stream_path: Optional[str] = None
         self._stream_lock = threading.Lock()
+
+    @property
+    def stream_path(self) -> Optional[str]:
+        """The JSONL path currently streamed to (None when not streaming)
+        — lets a tool that must toggle telemetry restore the caller's
+        stream afterwards (serve_bench.run_obs_overhead)."""
+        return self._stream_path
+
+    @property
+    def wall_epoch(self) -> float:
+        """Unix time of the tracer's t=0 (the cross-process clock anchor)."""
+        return self._wall_epoch
 
     # -- hot path ----------------------------------------------------------
     def _stack(self) -> list:
@@ -136,26 +181,47 @@ class Tracer:
     def span(self, name: str, **attrs) -> "_Span | _NoopSpan":
         if not _ENABLED:
             return _NOOP
-        return _Span(self, name, attrs or None)
+        ctx = _context.current()
+        if ctx is not None and not ctx.sampled:
+            return _NOOP  # head-based sampling: whole trace or nothing
+        return _Span(self, name, attrs or None, parent=ctx)
 
     def event(self, name: str, **attrs) -> None:
         """Record an instant (zero-duration) event — chaos injections,
-        preemption signals, retries."""
+        preemption signals, retries. Carries the active trace context's
+        ids so a tagged event lands inside its request's trace."""
         if not _ENABLED:
             return
+        ctx = _context.current()
+        if ctx is not None:
+            if not ctx.sampled:
+                return
+            attrs = dict(attrs)
+            attrs["trace_id"] = ctx.trace_id
+            attrs["parent_id"] = ctx.span_id
         self._record(("i", name, time.monotonic(), None,
                       threading.get_ident(), len(self._stack()),
                       attrs or None))
 
     def complete(self, name: str, t_start: float, duration: float,
-                 **attrs) -> None:
+                 ctx=None, **attrs) -> None:
         """Record an already-measured span with an explicit start and
         duration (``time.monotonic()`` seconds) — for phases whose
         endpoints live on different threads, e.g. a serve request's
         queue_wait measured between the submitter's enqueue and the
-        batcher's dispatch."""
+        batcher's dispatch. ``ctx`` pins the span to a trace context
+        captured on another thread (the batcher passes the request's)."""
         if not _ENABLED:
             return
+        if ctx is None:
+            ctx = _context.current()
+        if ctx is not None:
+            if not ctx.sampled:
+                return
+            attrs = dict(attrs)
+            attrs["trace_id"] = ctx.trace_id
+            attrs["span_id"] = _context.new_span_id()
+            attrs["parent_id"] = ctx.span_id
         self._record(("X", name, t_start, max(duration, 0.0),
                       threading.get_ident(), len(self._stack()),
                       attrs or None))
@@ -164,9 +230,38 @@ class Tracer:
     def events(self) -> List[tuple]:
         return list(self._events)
 
+    def drain(self) -> List[dict]:
+        """Atomically remove and return every buffered event as a list of
+        normalized dicts (the JSONL/event schema). The telemetry plane's
+        pull primitive: repeated ``OP_TELEMETRY`` collections each see only
+        what happened since the last one, and a bounded ring drained
+        periodically loses nothing."""
+        out = []
+        events = self._events
+        while True:
+            try:
+                out.append(events.popleft())  # atomic under the GIL
+            except IndexError:
+                break
+        return [self._event_dict(rec) for rec in out]
+
     def reset(self) -> None:
         self._events.clear()
         self._epoch = _trace_epoch()
+        self._wall_epoch = time.time()
+        # an attached stream's first clock record anchored the OLD epoch;
+        # events after this reset are relative to the new one — append a
+        # fresh anchor or every post-reset event would be rebased wrong
+        # in a merged timeline (readers take the last clock record)
+        with self._stream_lock:
+            if self._stream is not None:
+                try:
+                    self._stream.write(json.dumps(
+                        {"ph": "M", "name": "clock", "pid": os.getpid(),
+                         "wall_epoch": self._wall_epoch}) + "\n")
+                    self._stream.flush()
+                except (OSError, ValueError):
+                    self._stream = None
 
     def stream_to(self, path: Optional[str]) -> None:
         """Append completed events to ``path`` as JSONL (None closes)."""
@@ -177,8 +272,20 @@ class Tracer:
                 except OSError:
                     pass
                 self._stream = None
+            self._stream_path = path
             if path is not None:
                 self._stream = open(path, "a", buffering=1)
+                # clock anchor first: readers (trace_report/fleet_report)
+                # rebase this file's events onto unix time with it, so
+                # per-replica JSONL streams merge onto one timeline even
+                # when the writer was SIGKILLed mid-run
+                try:
+                    self._stream.write(json.dumps(
+                        {"ph": "M", "name": "clock", "pid": os.getpid(),
+                         "wall_epoch": self._wall_epoch}) + "\n")
+                    self._stream.flush()
+                except (OSError, ValueError):
+                    self._stream = None
 
     def stream_metrics(self, snapshot: dict) -> None:
         """Append a metrics-snapshot record to the JSONL stream (written by
@@ -197,7 +304,7 @@ class Tracer:
     def _event_dict(self, rec: tuple) -> dict:
         ph, name, ts, dur, tid, depth, attrs = rec
         d = {"ph": ph, "name": name, "ts": ts - self._epoch, "tid": tid,
-             "depth": depth}
+             "depth": depth, "pid": os.getpid()}
         if dur is not None:
             d["dur"] = dur
         if attrs:
@@ -234,9 +341,10 @@ class Tracer:
                 "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
                 "args": {"name": f"thread-{idx}"
                          if idx else "main"}})
-        out = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+        out = {"traceEvents": trace_events, "displayTimeUnit": "ms",
+               "otherData": {"pid": pid, "wall_epoch": self._wall_epoch}}
         if metrics is not None:
-            out["otherData"] = {"metrics": metrics}
+            out["otherData"]["metrics"] = metrics
         return out
 
     def export_chrome_trace(self, path: str,
@@ -252,10 +360,14 @@ tracer = Tracer(capacity=int(os.environ.get("MXNET_OBS_BUFFER", "65536")))
 
 def span(name: str, **attrs):
     """``with obs.trace.span("forward", epoch=3): ...`` — no-op singleton
-    when tracing is disabled."""
+    when tracing is disabled OR when the active trace context is not
+    sampled (head-based sampling, obs/context.py)."""
     if not _ENABLED:
         return _NOOP
-    return _Span(tracer, name, attrs or None)
+    ctx = _context.current()
+    if ctx is not None and not ctx.sampled:
+        return _NOOP
+    return _Span(tracer, name, attrs or None, parent=ctx)
 
 
 def event(name: str, **attrs) -> None:
@@ -263,14 +375,19 @@ def event(name: str, **attrs) -> None:
         tracer.event(name, **attrs)
 
 
-def complete(name: str, t_start: float, duration: float, **attrs) -> None:
+def complete(name: str, t_start: float, duration: float, ctx=None,
+             **attrs) -> None:
     """Module-level passthrough to :meth:`Tracer.complete`."""
     if _ENABLED:
-        tracer.complete(name, t_start, duration, **attrs)
+        tracer.complete(name, t_start, duration, ctx=ctx, **attrs)
 
 
 def events() -> List[tuple]:
     return tracer.events()
+
+
+def drain() -> List[dict]:
+    return tracer.drain()
 
 
 def reset() -> None:

@@ -35,6 +35,7 @@ from typing import List, Optional
 import numpy as np
 
 from .. import obs
+from ..obs import context as obs_context
 from .engine import DeadlineExceeded, Draining, RequestRejected, ServeError
 
 __all__ = ["DynamicBatcher", "Future"]
@@ -73,7 +74,7 @@ class Future:
 
 class _Request:
     __slots__ = ("data", "n", "feat", "deadline", "priority", "t_enqueue",
-                 "future")
+                 "future", "ctx")
 
     def __init__(self, data: List[np.ndarray], deadline: Optional[float],
                  priority: int):
@@ -85,6 +86,10 @@ class _Request:
         self.priority = priority
         self.t_enqueue = time.monotonic()
         self.future = Future()
+        # the submitter's trace context crosses to the batcher thread WITH
+        # the request: queue_wait/execute spans recorded over there still
+        # hang off the serve.rpc span that enqueued it
+        self.ctx = obs_context.current()
 
 
 class DynamicBatcher:
@@ -283,15 +288,26 @@ class DynamicBatcher:
         t_exec = time.monotonic()
         rows = sum(r.n for r in batch)
         rec = obs.enabled()
+        # batch-level spans pin to the first SAMPLED member's trace — a
+        # batch serves many traces, and under head sampling the member
+        # that happened to open it may be unsampled; a sampled request
+        # must never lose its execute/assembly spans to an unsampled lead
+        lead_ctx = batch[0].ctx
+        for r in batch:
+            if r.ctx is not None and r.ctx.sampled:
+                lead_ctx = r.ctx
+                break
         if rec:
             for r in batch:
                 # retroactive span: the wait happened on the caller's
-                # timeline, measured here where both endpoints are known
+                # timeline, measured here where both endpoints are known;
+                # pinned to the request's OWN trace context
                 obs.trace.complete("serve.queue_wait", r.t_enqueue,
-                                   t_exec - r.t_enqueue,
+                                   t_exec - r.t_enqueue, ctx=r.ctx,
                                    priority=r.priority, rows=r.n)
             obs.trace.complete("serve.batch_assembly", batch[0].t_enqueue,
                                t_exec - batch[0].t_enqueue,
+                               ctx=lead_ctx,
                                requests=len(batch), rows=rows)
             obs.observe("serve.batch_rows", rows)
             obs.observe("serve.batch_requests", len(batch))
@@ -301,7 +317,8 @@ class DynamicBatcher:
             else:
                 inputs = [np.concatenate([r.data[i] for r in batch], axis=0)
                           for i in range(len(batch[0].data))]
-            outs, version = self.engine.infer(inputs, n_valid=rows)
+            with obs_context.use(lead_ctx):
+                outs, version = self.engine.infer(inputs, n_valid=rows)
             lo = 0
             done_t = time.monotonic()
             for r in batch:

@@ -25,6 +25,7 @@ retry loop gives up once the deadline passes and surfaces
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
@@ -34,6 +35,7 @@ from typing import List, Optional, Tuple, Union
 import numpy as np
 
 from .. import obs
+from ..obs import context as obs_context
 from ..base import capped_backoff
 from ..chaos import rpc as chaos_rpc
 from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
@@ -41,9 +43,10 @@ from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
 from .engine import (DeadlineExceeded, Draining, RequestRejected, ServeError)
 from .server import (OP_ABORT_RELOAD, OP_COMMIT_RELOAD, OP_DRAIN, OP_HEALTH,
                      OP_INFER, OP_PREPARE_RELOAD, OP_READY, OP_RELOAD,
-                     OP_SHUTDOWN, OP_STATS, SERVE_OP_NAMES, STATUS_BAD_REQUEST,
-                     STATUS_DEADLINE, STATUS_DRAINING, STATUS_INTERNAL,
-                     STATUS_NOT_READY, STATUS_OK, STATUS_REJECTED, _INFER_HDR)
+                     OP_SHUTDOWN, OP_STATS, OP_TELEMETRY, SERVE_OP_NAMES,
+                     STATUS_BAD_REQUEST, STATUS_DEADLINE, STATUS_DRAINING,
+                     STATUS_INTERNAL, STATUS_NOT_READY, STATUS_OK,
+                     STATUS_REJECTED, _INFER_HDR)
 
 __all__ = ["ServeClient"]
 
@@ -113,10 +116,17 @@ class ServeClient:
                     t0 = time.monotonic() if rec else 0.0
                     with obs.trace.span("serve.client.rpc", op=opname,
                                         attempt=attempt):
+                        # the span re-activated itself as the current
+                        # context, so the wire key carries ITS span_id —
+                        # the server's spans become its children. No
+                        # active context (or obs off) → key stays "",
+                        # byte-identical to the old wire format.
+                        key = obs_context.inject_key(
+                            "", obs_context.current())
                         dup = chaos_rpc.on_send(opcode, "")
-                        _send_msg(self._sock, opcode, "", payload)
+                        _send_msg(self._sock, opcode, key, payload)
                         if dup == "dup":
-                            _send_msg(self._sock, opcode, "", payload)
+                            _send_msg(self._sock, opcode, key, payload)
                         reply = _recv_msg(self._sock)
                         if dup == "dup":
                             reply = _recv_msg(self._sock)
@@ -175,9 +185,17 @@ class ServeClient:
                    + _pack_arrays(arrays))
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms else None)
-        reply = self._check(self._rpc(OP_INFER, payload, deadline=deadline,
-                                      timeout=rpc_timeout),
-                            "inference failed")
+        # the trace is born here (unless the caller already carries one):
+        # the head-based sampling decision this root takes rides the wire
+        # to the router and every replica this request touches
+        ctx = None
+        if obs.enabled():
+            ctx = obs_context.current() or obs_context.new_root()
+        with obs_context.use(ctx):
+            reply = self._check(self._rpc(OP_INFER, payload,
+                                          deadline=deadline,
+                                          timeout=rpc_timeout),
+                                "inference failed")
         (version,) = struct.unpack_from("<I", reply, 0)
         outs, _ = _unpack_arrays(reply[4:])
         result = outs[0] if len(outs) == 1 else outs
@@ -215,6 +233,27 @@ class ServeClient:
 
     def stats(self) -> dict:
         reply = self._check(self._rpc(OP_STATS), "stats failed")
+        return json.loads(bytes(reply).decode("utf-8"))
+
+    def telemetry(self, drain: bool = True, fmt: str = "json"):
+        """Pull the server's telemetry (``OP_TELEMETRY``): ``fmt="json"``
+        returns ``{"parts": [...]}`` — one part per process behind the
+        endpoint (a FleetServer appends every live replica's), each with
+        its drained span ring, metrics snapshot, and clock anchor.
+        ``fmt="prometheus"`` returns the text exposition instead.
+        ``drain=False`` peeks without consuming the rings.
+
+        Exactly-once under retries: draining is destructive, so the
+        request carries a fresh collection token — a retried frame whose
+        reply was lost re-serves the server's cached reply instead of
+        draining (and losing) a second batch."""
+        payload = json.dumps({"drain": bool(drain), "format": fmt,
+                              "token": os.urandom(8).hex()
+                              }).encode("utf-8")
+        reply = self._check(self._rpc(OP_TELEMETRY, payload),
+                            "telemetry failed")
+        if fmt == "prometheus":
+            return bytes(reply).decode("utf-8")
         return json.loads(bytes(reply).decode("utf-8"))
 
     def reload(self, path: str, epoch: Optional[int] = None,

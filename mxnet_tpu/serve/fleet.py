@@ -111,6 +111,11 @@ class CircuitBreaker:
         self._consecutive = 0
         self._opened_at = 0.0
         self._probe_out = False
+        # cumulative seconds spent NOT closed (open + half-open probing) —
+        # the SLO monitor's "breaker open-time" signal: how long traffic
+        # was being turned away from this replica
+        self.open_seconds = 0.0
+        self._not_closed_since: Optional[float] = None
         self._lock = threading.Lock()
 
     @property
@@ -135,6 +140,9 @@ class CircuitBreaker:
 
     def success(self) -> None:
         with self._lock:
+            if self._not_closed_since is not None:
+                self.open_seconds += time.monotonic() - self._not_closed_since
+                self._not_closed_since = None
             self._state = "closed"
             self._consecutive = 0
             self._probe_out = False
@@ -156,8 +164,11 @@ class CircuitBreaker:
             if self._state == "half_open" or (
                     self._state == "closed"
                     and self._consecutive >= self.threshold):
+                was_closed = self._state == "closed"
                 self._state = "open"
                 self._opened_at = time.monotonic()
+                if was_closed:  # open→half_open→open keeps the first stamp
+                    self._not_closed_since = self._opened_at
                 self._probe_out = False
                 self.trips += 1
                 return True
@@ -165,9 +176,13 @@ class CircuitBreaker:
 
     def snapshot(self) -> dict:
         with self._lock:
+            open_s = self.open_seconds
+            if self._not_closed_since is not None:
+                open_s += time.monotonic() - self._not_closed_since
             return {"state": self._state, "consecutive": self._consecutive,
                     "trips": self.trips, "threshold": self.threshold,
-                    "cooldown_s": self.cooldown}
+                    "cooldown_s": self.cooldown,
+                    "open_seconds": round(open_s, 4)}
 
 
 # ---------------------------------------------------------------------------
@@ -216,14 +231,23 @@ class ProcReplica:
     a pre-picked port. ``kill()`` is a real SIGKILL. Per-replica chaos:
     ``MXNET_CHAOS_KILL_REPLICA<idx>`` in the parent environment becomes the
     child's ``MXNET_CHAOS_KILL``, so one fleet member can be killed at a
-    named code point while its peers stay healthy."""
+    named code point while its peers stay healthy.
+
+    Telemetry inheritance: when the parent has obs on (or ``MXNET_OBS`` is
+    set) the child gets ``MXNET_OBS=1`` and the parent's sample rate; with
+    an ``obs_dir`` (param or ``MXNET_OBS_DIR``) the child also streams
+    flush-per-event JSONL to ``<obs_dir>/replica-<pid>.jsonl`` — so a
+    SIGKILL'd replica still leaves its half of the timeline on disk, and
+    ``tools/trace_report.py`` merges it back in by pid lane."""
 
     def __init__(self, model: str, *, args: Sequence[str] = (),
-                 env: Optional[dict] = None, log_path: Optional[str] = None):
+                 env: Optional[dict] = None, log_path: Optional[str] = None,
+                 obs_dir: Optional[str] = None):
         self.model = model
         self._args = list(args)
         self._env = dict(env or {})
         self._log_path = log_path
+        self._obs_dir = obs_dir or os.environ.get("MXNET_OBS_DIR")
         self.proc: Optional[subprocess.Popen] = None
         self.idx = -1  # assigned by the pool
 
@@ -239,6 +263,22 @@ class ProcReplica:
                         os.environ.get(f"MXNET_CHAOS_KILL_REPLICA{self.idx}"))
         if chaos:
             env["MXNET_CHAOS_KILL"] = chaos
+        if obs.enabled():
+            # the whole fleet observes or none of it does — a replica with
+            # telemetry off would be a hole in every collected trace
+            env.setdefault("MXNET_OBS", "1")
+            env.setdefault("MXNET_OBS_SAMPLE",
+                           repr(obs.context.sample_rate()))
+        if self._obs_dir and env.get("MXNET_OBS") \
+                and "MXNET_OBS_JSONL" not in self._env:
+            os.makedirs(self._obs_dir, exist_ok=True)
+            # %p expands to the CHILD's pid at its obs import — per-pid
+            # evidence files that survive SIGKILL. This OVERRIDES a
+            # parent-inherited MXNET_OBS_JSONL (which would make every
+            # replica append to one shared file with clashing clock
+            # anchors); only an explicit per-replica env wins over it.
+            env["MXNET_OBS_JSONL"] = os.path.join(
+                self._obs_dir, "replica-%p.jsonl")
         out = open(self._log_path, "ab") if self._log_path \
             else subprocess.DEVNULL
         try:
@@ -326,8 +366,9 @@ class ReplicaPool:
 
     @classmethod
     def spawn(cls, model: str, n: int, *, args: Sequence[str] = (),
-              env: Optional[dict] = None, **kw) -> "ReplicaPool":
-        return cls([ProcReplica(model, args=args, env=env)
+              env: Optional[dict] = None, obs_dir: Optional[str] = None,
+              **kw) -> "ReplicaPool":
+        return cls([ProcReplica(model, args=args, env=env, obs_dir=obs_dir)
                     for _ in range(n)], **kw)
 
     # -- lifecycle ------------------------------------------------------
@@ -703,9 +744,16 @@ class Router:
         read-only — the loser's work is wasted capacity, not corruption)
         and take the first success."""
         q: "queue.Queue" = queue.Queue()
+        # the trace context is thread-local and the racing attempts run on
+        # fresh threads — carry it over, or every hedged request would
+        # re-root downstream (new trace_id, fresh sampling roll) and fall
+        # out of the client's trace
+        ctx = obs.context.current()
 
         def run(member):
-            q.put((member, self._attempt(member, arrays, deadline, priority)))
+            with obs.context.use(ctx):
+                q.put((member,
+                       self._attempt(member, arrays, deadline, priority)))
 
         threading.Thread(target=run, args=(primary,), daemon=True).start()
         try:
@@ -755,6 +803,12 @@ class Router:
         arrays = [np.ascontiguousarray(np.asarray(x)) for x in inputs]
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms else None)
+        # a Router driven directly (no FleetServer front) still roots the
+        # trace here, so fleet.route → replica spans correlate; behind a
+        # front the serve.rpc handler already activated the wire context
+        rctx = None
+        if obs.enabled() and obs.context.current() is None:
+            rctx = obs.context.new_root()
         # gate-check and inflight-increment must be one atomic step from
         # the flip's point of view: check the gate again under _cv after
         # counting ourselves, so either the reload's drain sees us (and
@@ -771,8 +825,20 @@ class Router:
                 if self._gate.is_set():
                     self._inflight += 1
                     break
+        t0 = time.monotonic()
         try:
-            return self._infer_routed(arrays, deadline, priority)
+            with obs.context.use(rctx):
+                result = self._infer_routed(arrays, deadline, priority)
+            # ONE observation per REQUEST, front-side — the replica-side
+            # serve.latency_seconds counts executions, which hedging
+            # duplicates; SLO math prefers this histogram when present so
+            # phantom hedge completions can't dilute attainment
+            obs.observe("fleet.request_latency_seconds",
+                        time.monotonic() - t0)
+            return result
+        except DeadlineExceeded:
+            obs.inc("fleet.request_deadline_exceeded")
+            raise
         finally:
             with self._cv:
                 self._inflight -= 1
@@ -860,6 +926,11 @@ class Router:
                 "sheds": m.sheds, "last_error": m.last_error,
                 "breaker": self._breaker(m).snapshot(),
             }
+        open_s = sum(r["breaker"]["open_seconds"]
+                     for r in replicas.values())
+        # mirrored into the registry so fleet-level SLO math works off the
+        # merged metrics snapshot alone (no stats dict in hand)
+        obs.set_gauge("fleet.breaker_open_seconds", open_s)
         return {"fleet_version": self._fleet_version,
                 "ready_replicas": len(self._pool.ready_members()),
                 "failovers": self.failovers, "hedges": self.hedges,
@@ -867,10 +938,31 @@ class Router:
                 "stale_rejected": self.stale_rejected,
                 "breaker_trips": sum(b.trips
                                      for b in self._breakers.values()),
+                "breaker_open_seconds": round(open_s, 4),
                 "inflight": self._inflight,
                 "intake_paused": not self._gate.is_set(),
                 "hedge_ms": self.hedge_ms,
                 "replicas": replicas}
+
+    def collect_telemetry(self, drain: bool = True) -> list:
+        """Pull every ready replica's telemetry part over ``OP_TELEMETRY``
+        (drained rings: repeated collections are increments). A replica
+        that fails mid-pull is skipped and counted — the fleet's timeline
+        must assemble from whoever is alive; the dead leave their JSONL
+        evidence instead."""
+        parts = []
+        for m in self._pool.ready_members():
+            try:
+                with self._conn(m) as cli:
+                    tel = cli.telemetry(drain=drain)
+                for p in tel.get("parts", []):
+                    p["role"] = f"replica{m.idx}"
+                    parts.append(p)
+            except (ServeError, ConnectionError, OSError) as e:
+                obs.inc("fleet.telemetry_errors")
+                obs.event("fleet.telemetry_error", replica=m.idx,
+                          error=str(e)[:160])
+        return parts
 
     def drain(self, timeout: float = 30.0) -> bool:
         deadline = time.monotonic() + timeout
@@ -1003,3 +1095,29 @@ class FleetServer(ServeServer):
     def reload(self, path: str, epoch: Optional[int] = None,
                prefix: str = "ckpt") -> int:
         return self._router.reload(path, epoch=epoch, prefix=prefix)
+
+    def telemetry(self, drain: bool = True) -> dict:
+        """The fleet collection plane: one ``OP_TELEMETRY`` against the
+        front returns the front's own part (client rpc + fleet.route
+        spans, router metrics, breaker state) PLUS one part per live
+        replica — everything ``obs.export.merge_chrome_parts`` needs for
+        the single merged timeline, and ``parts_to_prometheus`` for the
+        pid/role-labeled exposition.
+
+        Parts are deduped by pid: an in-process LocalReplica fleet shares
+        ONE tracer ring and registry with the front, so its replica parts
+        would be copies (peek) or already-claimed spans (drain) — only a
+        real subprocess fleet contributes distinct lanes."""
+        # stats FIRST: Router.stats() refreshes the breaker-open-time
+        # gauge, which must land in the snapshot the part takes — the
+        # other order would export the gauge one collection stale
+        st = self.stats(include_metrics=False)
+        front = obs.telemetry_part(drain=drain, role="fleet")
+        front["stats"] = st
+        parts, seen = [front], {front["pid"]}
+        for p in self._router.collect_telemetry(drain=drain):
+            if p.get("pid") in seen:
+                continue
+            seen.add(p.get("pid"))
+            parts.append(p)
+        return {"parts": parts}

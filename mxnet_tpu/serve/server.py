@@ -19,6 +19,21 @@ Wire protocol (little-endian, see ``kvstore/ps_server.py`` for framing):
   STATS  reply   : u8 0 | utf-8 json (engine + batcher + server stats)
   DRAIN  request : u8 stop_after (0/1)
   DRAIN  reply   : u8 0 once queued + in-flight work finished
+  TELEMETRY request : utf-8 json {"drain": bool (default true),
+                   "format": "json"|"prometheus"} (empty = defaults).
+  TELEMETRY reply: u8 status | utf-8 blob — json: {"parts": [telemetry
+                   part, ...]} (obs.telemetry_part schema: pid, role,
+                   wall_epoch clock anchor, drained span ring, metrics
+                   snapshot; a FleetServer returns one part per live
+                   replica plus its own). prometheus: text exposition
+                   (obs/export.py), pid/role-labeled — the HTTP-free
+                   scrape endpoint.
+
+Distributed tracing (docs/OBSERVABILITY.md): every request frame's key
+field may carry a ``\\x1f``-suffixed W3C traceparent (obs/context.py).
+``_handle_loop`` strips it FIRST — old-format frames have no suffix and
+parse unchanged; a bare INFER gets a fresh sampled-or-not root, so the
+replica's spans are one timeline either way. Replies never carry context.
   PREPARE_RELOAD : utf-8 json {"path", "epoch", "prefix", "version",
                    "token": [cid, epoch]} — phase one of the fleet-atomic
                    reload (serve/fleet.py): load + validate + stage, do NOT
@@ -56,6 +71,7 @@ from typing import Optional
 import numpy as np
 
 from .. import obs
+from ..obs import context as obs_context
 from ..chaos import rpc as _chaos_rpc
 from ..chaos.proc import kill_point
 from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
@@ -66,23 +82,24 @@ from .engine import (DeadlineExceeded, Draining, InferenceEngine,
 
 __all__ = ["ServeServer", "OP_INFER", "OP_HEALTH", "OP_READY", "OP_RELOAD",
            "OP_STATS", "OP_DRAIN", "OP_SHUTDOWN", "OP_PREPARE_RELOAD",
-           "OP_COMMIT_RELOAD", "OP_ABORT_RELOAD", "SERVE_OP_NAMES",
-           "STATUS_OK", "STATUS_REJECTED", "STATUS_DEADLINE",
-           "STATUS_BAD_REQUEST", "STATUS_DRAINING", "STATUS_INTERNAL",
-           "STATUS_NOT_READY"]
+           "OP_COMMIT_RELOAD", "OP_ABORT_RELOAD", "OP_TELEMETRY",
+           "SERVE_OP_NAMES", "STATUS_OK", "STATUS_REJECTED",
+           "STATUS_DEADLINE", "STATUS_BAD_REQUEST", "STATUS_DRAINING",
+           "STATUS_INTERNAL", "STATUS_NOT_READY"]
 
 # serve opcode range: disjoint from the kvstore PS opcodes (0–9), so the
 # chaos rule table (chaos/rpc.py OP_NAMES) can address both planes
 (OP_INFER, OP_HEALTH, OP_READY, OP_RELOAD, OP_STATS, OP_DRAIN,
  OP_SHUTDOWN, OP_PREPARE_RELOAD, OP_COMMIT_RELOAD,
- OP_ABORT_RELOAD) = range(32, 42)
+ OP_ABORT_RELOAD, OP_TELEMETRY) = range(32, 43)
 
 SERVE_OP_NAMES = {OP_INFER: "infer", OP_HEALTH: "health", OP_READY: "ready",
                   OP_RELOAD: "reload", OP_STATS: "stats", OP_DRAIN: "drain",
                   OP_SHUTDOWN: "serve_shutdown",
                   OP_PREPARE_RELOAD: "prepare_reload",
                   OP_COMMIT_RELOAD: "commit_reload",
-                  OP_ABORT_RELOAD: "abort_reload"}
+                  OP_ABORT_RELOAD: "abort_reload",
+                  OP_TELEMETRY: "telemetry"}
 
 # single source of truth for chaos rule names: MXNET_CHAOS_RPC rules match
 # these ops the moment the serving plane is imported (the client imports
@@ -133,6 +150,13 @@ class ServeServer:
         self._staged_token = None
         from collections import OrderedDict
         self._committed_tokens: "OrderedDict" = OrderedDict()
+        # exactly-once telemetry drains: draining the span ring is
+        # destructive, and the client's RPC layer retries lost replies —
+        # a retried collection token re-serves the cached reply instead
+        # of draining again (the kvstore (client_id, seq) idiom; without
+        # this, every retry would silently lose the first drain's spans)
+        self._telemetry_tokens: "OrderedDict" = OrderedDict()
+        self._telemetry_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -276,17 +300,37 @@ class ServeServer:
                 self._engine.abort_reload()
                 self._staged_token = None
 
-    def stats(self) -> dict:
+    def stats(self, include_metrics: bool = True) -> dict:
         out = {"uptime_seconds": round(time.monotonic() - self._started, 3),
                "draining": self._draining,
                "connections": len(self._conns),
                "sheds": {"draining": self._shed_draining},
                "pid": os.getpid()}
+        if include_metrics:
+            # ONE schema for every numeric runtime signal: the full
+            # registry snapshot rides STATS, so serve_bench /
+            # fleet_report / the SLO monitor read the same counters the
+            # process records — no ad-hoc parallel bookkeeping. (The
+            # telemetry path passes False: its part already carries the
+            # snapshot, a second copy would just double the payload.)
+            out["metrics"] = obs.metrics.snapshot()
         if self._engine is not None:
             out["engine"] = self._engine.stats()
         if self._batcher is not None:
             out["batcher"] = self._batcher.stats()
         return out
+
+    def telemetry(self, drain: bool = True) -> dict:
+        """This process's telemetry contribution (``OP_TELEMETRY``): span
+        ring (drained by default — repeated collections are increments),
+        metrics snapshot, clock anchor. A FleetServer overrides this to
+        pull and append every live replica's parts."""
+        # stats first: anything stats() mirrors into gauges must land in
+        # the snapshot telemetry_part() takes
+        st = self.stats(include_metrics=False)
+        part = obs.telemetry_part(drain=drain, role="server")
+        part["stats"] = st
+        return {"parts": [part]}
 
     # ------------------------------------------------------------------
     # connection handling
@@ -309,11 +353,19 @@ class ServeServer:
             while True:
                 opcode, key, payload = _recv_msg(conn)
                 kill_point("serve:post_recv")  # chaos: die with work read
+                # strip wire trace context BEFORE anything looks at the
+                # key (old-format frames: no separator, no context); a
+                # context-less INFER becomes a new sampled-or-not root, so
+                # replica spans trace either way ("absent = new root")
+                key, wctx = obs_context.extract_key(key)
                 rec = obs.enabled()
+                if wctx is None and rec and opcode == OP_INFER:
+                    wctx = obs_context.new_root()
                 t0 = time.monotonic() if rec else 0.0
                 opname = SERVE_OP_NAMES.get(opcode, str(opcode))
                 try:
-                    with obs.trace.span("serve.rpc", op=opname):
+                    with obs_context.use(wctx), \
+                            obs.trace.span("serve.rpc", op=opname):
                         alive = self._handle_one(conn, opcode, key, payload)
                 finally:
                     if rec:
@@ -402,6 +454,35 @@ class ServeServer:
         elif opcode == OP_STATS:
             blob = json.dumps(self.stats(), default=str).encode("utf-8")
             self._reply(conn, OP_STATS, struct.pack("<B", STATUS_OK) + blob)
+        elif opcode == OP_TELEMETRY:
+            try:
+                spec = json.loads(bytes(payload).decode("utf-8")) \
+                    if len(payload) else {}
+                token = spec.get("token")
+                blob = None
+                if token is not None:
+                    with self._telemetry_lock:
+                        blob = self._telemetry_tokens.get(token)
+                if blob is None:
+                    tel = self.telemetry(drain=bool(spec.get("drain", True)))
+                    if spec.get("format") == "prometheus":
+                        from ..obs.export import parts_to_prometheus
+
+                        blob = parts_to_prometheus(
+                            tel["parts"]).encode("utf-8")
+                    else:
+                        blob = json.dumps(tel, default=float).encode("utf-8")
+                    if token is not None:
+                        with self._telemetry_lock:
+                            self._telemetry_tokens[token] = blob
+                            while len(self._telemetry_tokens) > 4:
+                                self._telemetry_tokens.popitem(last=False)
+                self._reply(conn, OP_TELEMETRY,
+                            struct.pack("<B", STATUS_OK) + blob)
+            except Exception as e:  # noqa: BLE001 — wire-reported
+                obs.inc("serve.telemetry_errors")
+                self._reply(conn, OP_TELEMETRY, _err_payload(
+                    STATUS_INTERNAL, f"{type(e).__name__}: {e}"))
         elif opcode == OP_DRAIN:
             stop = bool(payload and payload[0])
             drained = self.drain(stop=False)

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""fleet_report.py — pull fleet-wide telemetry and render the one timeline.
+
+The collection plane's CLI (docs/OBSERVABILITY.md "Fleet telemetry"): one
+``OP_TELEMETRY`` against a serving endpoint — a FleetServer front answers
+with its own part (client rpc + fleet.route spans, router/breaker state)
+plus one part per live replica; a plain ServeServer answers with just its
+own — and this tool turns the parts into:
+
+- ``--trace out.json``  — ONE merged chrome trace, a lane per pid, every
+  sampled INFER's client → router → replica spans stitched by trace_id
+  (load in Perfetto, or feed to ``tools/trace_report.py``);
+- ``--prom out.prom``   — Prometheus text exposition, pid/role-labeled
+  (``-`` writes to stdout; point a textfile collector at the file — no
+  HTTP server in-process);
+- the SLO report (default on): deadline attainment, error-budget burn,
+  p99 vs target, shed-by-reason, breaker open-time, hedge win rate
+  (``obs/slo.py``), computed over the MERGED metrics.
+
+SIGKILL'd replicas answer nothing — but their ``replica-<pid>.jsonl``
+evidence files (``MXNET_OBS_DIR``) do: pass them via ``--jsonl`` and they
+join the same timeline as extra pid lanes.
+
+Usage::
+
+    python tools/fleet_report.py --connect 127.0.0.1:9191 \
+        --trace merged.json --prom - [--jsonl obs/replica-*.jsonl]
+        [--target 0.99] [--p99-ms 50] [--no-drain]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def jsonl_to_part(path: str) -> dict:
+    """A JSONL evidence file as a telemetry part (the dead replica's
+    contribution: its clock record anchors the lane, its flushed spans are
+    whatever it managed to record before the kill)."""
+    from trace_report import load_trace_meta
+
+    spans, instants, metrics, meta = load_trace_meta(path)
+    events = []
+    for ev in spans:
+        events.append(dict(ev, ph="X"))
+    for ev in instants:
+        events.append(dict(ev, ph="i"))
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"pid": meta.get("pid"), "role": f"jsonl:{path.rsplit('/',1)[-1]}",
+            "wall_epoch": meta.get("wall_epoch"),
+            "spans": events, "metrics": metrics or {}}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--connect", required=True, metavar="HOST:PORT",
+                    help="a ServeServer/FleetServer endpoint")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="write the merged chrome trace here")
+    ap.add_argument("--prom", default=None, metavar="OUT.prom",
+                    help="write the Prometheus exposition ('-' = stdout)")
+    ap.add_argument("--jsonl", nargs="*", default=(),
+                    help="per-replica JSONL evidence files to merge in "
+                         "(SIGKILL'd members)")
+    ap.add_argument("--no-drain", action="store_true",
+                    help="peek without consuming the span rings")
+    ap.add_argument("--no-slo", action="store_true",
+                    help="skip the SLO report")
+    ap.add_argument("--target", type=float, default=0.99,
+                    help="deadline-attainment SLO target (default 0.99)")
+    ap.add_argument("--p99-ms", type=float, default=None,
+                    help="p99 latency alert threshold (ms)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit everything as one JSON document")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.obs.export import (merge_chrome_parts, merge_metrics,
+                                      parts_to_prometheus)
+    from mxnet_tpu.obs.slo import SLOMonitor
+    from mxnet_tpu.serve import ServeClient
+
+    host, _, port = args.connect.partition(":")
+    cli = ServeClient(host, int(port))
+    try:
+        tel = cli.telemetry(drain=not args.no_drain)
+        # stats ride the front part when the server attached them (the
+        # router's breaker open-time lives there)
+        stats = next((p.get("stats") for p in tel["parts"]
+                      if p.get("stats")), None)
+    finally:
+        cli.close()
+    # a live replica answers OP_TELEMETRY *and* has a JSONL file — a glob
+    # like obs/replica-*.jsonl matches both, so drop evidence whose pid
+    # already reported over the wire (its spans would merge twice); only
+    # the dead, who answer nothing, contribute through their files
+    live_pids = {p.get("pid") for p in tel["parts"]}
+    jsonl_parts = []
+    for path in args.jsonl:
+        jp = jsonl_to_part(path)
+        if jp.get("pid") is not None and jp["pid"] in live_pids:
+            continue
+        jsonl_parts.append(jp)
+    parts = tel["parts"] + jsonl_parts
+
+    # dedupe by pid: parts from one process share one registry (an
+    # in-process LocalReplica fleet); merging each copy would multiply
+    # every count
+    seen_pids, uniq = set(), []
+    for p in parts:
+        if p.get("pid") in seen_pids:
+            continue
+        seen_pids.add(p.get("pid"))
+        uniq.append(p.get("metrics") or {})
+    merged_metrics = merge_metrics(uniq)
+    out = {"parts": [{"pid": p.get("pid"), "role": p.get("role"),
+                      "spans": len(p.get("spans") or ())} for p in parts]}
+
+    if args.trace:
+        doc = merge_chrome_parts(parts, metrics=merged_metrics)
+        with open(args.trace, "w") as f:
+            json.dump(doc, f, default=str)
+        out["trace"] = args.trace
+        if not args.json:
+            print(f"merged chrome trace ({len(parts)} lanes) "
+                  f"-> {args.trace}")
+
+    if args.prom:
+        text = parts_to_prometheus(parts)
+        if args.prom == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.prom, "w") as f:
+                f.write(text)
+            if not args.json:
+                print(f"prometheus exposition -> {args.prom}")
+        out["prometheus_lines"] = text.count("\n")
+
+    if not args.no_slo:
+        mon = SLOMonitor(deadline_target=args.target,
+                         p99_target_ms=args.p99_ms)
+        # a FleetServer's "batcher" IS the Router — its stats carry the
+        # breaker open-time the SLO report wants
+        rep = mon.evaluate(merged_metrics,
+                           stats=(stats or {}).get("batcher"))
+        out["slo"] = rep
+        if not args.json:
+            print(SLOMonitor.render(rep))
+
+    if args.json:
+        json.dump(out, sys.stdout, indent=2, default=str)
+        sys.stdout.write("\n")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -210,6 +210,51 @@ def run_bench(model="mlp", mode="closed", duration=5.0, clients=4, qps=200.0,
     return out
 
 
+def run_obs_overhead(model="mlp", duration=4.0, sample=0.1, clients=4,
+                     max_batch_size=8, request_rows=1, threshold_pct=5.0):
+    """Measure what tracing COSTS, instead of assuming it's free: the same
+    closed-loop bench twice through the full engine→batcher→socket stack —
+    telemetry off, then on with head-based sampling at ``sample`` — and
+    report the qps delta as ``obs_overhead_pct``. This is the number that
+    justifies leaving tracing on under load (docs/OBSERVABILITY.md), and
+    ``bench.py`` records + gates it (< ``threshold_pct`` at sample 0.1 on
+    the resnet18 serve path)."""
+    from mxnet_tpu import obs
+
+    # the caller may be mid-run with live telemetry (bench.py streaming
+    # JSONL): snapshot flag/rate/stream, and only wipe what THIS harness
+    # recorded when telemetry was off to begin with
+    was_on = obs.enabled()
+    prev_rate = obs.context.sample_rate()
+    prev_stream = obs.trace.tracer.stream_path
+    obs.disable()
+    try:
+        off = run_bench(model=model, mode="closed", duration=duration,
+                        clients=clients, max_batch_size=max_batch_size,
+                        request_rows=request_rows)
+        obs.context.set_sample_rate(sample)
+        obs.enable()
+        on = run_bench(model=model, mode="closed", duration=duration,
+                       clients=clients, max_batch_size=max_batch_size,
+                       request_rows=request_rows)
+    finally:
+        obs.disable()
+        obs.context.set_sample_rate(prev_rate)
+        if was_on:
+            obs.enable(jsonl=prev_stream)  # resume the caller's stream
+        else:
+            obs.reset()  # telemetry was off: leave no residue
+    qps_off, qps_on = off["qps"], on["qps"]
+    pct = 100.0 * (qps_off - qps_on) / qps_off if qps_off else 0.0
+    return {"model": model, "sample_rate": sample,
+            "duration_s": duration, "clients": clients,
+            "qps_off": qps_off, "qps_on": qps_on,
+            "p99_ms_off": off["p99_ms"], "p99_ms_on": on["p99_ms"],
+            "obs_overhead_pct": round(pct, 2),
+            "threshold_pct": threshold_pct,
+            "ok": bool(pct < threshold_pct)}
+
+
 def run_chaos_bench(model="mlp", duration=12.0, qps=120.0, replicas=3,
                     max_batch_size=8, max_linger_ms=2.0, deadline_ms=500.0,
                     request_rows=1, hedge_ms=None, kill_replica=0):
@@ -374,6 +419,12 @@ def main(argv=None):
                     help="fleet size for --chaos")
     ap.add_argument("--hedge-ms", type=float, default=None,
                     help="fleet tail-latency hedge threshold for --chaos")
+    ap.add_argument("--obs-overhead", action="store_true",
+                    help="measure tracing overhead: closed-loop qps with "
+                         "telemetry off vs on at --sample (always prints "
+                         "JSON; warns when over the 5%% budget)")
+    ap.add_argument("--sample", type=float, default=0.1,
+                    help="head-sampling rate for --obs-overhead")
     args = ap.parse_args(argv)
 
     if not args.connect:
@@ -382,6 +433,24 @@ def main(argv=None):
         from mxnet_tpu import platform as mxplatform
 
         mxplatform.devices_or_exit(what="tools/serve_bench.py")
+
+    if args.obs_overhead:
+        if args.connect:
+            # the overhead harness toggles THIS process's telemetry around
+            # an in-process stack; it cannot flip a remote endpoint's —
+            # a localhost number labeled as the remote's would be a lie
+            ap.error("--obs-overhead measures an in-process stack and "
+                     "cannot target --connect")
+        res = run_obs_overhead(model=args.model, duration=args.duration,
+                               sample=args.sample, clients=args.clients,
+                               max_batch_size=args.max_batch_size,
+                               request_rows=args.request_rows)
+        print(json.dumps(res, indent=1))
+        if not res["ok"]:
+            print(f"WARNING: obs_overhead_pct={res['obs_overhead_pct']} "
+                  f"exceeds the {res['threshold_pct']}% budget at "
+                  f"sample={args.sample}", file=sys.stderr)
+        return 0
 
     if args.chaos:
         res = run_chaos_bench(model=args.model, duration=args.duration,
@@ -422,4 +491,11 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    # skip interpreter teardown: after 2+ in-process engine/server builds
+    # the PJRT CPU client's worker threads can std::terminate the exit
+    # (pre-existing, timing-dependent; everything is printed and flushed
+    # by now) — a measurement CLI must not turn a clean run into rc=134
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc or 0)

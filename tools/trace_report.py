@@ -1,9 +1,11 @@
 #!/usr/bin/env python
-"""trace_report.py — terminal breakdown of an obs trace.
+"""trace_report.py — terminal breakdown of one or MANY obs traces.
 
-Reads a chrome-trace ``trace.json`` (``mx.obs.export(...)`` /
-``tools/profile_step.py --trace-out``) or a JSONL event stream
-(``MXNET_OBS_JSONL=...``) and prints:
+Reads chrome-trace ``trace.json`` files (``mx.obs.export(...)`` /
+``tools/profile_step.py --trace-out`` / ``tools/fleet_report.py``) and/or
+JSONL event streams (``MXNET_OBS_JSONL=...`` — including the per-replica
+``replica-<pid>.jsonl`` evidence a SIGKILL'd fleet member leaves behind)
+and prints:
 
 1. the per-phase time breakdown — every span name aggregated
    (count / total / mean / max / % of wall), step phases first;
@@ -13,12 +15,21 @@ Reads a chrome-trace ``trace.json`` (``mx.obs.export(...)`` /
    trace (`otherData.metrics` in chrome traces, the final ``"ph": "M"``
    record in JSONL streams).
 
+With multiple inputs, events merge onto per-pid/tid lanes: each file's
+clock anchor (the ``wall_epoch`` every tracer stamps into its stream /
+export) rebases its events onto shared unix time. Files without an anchor
+(pre-anchor captures) are pinned at the shared origin and the report
+carries an explicit clock-skew note — cross-file ordering is then
+approximate. ``--chrome-out merged.json`` writes the merged timeline as
+one Perfetto-loadable chrome trace.
+
 Usage::
 
-    python tools/trace_report.py trace.json [--top 10] [--json]
+    python tools/trace_report.py trace.json [more.json replica-*.jsonl]
+        [--top 10] [--json] [--chrome-out merged.json]
 
 No framework import needed — this parses the files, so it runs anywhere
-(including on a laptop against a trace scp'd off a TPU worker).
+(including on a laptop against traces scp'd off a TPU worker).
 """
 from __future__ import annotations
 
@@ -36,9 +47,17 @@ STEP_PHASES = ("data_wait", "forward", "backward", "update", "metric",
 def load_trace(path: str) -> Tuple[List[dict], List[dict], Optional[dict]]:
     """Parse chrome-trace JSON or a JSONL stream into (spans, instants,
     metrics). Spans/instants are normalized to seconds-based dicts:
-    {"name", "ts", "dur", "tid", "args"}."""
+    {"name", "ts", "dur", "tid", "pid", "args"}."""
+    spans, instants, metrics, _ = load_trace_meta(path)
+    return spans, instants, metrics
+
+
+def load_trace_meta(path: str):
+    """``load_trace`` plus the file's merge metadata: ``{"pid",
+    "wall_epoch"}`` (either may be None on old captures)."""
     with open(path) as f:
         text = f.read()
+    meta = {"pid": None, "wall_epoch": None}
     # chrome traces are one JSON document with "traceEvents"; JSONL lines
     # each start with "{" too, so try the whole-document parse first
     try:
@@ -54,14 +73,18 @@ def load_trace(path: str) -> Tuple[List[dict], List[dict], Optional[dict]]:
                               "ts": ev.get("ts", 0.0) / 1e6,
                               "dur": ev.get("dur", 0.0) / 1e6,
                               "tid": ev.get("tid"),
+                              "pid": ev.get("pid"),
                               "args": ev.get("args") or {}})
             elif ph == "i":
                 instants.append({"name": ev["name"],
                                  "ts": ev.get("ts", 0.0) / 1e6,
                                  "tid": ev.get("tid"),
+                                 "pid": ev.get("pid"),
                                  "args": ev.get("args") or {}})
-        metrics = (doc.get("otherData") or {}).get("metrics")
-        return spans, instants, metrics
+        other = doc.get("otherData") or {}
+        meta["pid"] = other.get("pid")
+        meta["wall_epoch"] = other.get("wall_epoch")
+        return spans, instants, other.get("metrics"), meta
     # JSONL stream: one event per line, ts/dur already in seconds
     spans, instants, metrics = [], [], None
     for line in text.splitlines():
@@ -77,14 +100,20 @@ def load_trace(path: str) -> Tuple[List[dict], List[dict], Optional[dict]]:
             spans.append({"name": ev["name"], "ts": ev.get("ts", 0.0),
                           "dur": ev.get("dur", 0.0),
                           "tid": ev.get("tid"),
+                          "pid": ev.get("pid"),
                           "args": ev.get("args") or {}})
         elif ph == "i":
             instants.append({"name": ev["name"], "ts": ev.get("ts", 0.0),
                              "tid": ev.get("tid"),
+                             "pid": ev.get("pid"),
                              "args": ev.get("args") or {}})
-        elif ph == "M" and "metrics" in ev:
-            metrics = ev["metrics"]
-    return spans, instants, metrics
+        elif ph == "M":
+            if "metrics" in ev:
+                metrics = ev["metrics"]
+            if ev.get("name") == "clock":  # the stream's first record
+                meta["pid"] = ev.get("pid", meta["pid"])
+                meta["wall_epoch"] = ev.get("wall_epoch")
+    return spans, instants, metrics, meta
 
 
 def phase_breakdown(spans: List[dict]) -> List[dict]:
@@ -112,20 +141,132 @@ def phase_breakdown(spans: List[dict]) -> List[dict]:
     return rows
 
 
-def report(path: str, top: int = 10) -> dict:
+def merge_loaded(loaded: List[tuple]) -> tuple:
+    """Merge N ``load_trace_meta`` results onto per-pid lanes, rebased via
+    each file's wall-clock anchor. Returns ``(spans, instants, metrics,
+    lanes, clock_note)`` — ``clock_note`` is None only when EVERY file
+    carried an anchor (cross-file timestamps are then trustworthy)."""
+    anchors = [m["wall_epoch"] for *_rest, m in loaded
+               if m["wall_epoch"] is not None]
+    base = min(anchors) if anchors else 0.0
+    missing = [i for i, (*_r, m) in enumerate(loaded)
+               if m["wall_epoch"] is None]
+    spans, instants, lanes = [], [], {}
+    metrics_parts = []
+    metric_pids = set()
+    for i, (sp, ins, met, meta) in enumerate(loaded):
+        off = ((meta["wall_epoch"] - base)
+               if meta["wall_epoch"] is not None else 0.0)
+        # lane key: the file's pid (per-event pid wins when present —
+        # a chrome file may already be a merge), else a synthetic lane
+        fallback_pid = meta["pid"] if meta["pid"] is not None \
+            else f"file{i}"
+        n = 0
+        for ev in sp:
+            ev = dict(ev, ts=ev["ts"] + off,
+                      pid=ev.get("pid") or fallback_pid)
+            spans.append(ev)
+            n += 1
+        for ev in ins:
+            ev = dict(ev, ts=ev["ts"] + off,
+                      pid=ev.get("pid") or fallback_pid)
+            instants.append(ev)
+            n += 1
+        lanes[str(fallback_pid)] = {"file_index": i, "events": n,
+                                    "wall_epoch": meta["wall_epoch"]}
+        # one registry per PROCESS: two files from one pid (a JSONL stream
+        # plus an export, say) snapshot the same registry — summing both
+        # copies would double every count
+        if met and (meta["pid"] is None or meta["pid"] not in metric_pids):
+            if meta["pid"] is not None:
+                metric_pids.add(meta["pid"])
+            metrics_parts.append(met)
+    spans.sort(key=lambda e: e["ts"])
+    instants.sort(key=lambda e: e["ts"])
+    if metrics_parts:
+        if len(metrics_parts) == 1:
+            metrics = metrics_parts[0]
+        else:  # fold fleet members' registries into one table
+            try:
+                from mxnet_tpu.obs.export import merge_metrics
+                metrics = merge_metrics(metrics_parts)
+            except ImportError:  # parser-only environment: first wins
+                metrics = metrics_parts[0]
+    else:
+        metrics = None
+    note = None
+    if missing and len(loaded) > 1:
+        note = (f"{len(missing)} of {len(loaded)} inputs carry no "
+                "wall-clock anchor; their lanes are pinned at the shared "
+                "origin — cross-file ordering is approximate (clock skew "
+                "unbounded)")
+    return spans, instants, metrics, lanes, note
+
+
+def report(paths, top: int = 10, _loaded=None) -> dict:
     """Build the full report as data (the CLI renders it; tests assert on
-    it)."""
-    spans, instants, metrics = load_trace(path)
+    it). ``paths``: one path or a list — multiple inputs merge onto
+    per-pid lanes (see module doc)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    loaded = _loaded if _loaded is not None \
+        else [load_trace_meta(p) for p in paths]
+    spans, instants, metrics, lanes, note = merge_loaded(loaded)
     out = {
-        "trace": path,
+        "trace": paths[0] if len(paths) == 1 else list(paths),
         "n_spans": len(spans),
         "n_events": len(instants),
+        "lanes": lanes,
+        "clock_note": note,
         "phases": phase_breakdown(spans),
         "top_spans": sorted(spans, key=lambda s: -s["dur"])[:top],
         "events": instants,
         "metrics": metrics,
     }
     return out
+
+
+def merged_chrome(paths, _loaded=None) -> dict:
+    """The merged timeline as one chrome-trace document (``--chrome-out``):
+    a process lane per pid, thread tracks inside, clock-anchored."""
+    loaded = _loaded if _loaded is not None \
+        else [load_trace_meta(p) for p in paths]
+    spans, instants, metrics, lanes, note = merge_loaded(loaded)
+    events = []
+    seen = set()
+    # synthetic lanes (anchor-less files with no recorded pid) get
+    # deterministic ids far above any real pid — str hashes randomize per
+    # interpreter run and could collide with a genuine pid's lane
+    synthetic: dict = {}
+    for ev in spans + instants:
+        pid = ev.get("pid")
+        if isinstance(pid, int):
+            pid_num = pid
+        else:
+            pid_num = synthetic.setdefault(pid, 10_000_000 + len(synthetic))
+        if pid_num not in seen:
+            seen.add(pid_num)
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid_num, "tid": 0,
+                           "args": {"name": f"pid {pid}"}})
+        out = {"name": ev["name"], "pid": pid_num, "tid": ev.get("tid", 0),
+               "ts": ev["ts"] * 1e6}
+        if "dur" in ev:
+            out["ph"] = "X"
+            out["dur"] = ev["dur"] * 1e6
+        else:
+            out["ph"] = "i"
+            out["s"] = "t"
+        if ev.get("args"):
+            out["args"] = ev["args"]
+        events.append(out)
+    other = {"lanes": lanes}
+    if note:
+        other["clock_note"] = note
+    if metrics:
+        other["metrics"] = metrics
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
 
 
 def _fmt_s(sec: float) -> str:
@@ -137,8 +278,19 @@ def _fmt_s(sec: float) -> str:
 def render(rep: dict, stream=None) -> None:
     out = stream or sys.stdout
     w = out.write
-    w(f"trace: {rep['trace']}  "
-      f"({rep['n_spans']} spans, {rep['n_events']} events)\n\n")
+    trace = rep["trace"]
+    if isinstance(trace, list):
+        trace = f"{len(trace)} files merged"
+    w(f"trace: {trace}  "
+      f"({rep['n_spans']} spans, {rep['n_events']} events)\n")
+    lanes = rep.get("lanes") or {}
+    if len(lanes) > 1:
+        w("lanes: " + ", ".join(
+            f"pid {p} ({info['events']} ev)"
+            for p, info in sorted(lanes.items())) + "\n")
+    if rep.get("clock_note"):
+        w(f"NOTE: {rep['clock_note']}\n")
+    w("\n")
 
     w("Per-phase breakdown:\n")
     w(f"  {'Phase':<28}{'Count':>7}{'Total':>12}{'Avg':>12}"
@@ -180,13 +332,24 @@ def render(rep: dict, stream=None) -> None:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="trace.json (chrome) or events.jsonl")
+    ap.add_argument("trace", nargs="+",
+                    help="trace.json (chrome) and/or events.jsonl — "
+                         "multiple inputs merge onto per-pid lanes")
     ap.add_argument("--top", type=int, default=10,
                     help="how many individual spans to list")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of tables")
+    ap.add_argument("--chrome-out", default=None,
+                    help="also write the merged timeline as one "
+                         "Perfetto-loadable chrome trace")
     args = ap.parse_args(argv)
-    rep = report(args.trace, top=args.top)
+    loaded = [load_trace_meta(p) for p in args.trace]  # parse each ONCE
+    rep = report(args.trace, top=args.top, _loaded=loaded)
+    if args.chrome_out:
+        with open(args.chrome_out, "w") as f:
+            json.dump(merged_chrome(args.trace, _loaded=loaded), f,
+                      default=str)
+        sys.stderr.write(f"merged chrome trace -> {args.chrome_out}\n")
     if args.json:
         json.dump(rep, sys.stdout, indent=2, default=str)
         sys.stdout.write("\n")
