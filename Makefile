@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve perf obs serve serve-bench
+.PHONY: lint lint-tests test test-fast chaos chaos-serve perf obs serve serve-bench dossier
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -52,6 +52,17 @@ perf:
 obs:
 	$(PYTHON) -m pytest tests/ -q -m obs -p no:cacheprovider
 	$(PYTHON) tools/serve_bench.py --obs-overhead --duration 4
+
+# perf-regression dossier (docs/PERFORMANCE.md "Perf-regression dossier"):
+# the device-plane perf gates (memory steady state, regression
+# classification, dispatch bound with cost capture on), then
+# bench_compare over the committed BENCH_r*.json trajectory. The CLI exits
+# 2 on regressions/anomalies and 3 on platform gaps — expected against
+# the committed history (r05 outage, r04 bf16-piped inversion), so the
+# report is informational here; CI gates on the pytest half.
+dossier:
+	$(PYTHON) -m pytest tests/test_device_obs.py -q -m perf -p no:cacheprovider
+	-$(PYTHON) tools/bench_compare.py
 
 # serving suite: compiled engine program bound, SLO scheduler, endpoint
 # lifecycle + chaos degradation (docs/SERVING.md)

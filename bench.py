@@ -30,7 +30,59 @@ import time
 import numpy as np
 
 BASELINE_IMG_PER_SEC_PER_GPU = 385.0
+# mirrored in obs/device.py _DEFAULT_PEAKS["tpu"] — keep in sync (this
+# file defers all framework imports for outage-proofing, so no import)
 NOMINAL_V5E_BF16_TFLOPS = 197.0
+NOMINAL_V5E_HBM_GBPS = 819.0
+
+
+class _device_cost_capture:
+    """Force obs.device program-cost capture (MXNET_DEVICE_COST=1) for a
+    leg without enabling span telemetry — the XLA cost analysis rides the
+    one step compile, zero per-step overhead. Restores the prior setting."""
+
+    def __enter__(self):
+        self._prev = os.environ.get("MXNET_DEVICE_COST")
+        os.environ["MXNET_DEVICE_COST"] = "1"
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            os.environ.pop("MXNET_DEVICE_COST", None)
+        else:
+            os.environ["MXNET_DEVICE_COST"] = self._prev
+
+
+def _attach_step_cost(leg: dict, trainer, sec: float) -> None:
+    """Fold the trainer's captured step-program cost record into a bench
+    leg: the XLA-counted FLOP rate ("analytic") beside the hand-model
+    rate, plus the raw cost fields the dossier/report can audit."""
+    cost = getattr(trainer, "step_cost", None)
+    if not cost or not cost.get("flops"):
+        return
+    leg["device_cost"] = {k: cost.get(k, 0) for k in
+                          ("flops", "bytes_accessed", "peak_hbm_bytes")}
+    # 4 significant digits, not fixed decimals — a CPU smoke run's
+    # micro-TFLOP rate must not round to a falsy 0.0
+    leg["analytic_tflops"] = float(f"{cost['flops'] / sec / 1e12:.4g}")
+
+
+def _annotate_analytic(leg: dict, peak_tflops: float) -> None:
+    """extra.*_analytic_mfu / extra.*_roofline: analytic MFU against the
+    same measured-peak denominator as the measured MFU it sits next to,
+    and the roofline class (compute- vs bandwidth-bound) of the step
+    program — the attribution ROADMAP item 3's open MFU questions need."""
+    from mxnet_tpu.obs import device as obs_device
+
+    cost = leg.get("device_cost")
+    at = leg.get("analytic_tflops")
+    if not cost or not at or not peak_tflops:
+        return
+    leg["analytic_mfu"] = float(f"{at / peak_tflops:.4g}")
+    rl = obs_device.roofline_class(cost, peak_tflops=peak_tflops,
+                                   peak_gbps=NOMINAL_V5E_HBM_GBPS)
+    if rl:
+        leg["roofline"] = rl["bound"]
+        leg["intensity_flop_per_byte"] = rl["intensity_flop_per_byte"]
 
 # Round-2's 802 img/s fp32 was measured on a silently-wrong program: a
 # deferred-shape capture bug froze every BatchNorm gamma/beta/stat as an XLA
@@ -394,10 +446,11 @@ def bench_bert(platform):
     rng = np.random.RandomState(0)
     x = nd.array(rng.randint(0, vocab, (batch, seq)).astype(np.int32))
     net(x)
-    sec, spread = _time_steps(trainer, lambda i: (x, x), steps, warmup,
-                              n_runs=_n_runs(platform))
+    with _device_cost_capture():
+        sec, spread = _time_steps(trainer, lambda i: (x, x), steps, warmup,
+                                  n_runs=_n_runs(platform))
     flops = _bert_train_flops(12, 768, 3072, vocab, seq, batch)
-    return {
+    out = {
         "seq_per_sec": round(batch / sec, 2),
         "tokens_per_sec": round(batch * seq / sec, 1),
         "model_tflops": round(flops / sec / 1e12, 3),
@@ -406,6 +459,8 @@ def bench_bert(platform):
         "n_runs": _n_runs(platform),
         "spread": round(spread, 3),
     }
+    _attach_step_cost(out, trainer, sec)
+    return out
 
 
 def _lm_train_flops(n_layers, units, hidden, vocab, seq, batch):
@@ -528,11 +583,13 @@ def bench_lm_long(platform):
                 grad_accum=int(os.environ.get("BENCH_LM_ACCUM", 1)))
             xd = nd.array(x)
             net(xd)
-            sec, spread = _time_steps(trainer, lambda i: (xd, xd), steps,
-                                      warmup, n_runs=_n_runs(platform))
+            with _device_cost_capture():
+                sec, spread = _time_steps(trainer, lambda i: (xd, xd), steps,
+                                          warmup, n_runs=_n_runs(platform))
             out[impl] = {"tokens_per_sec": round(batch * seq / sec, 1),
                          "model_tflops": round(flops / sec / 1e12, 3),
                          "spread": round(spread, 3)}
+            _attach_step_cost(out[impl], trainer, sec)
         except Exception as e:
             out[f"{impl}_error"] = f"{type(e).__name__}: {e}"[:200]
         finally:
@@ -632,8 +689,18 @@ def main():
             extra["resnet50_piped_bf16_breakdown"] = piped_bf
         except Exception as e:
             extra["resnet50_piped_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
+    # the measured-peak denominator, shared with the lm legs — probed in
+    # its own guard so a bert-leg failure can't strip the LM analytic-MFU
+    # columns of a successfully measured peak
+    peak_eff = None
     try:
         peak = _measure_matmul_peak()
+    except Exception as e:
+        peak = float("nan")
+        extra["matmul_probe_error"] = f"{type(e).__name__}: {e}"[:200]
+    if np.isfinite(peak):
+        peak_eff = min(peak, NOMINAL_V5E_BF16_TFLOPS)
+    try:
         bert = bench_bert(platform)
         # chip throughput drifts run-to-run (~±20% observed); a sustained
         # model rate is itself a lower bound on peak, so the MFU denominator
@@ -654,11 +721,22 @@ def main():
             bert["model_tflops"] / peak_eff, 4)
         bert["mfu_vs_nominal_v5e"] = round(
             bert["model_tflops"] / NOMINAL_V5E_BF16_TFLOPS, 4)
+        # device-plane attribution (obs/device.py): the XLA-counted FLOP
+        # rate as analytic_mfu + the step program's roofline class, same
+        # measured-peak denominator as mfu_vs_measured_peak beside it
+        from mxnet_tpu.obs import device as obs_device
+
+        obs_device.set_peak(tflops=peak_eff, gbps=NOMINAL_V5E_HBM_GBPS)
+        _annotate_analytic(bert, peak_eff)
         extra["bert_base_bf16"] = bert
     except Exception as e:
         extra["bert_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
-        extra["lm_seq2048_bf16"] = bench_lm_long(platform)
+        lm = bench_lm_long(platform)
+        for _impl in ("flash", "plain"):
+            if isinstance(lm.get(_impl), dict) and peak_eff:
+                _annotate_analytic(lm[_impl], peak_eff)
+        extra["lm_seq2048_bf16"] = lm
     except Exception as e:
         extra["lm_seq2048_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
@@ -702,6 +780,8 @@ def main():
                 res = bench_lm_long(platform)
                 if "flash" in res:
                     res["grad_accum"] = int(acc_)
+                    if peak_eff:
+                        _annotate_analytic(res["flash"], peak_eff)
                     extra["lm_seq4096_bf16"] = res
                     break
                 extra[f"lm_seq4096_attempt_b{b_}_acc{acc_}_error"] = \

@@ -10,12 +10,14 @@ function. BatchNorm moving stats thread through as explicit aux outputs
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import obs
 from .base import MXNetError
 from .context import Context, current_context
 from .ndarray import NDArray
@@ -23,6 +25,10 @@ from .ops import get_op
 from .ops.registry import coerce_kwargs
 
 __all__ = ["Executor"]
+
+
+def _avals_sig(vals) -> tuple:
+    return tuple((tuple(v.shape), str(v.dtype)) for v in vals)
 
 
 def _build_graph_fn(sym, train: bool):
@@ -196,6 +202,15 @@ class Executor:
         self._jit_cache: Dict = {}
         self._vjp = None
         self._last_inputs = None
+        # device-plane program accounting (obs/device.py), populated only
+        # while capture is active (zero-cost-when-off): one entry per
+        # distinct (site, input signature) compile, carrying XLA
+        # flops/bytes/HBM; the signature's AOT executable replaces the
+        # jit wrapper for execution
+        self.compile_log: List[dict] = []
+        self._seen_sigs: set = set()
+        self._aot: Dict = {}
+        self._sig_cost: Dict = {}
 
     # ------------------------------------------------------------------
     @property
@@ -211,6 +226,26 @@ class Executor:
                 self.aux_dict[k]._set_data(NDArray(v)._data)
 
     # ------------------------------------------------------------------
+    def _device_account(self, site: str, jitted, call_args, sig):
+        """Device-plane bookkeeping shared by forward and backward: on a
+        signature's first sighting (and capture active) AOT-compile once —
+        cost/memory analysis into ``compile_log``, the executable into the
+        AOT cache. Returns ``(fn_to_call, is_compile)``."""
+        is_compile = sig not in self._seen_sigs
+        if is_compile:
+            self._seen_sigs.add(sig)
+            if obs.device.active():
+                entry = {"site": site, "train": sig[1], "avals": sig[2]}
+                compiled, cost = obs.device.capture(
+                    jitted, call_args, site="executor", label=site)
+                if compiled is not None:
+                    self._aot[sig] = compiled
+                if cost:
+                    entry.update(cost)
+                    self._sig_cost[sig] = cost
+                self.compile_log.append(entry)
+        return self._aot.get(sig, jitted), is_compile
+
     def _get_fn(self, train: bool):
         key = train
         if key not in self._jit_cache:
@@ -249,7 +284,32 @@ class Executor:
 
         if _profiler.counting_dispatches():
             _profiler.count_dispatch("compiled")
-        outs, new_aux = jitted(key_data, arg_vals, aux_vals)
+        rec = obs.enabled()
+        t0 = time.monotonic() if rec else 0.0
+        # device-plane accounting only when capture is active (or produced
+        # an AOT executable earlier): the disabled hot path must not pay
+        # the per-call aval-signature build (zero-cost-when-off contract)
+        fn, sig, is_compile = jitted, None, False
+        if obs.device.active() or self._aot:
+            sig = ("forward", bool(is_train), _avals_sig(arg_vals),
+                   _avals_sig(aux_vals))
+            fn, is_compile = self._device_account(
+                "forward", jitted, (key_data, arg_vals, aux_vals), sig)
+        with obs.trace.span("device.forward", train=bool(is_train),
+                            compile=is_compile) as sp:
+            outs, new_aux = fn(key_data, arg_vals, aux_vals)
+            cost = self._sig_cost.get(sig) if rec and not is_compile \
+                else None
+            if cost:
+                # block before timing: on async backends the call above
+                # returns futures, and attributing MFU to dispatch latency
+                # would be meaningless — accurate device timing costs the
+                # overlap, the same NaiveEngine-style trade the profiler's
+                # aggregate_stats makes (docs/OBSERVABILITY.md). Only paid
+                # when there IS a cost record to attribute.
+                jax.block_until_ready((outs, new_aux))
+                obs.device.annotate_span(sp, "forward",
+                                         time.monotonic() - t0, cost)
         if is_train and self._grad_req != "null":
             # backward replays the same RNG key → identical dropout masks
             self._last_inputs = (key_data, arg_vals, aux_vals, bool(is_train))
@@ -297,7 +357,24 @@ class Executor:
 
         if _profiler.counting_dispatches():
             _profiler.count_dispatch("compiled")
-        grads = self._get_grad_fn(train)(key_data, arg_vals, aux_vals, cot)
+        grad_fn = self._get_grad_fn(train)
+        rec = obs.enabled()
+        t0 = time.monotonic() if rec else 0.0
+        fn, sig, is_compile = grad_fn, None, False
+        if obs.device.active() or self._aot:
+            sig = ("backward", bool(train), _avals_sig(arg_vals),
+                   _avals_sig(cot))
+            fn, is_compile = self._device_account(
+                "backward", grad_fn, (key_data, arg_vals, aux_vals, cot),
+                sig)
+        with obs.trace.span("device.backward", compile=is_compile) as sp:
+            grads = fn(key_data, arg_vals, aux_vals, cot)
+            cost = self._sig_cost.get(sig) if rec and not is_compile \
+                else None
+            if cost:
+                jax.block_until_ready(grads)  # see forward: honest MFU
+                obs.device.annotate_span(sp, "backward",
+                                         time.monotonic() - t0, cost)
         for n, g in zip(self._arg_names, grads):
             if n in self.grad_dict and g is not None:
                 if self._grad_req == "add":

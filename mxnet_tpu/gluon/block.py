@@ -499,6 +499,9 @@ class CachedOp:
     def __init__(self, block: HybridBlock):
         self.block = block
         self._cache = {}
+        # device-plane accounting (obs/device.py): one entry per compiled
+        # cache entry, carrying XLA flops/bytes/HBM when capture is active
+        self.compile_log = []
 
     def __call__(self, *inputs):
         flat_in, fmt = _flatten_nds(inputs)
@@ -590,13 +593,47 @@ class CachedOp:
         entry = {"opdef": opdef, "aux_param_idx": aux_param_idx,
                  "out_fmt": out_fmt_holder, "n_out": None}
 
-        # Wrap fn so first execution finalizes n_out/num_outputs metadata.
+        # Wrap fn so first execution finalizes n_out/num_outputs metadata
+        # (and, when device capture is active, AOT-compiles once for cost
+        # accounting and keeps that executable for later calls).
+        aot = {"compiled": None, "logged": False}
+
         def finalizing_fn(*vals, **kw):
+            from .. import obs as _obs
             from .. import profiler as _profiler
 
             if _profiler.counting_dispatches():
                 _profiler.count_dispatch("compiled")
-            res = jitted(*vals, **kw)
+            # Device-plane accounting only when capture is active (or
+            # already produced an executable) — the disabled hot path must
+            # not pay the per-call scans. A nested hybridized block's
+            # CachedOp runs INSIDE its parent's trace: tracer args can't
+            # feed an AOT executable (and there is no standalone program
+            # to account), so only concrete calls capture/log and tracer
+            # calls inline through the jit wrapper.
+            fn = jitted
+            if aot["compiled"] is not None or \
+                    (not aot["logged"] and _obs.device.active()):
+                concrete = not any(isinstance(v, jax.core.Tracer)
+                                   for v in vals)
+                if concrete and not aot["logged"]:
+                    aot["logged"] = True
+                    log_entry = {"block": block.name, "train": train,
+                                 "avals": tuple(
+                                     (tuple(v.shape),
+                                      str(getattr(v, "dtype", "?")))
+                                     for v in vals)}
+                    compiled, cost = _obs.device.capture(
+                        jitted, vals, site="cachedop", label=block.name,
+                        kwargs=kw)
+                    if compiled is not None:
+                        aot["compiled"] = compiled
+                    if cost:
+                        log_entry.update(cost)
+                    self.compile_log.append(log_entry)
+                if concrete and aot["compiled"] is not None:
+                    fn = aot["compiled"]
+            res = fn(*vals, **kw)
             n_aux = len(aux_param_idx)
             entry["n_out"] = len(res) - n_aux
             return res

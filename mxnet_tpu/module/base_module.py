@@ -209,6 +209,10 @@ class BaseModule:
                     with obs.trace.span("update"):
                         self.update()
                     global_step += 1
+                    # live device memory, once per batch: the counter track
+                    # in the chrome trace + the steady-state leak detector
+                    # (one flag check when telemetry is off)
+                    obs.device.sample(step=global_step)
                     with obs.trace.span("metric"):
                         self.update_metric(eval_metric, data_batch.label)
                     if batch_end_callback:

@@ -40,10 +40,11 @@ from . import context, metrics, trace
 # resolves that through sys.modules, not the shadowed attribute)
 from . import export as export_mod
 from . import slo  # SLO monitor over merged telemetry
+from . import device  # device plane: XLA cost/memory accounting, MFU
 
-__all__ = ["trace", "metrics", "context", "export_mod", "slo", "enable",
-           "disable", "enabled", "span", "event", "inc", "observe",
-           "set_gauge", "export", "reset", "telemetry_part"]
+__all__ = ["trace", "metrics", "context", "export_mod", "slo", "device",
+           "enable", "disable", "enabled", "span", "event", "inc",
+           "observe", "set_gauge", "export", "reset", "telemetry_part"]
 
 # re-exported hot-path helpers (obs.span is obs.trace.span)
 span = trace.span
@@ -78,9 +79,11 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Clear the span ring buffer and drop every metric."""
+    """Clear the span ring buffer, drop every metric, and empty the
+    device-plane cost registry / leak-monitor state."""
     trace.reset()
     metrics.reset()
+    device.reset()
 
 
 # -- self-gating convenience helpers for instrumentation call sites --------

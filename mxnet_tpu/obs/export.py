@@ -232,7 +232,7 @@ def merge_chrome_parts(parts: Sequence[dict],
         tids = {}
         for ev in p.get("spans") or ():
             ph = ev.get("ph", "X")
-            if ph not in ("X", "i"):
+            if ph not in ("X", "i", "C"):
                 continue  # clock/metrics metadata records
             tid = ev.get("tid", 0)
             tids.setdefault(tid, len(tids))
@@ -240,8 +240,9 @@ def merge_chrome_parts(parts: Sequence[dict],
                    "tid": tid, "ts": (ev.get("ts", 0.0) + off) * 1e6}
             if ph == "X":
                 out["dur"] = (ev.get("dur") or 0.0) * 1e6
-            else:
+            elif ph == "i":
                 out["s"] = "t"
+            # "C" counter samples (device.live_bytes lane) carry args only
             if ev.get("args"):
                 out["args"] = dict(ev["args"])
             trace_events.append(out)

@@ -46,9 +46,9 @@ from typing import IO, List, Optional
 
 from . import context as _context
 
-__all__ = ["Tracer", "span", "event", "complete", "events", "reset",
-           "drain", "stream_to", "to_chrome_trace", "export_chrome_trace",
-           "tracer"]
+__all__ = ["Tracer", "span", "event", "counter", "complete", "events",
+           "reset", "drain", "stream_to", "to_chrome_trace",
+           "export_chrome_trace", "tracer"]
 
 # THE module flag: obs.enable()/disable() flip it; every instrumentation
 # entry point checks it first. Plain module global — one LOAD_GLOBAL on the
@@ -203,6 +203,15 @@ class Tracer:
                       threading.get_ident(), len(self._stack()),
                       attrs or None))
 
+    def counter(self, name: str, value: float) -> None:
+        """Record one sample of a counter track (a Perfetto counter lane —
+        ``device.live_bytes`` is the memory lane). Exported as a chrome
+        ``"C"`` event; ``tools/trace_report.py`` renders the series."""
+        if not _ENABLED:
+            return
+        self._record(("C", name, time.monotonic(), None,
+                      threading.get_ident(), 0, {"value": float(value)}))
+
     def complete(self, name: str, t_start: float, duration: float,
                  ctx=None, **attrs) -> None:
         """Record an already-measured span with an explicit start and
@@ -331,8 +340,9 @@ class Tracer:
                   "ts": (ts - self._epoch) * 1e6}
             if ph == "X":
                 ev["dur"] = (dur or 0.0) * 1e6
-            else:
+            elif ph == "i":
                 ev["s"] = "t"  # thread-scoped instant
+            # "C" counter samples carry only their args series
             if attrs:
                 ev["args"] = dict(attrs)
             trace_events.append(ev)
@@ -373,6 +383,12 @@ def span(name: str, **attrs):
 def event(name: str, **attrs) -> None:
     if _ENABLED:
         tracer.event(name, **attrs)
+
+
+def counter(name: str, value: float) -> None:
+    """Module-level passthrough to :meth:`Tracer.counter`."""
+    if _ENABLED:
+        tracer.counter(name, value)
 
 
 def complete(name: str, t_start: float, duration: float, ctx=None,

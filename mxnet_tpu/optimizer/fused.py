@@ -387,6 +387,7 @@ class FusedUpdateEngine:
         self._donate = _donate_default() if donate is None else bool(donate)
         self.exec_count = 0
         self.compile_log: List[dict] = []
+        self._costs: Dict = {}  # cache key -> device cost record
 
     # -- keys --------------------------------------------------------------
     _TRACED_ATTRS = frozenset({"lr", "rescale_grad", "num_update",
@@ -460,18 +461,36 @@ class FusedUpdateEngine:
                tuple(self._aval(x) for x in gs),
                tuple(tuple(self._aval(x) for x in lp) for lp in state_leaves),
                scaler_on, factor, window, cgn_on, self._donate)
+        _device = obs.device
+
+        rec = obs.enabled()
+        t0 = time.monotonic() if rec else 0.0
         jitted = self._cache.get(key)
         is_compile = jitted is None
         if is_compile:
             jitted = self._build(specs, mp, scaler_on, factor, window, cgn_on)
-            self._cache[key] = jitted
-            self.compile_log.append({
+            entry = {
                 "optimizer": type(opt).__name__,
                 "static": self._static_key(),
                 "avals": key[4],
                 "state_structure": specs,
                 "flags": (scaler_on, cgn_on),
-            })
+            }
+            if _device.active():
+                # ONE compile serves accounting and execution: the AOT
+                # executable replaces the jit wrapper in the cache, and its
+                # XLA cost/memory analyses land in this compile_log entry
+                compiled, cost = _device.capture(
+                    jitted, (ws, gs, state_leaves, lrs, wds, ts, rescale,
+                             scale, unskipped, cgn_val, extras),
+                    site="update", label=type(opt).__name__)
+                if compiled is not None:
+                    jitted = compiled
+                if cost:
+                    entry.update(cost)
+                    self._costs[key] = cost
+            self._cache[key] = jitted
+            self.compile_log.append(entry)
             # telemetry: every compile counts; a compile AFTER the first is
             # a retrace (something static churned — the TraceLinter's
             # update-retrace-churn rule diagnoses which component)
@@ -484,17 +503,26 @@ class FusedUpdateEngine:
         if profiler.counting_dispatches():
             profiler.count_dispatch("compiled")
             profiler.count_dispatch("h2d")  # the packed lr/wd/t hyper vectors
-        rec = obs.enabled()
-        t0 = time.monotonic() if rec else 0.0
         with obs.trace.span("update.fused", optimizer=type(opt).__name__,
-                            n_params=n, compile=is_compile):
+                            n_params=n, compile=is_compile) as sp:
             new_ws, new_flat, new_ex, scaler_out = jitted(
                 ws, gs, state_leaves, lrs, wds, ts, rescale, scale, unskipped,
                 cgn_val, extras)
+            cost = self._costs.get(key) if rec and not is_compile else None
+            if cost:
+                # analytic MFU + roofline on the executed program's span
+                # (compile calls excluded: their wall time is the
+                # compiler). Block first: on async backends the dispatch
+                # returns futures and MFU over dispatch latency would be
+                # meaningless — accurate attribution costs the overlap,
+                # the profiler aggregate_stats trade
+                jax.block_until_ready(new_ws)
+                _device.annotate_span(sp, "update", time.monotonic() - t0,
+                                      cost)
         if rec:
-            # first call traces+compiles (blocking); later calls only
-            # dispatch — on async backends this is dispatch wall time, not
-            # device time (docs/OBSERVABILITY.md)
+            # first call traces+compiles (blocking); later calls dispatch —
+            # wall time only, UNLESS a cost record made the attribution
+            # block above (then this is honest device time)
             obs.observe("update.compile_seconds" if is_compile
                         else "update.execute_seconds",
                         time.monotonic() - t0)

@@ -97,6 +97,12 @@ class ShardedTrainer:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
         self._t = 0
+        # XLA cost/memory record of the compiled step (obs/device.py),
+        # filled at first compile when device capture is active — the
+        # analytic-MFU numerator bench.py reports beside measured MFU;
+        # _aot_step holds (batch avals, AOT executable) for that signature
+        self.step_cost: Optional[Dict] = None
+        self._aot_step = None
         self._in_sh = batch_sharding(mesh, input_specs if isinstance(input_specs, P)
                                      else P(*input_specs))
         self._label_sh = batch_sharding(mesh, label_specs if isinstance(label_specs, P)
@@ -130,7 +136,17 @@ class ShardedTrainer:
         for n, p in self._params.items():
             sh = self.rules.sharding_for(n, mesh, p.data().shape)
             self._param_shardings[n] = sh
-            self.param_vals[n] = jax.device_put(p.data()._data, sh)
+            val = p.data()._data
+            if self._donate:
+                # donation consumes the step's param inputs, and a no-op
+                # device_put ALIASES val with the gluon parameter's own
+                # buffer — step 1 would then delete the parameter under
+                # gluon's feet (net() after step() raised "Array has been
+                # deleted"). A private copy keeps the donated generation
+                # exclusively the trainer's; sync_to_net() still writes
+                # trained weights back.
+                val = jnp.array(val, copy=True)
+            self.param_vals[n] = jax.device_put(val, sh)
         self.opt_state = {n: self._init_state(self.param_vals[n])
                           for n in self._grad_names}
         self._captured = True
@@ -284,13 +300,37 @@ class ShardedTrainer:
         vals = [b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch]
         vals = [jax.device_put(v, self._in_sh if i < len(vals) - 1 else self._label_sh)
                 for i, v in enumerate(vals)]
-        if self._step_fn is None:
-            self._step_fn = self._build(len(vals) - 1)
-        self._t += 1
         from .mesh import mesh_scope
 
+        if self._step_fn is None:
+            self._step_fn = self._build(len(vals) - 1)
+            from ..obs import device as _device
+
+            if _device.active():
+                # device-plane accounting (obs/device.py): AOT-compile the
+                # step ONCE inside the mesh scope — XLA flops/bytes/HBM into
+                # step_cost (bench.py's analytic-MFU source), the same
+                # executable kept for matching batches. Keyed by the batch
+                # avals: an AOT Compiled cannot retrace, so a later ragged
+                # batch must fall back to the jit wrapper, not crash
+                sig = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+                with mesh_scope(self.mesh):
+                    compiled, cost = _device.capture(
+                        self._step_fn,
+                        (self.param_vals, self.opt_state,
+                         jnp.float32(self._lr), jnp.float32(self._t + 1),
+                         *vals),
+                        site="train_step", label=type(self.net).__name__)
+                if compiled is not None:
+                    self._aot_step = (sig, compiled)
+                self.step_cost = cost
+        self._t += 1
+        step = self._step_fn
+        if self._aot_step is not None and self._aot_step[0] == tuple(
+                (tuple(v.shape), str(v.dtype)) for v in vals):
+            step = self._aot_step[1]
         with mesh_scope(self.mesh):  # attention layers pick sp/ring impls
-            loss, self.param_vals, self.opt_state = self._step_fn(
+            loss, self.param_vals, self.opt_state = step(
                 self.param_vals, self.opt_state, jnp.float32(self._lr),
                 jnp.float32(self._t), *vals)
         return NDArray(loss)

@@ -147,18 +147,40 @@ def set_config(**kwargs):
 
 
 def set_state(state="stop", profile_process="worker"):
+    """Start/stop the XLA trace. Idempotent both ways: a second "run" (or
+    a "run" racing a trace some other code started directly through
+    ``jax.profiler``) must never surface JAX's deep "trace already
+    started" RuntimeError to a training loop — we adopt the active trace
+    instead. Start/stop land as tagged obs events so profiler windows are
+    visible inside the span timeline (docs/OBSERVABILITY.md)."""
     if state in ("run", 1):
-        if not _state["running"]:
-            logdir = _config.get("filename", "profile.json")
-            trace_dir = logdir if os.path.isdir(logdir) else \
-                (os.path.splitext(logdir)[0] + "_trace")
-            os.makedirs(trace_dir, exist_ok=True)
-            jax.profiler.start_trace(trace_dir)
-            _state.update(running=True, dir=trace_dir)
-    elif state in ("stop", 0):
         if _state["running"]:
+            return  # double start: the window is already open
+        logdir = _config.get("filename", "profile.json")
+        trace_dir = logdir if os.path.isdir(logdir) else \
+            (os.path.splitext(logdir)[0] + "_trace")
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except RuntimeError as e:
+            # adopt ONLY the double-start case; any other RuntimeError is
+            # a genuine failure the caller must see (masking it would
+            # report a phantom profile window)
+            if "already" not in str(e).lower():
+                raise
+            warnings.warn(f"jax profiler already tracing ({e}); adopting "
+                          "the active trace window", stacklevel=2)
+        _state.update(running=True, dir=trace_dir)
+        _obs.event("profiler.start_trace", dir=trace_dir)
+    elif state in ("stop", 0):
+        if not _state["running"]:
+            return  # double stop: nothing open
+        try:
             jax.profiler.stop_trace()
-            _state["running"] = False
+        except RuntimeError as e:  # jax's trace died under us — still ours
+            warnings.warn(f"jax profiler stop: {e}", stacklevel=2)
+        _state["running"] = False
+        _obs.event("profiler.stop_trace", dir=_state.get("dir"))
     else:
         raise ValueError(f"invalid profiler state {state!r}")
 

@@ -95,6 +95,21 @@ _ENV_REGISTRY = {
                         "(reference with_seed())."),
     "MXNET_NO_NATIVE_BUILD": (None, "1 = never build/load the native C++ "
                               "components (PIL/python fallbacks)."),
+    # device-plane observability (obs/device.py, docs/OBSERVABILITY.md)
+    "MXNET_DEVICE_COST": (None, "1 = force XLA cost/memory capture at every "
+                          "compile choke point (0 = veto); default follows "
+                          "the obs telemetry flag."),
+    "MXNET_DEVICE_PEAK_TFLOPS": (None, "Peak compute rate used by analytic "
+                                 "MFU/roofline math (overrides the "
+                                 "per-backend nominal default)."),
+    "MXNET_DEVICE_PEAK_GBPS": (None, "Peak memory bandwidth for the "
+                               "roofline balance point."),
+    "MXNET_OBS_MEMORY": ("1", "0 = skip the per-batch device.live_bytes "
+                         "sampling even with telemetry on."),
+    "MXNET_DEVICE_LEAK_WINDOW": ("10", "Leak-detector sliding window "
+                                 "(samples)."),
+    "MXNET_DEVICE_LEAK_BYTES_PER_STEP": (str(1 << 20), "Leak-detector "
+                                         "slope threshold (bytes/step)."),
     # distributed (DMLC_* names kept for launcher compat)
     "DMLC_ROLE": (None, "worker|server|scheduler — set by tools/launch.py."),
     "DMLC_PS_ROOT_URI": (None, "Coordinator/PS host (reference ps-lite env)."),

@@ -250,6 +250,11 @@ class InferenceEngine:
         self.compile_log: List[dict] = []
         self._free_cache: Dict[tuple, tuple] = {}
         self.exec_count = 0
+        # device-plane accounting (obs/device.py): when capture is active a
+        # signature's program is AOT-compiled ONCE — the same executable is
+        # analyzed (flops/bytes/HBM into compile_log) and then executed
+        self._aot: Dict[tuple, object] = {}      # sig -> compiled executable
+        self._sig_cost: Dict[tuple, dict] = {}   # sig -> cost record
 
     # ------------------------------------------------------------------
     # properties / stats
@@ -374,13 +379,6 @@ class InferenceEngine:
         free_vals = self._free_vals(bucket, [a.shape for a in arrays])
         snapshot = self._params  # atomic: old-or-new, never mixed
 
-        is_compile = sig not in self._programs
-        if is_compile:
-            self.compile_log.append({
-                "sig": sig, "bucket": bucket,
-                "param_avals": self._param_avals,
-                "version_at_compile": snapshot.version,
-            })
         if profiler.counting_dispatches():
             profiler.count_dispatch("compiled")
             profiler.count_dispatch("h2d", len(arrays))
@@ -393,10 +391,43 @@ class InferenceEngine:
             arg_vals[slot] = v
         rec = obs.enabled()
         t0 = time.monotonic() if rec else 0.0
+        is_compile = sig not in self._programs
+        if is_compile:
+            entry = {
+                "sig": sig, "bucket": bucket,
+                "param_avals": self._param_avals,
+                "version_at_compile": snapshot.version,
+            }
+            if obs.device.active():
+                # one AOT compile per signature: cost/memory analysis into
+                # the compile_log entry, the executable into the sig cache
+                # (params stay traced arguments — reload still swaps arrays
+                # without touching the program)
+                compiled, cost = obs.device.capture(
+                    self._jitted,
+                    (self._rng_data, arg_vals, list(snapshot.aux_vals)),
+                    site="serve", label=f"bucket{bucket}")
+                if compiled is not None:
+                    self._aot[sig] = compiled
+                if cost:
+                    entry.update(cost)
+                    self._sig_cost[sig] = cost
+            self.compile_log.append(entry)
+        fn = self._aot.get(sig, self._jitted)
         with obs.trace.span("serve.execute", bucket=bucket, rows=n_valid,
-                            compile=is_compile, version=snapshot.version):
-            outs, _new_aux = self._jitted(self._rng_data, arg_vals,
-                                          list(snapshot.aux_vals))
+                            compile=is_compile,
+                            version=snapshot.version) as sp:
+            outs, _new_aux = fn(self._rng_data, arg_vals,
+                                list(snapshot.aux_vals))
+            cost = self._sig_cost.get(sig) if rec and not is_compile \
+                else None
+            if cost:
+                # MFU over device work only (block, no D2H yet) so the
+                # serve phase is comparable with forward/backward/update;
+                # the span itself still covers the host materialization
+                jax.block_until_ready(outs)
+                obs.device.annotate_span(sp, "serve.execute",
+                                         time.monotonic() - t0, cost)
             # materialize on host: the wire sends numpy, and an unwaited
             # future would let the execute span under-report real latency
             host = jax.device_get(list(outs))
@@ -411,6 +442,7 @@ class InferenceEngine:
                 obs.observe("serve.execute_seconds", dt)
             obs.inc("serve.rows_executed", n_valid)
             obs.inc("serve.rows_padding", bucket - n_valid)
+            obs.device.sample()  # live-HBM counter track, per batch
         self._programs[sig] = self._programs.get(sig, 0) + 1
         self.exec_count += 1
         return ([np.asarray(o)[:n_valid] if np.ndim(o) else np.asarray(o)

@@ -51,6 +51,12 @@ def jsonl_to_part(path: str) -> dict:
         events.append(dict(ev, ph="X"))
     for ev in instants:
         events.append(dict(ev, ph="i"))
+    for ev in meta.get("counters") or ():
+        # counter-track samples (the device.live_bytes memory lane) — a
+        # leak-before-OOM-kill corpse's most valuable evidence
+        events.append({"ph": "C", "name": ev["name"], "ts": ev["ts"],
+                       "tid": ev.get("tid"),
+                       "args": {"value": ev.get("value", 0)}})
     events.sort(key=lambda e: e.get("ts", 0.0))
     return {"pid": meta.get("pid"), "role": f"jsonl:{path.rsplit('/',1)[-1]}",
             "wall_epoch": meta.get("wall_epoch"),

@@ -10,8 +10,11 @@ and prints:
 1. the per-phase time breakdown — every span name aggregated
    (count / total / mean / max / % of wall), step phases first;
 2. the top-N individual spans by duration (where did the spikes go);
-3. tagged instant events (chaos injections, RPC retries, preemptions);
-4. the metrics table (counters / gauges / histograms) embedded in the
+3. counter tracks (``"C"`` events — the ``device.live_bytes`` memory
+   lane) and the top-N-programs-by-device-cost table from the
+   ``device.compile`` events the compile choke points emit;
+4. tagged instant events (chaos injections, RPC retries, preemptions);
+5. the metrics table (counters / gauges / histograms) embedded in the
    trace (`otherData.metrics` in chrome traces, the final ``"ph": "M"``
    record in JSONL streams).
 
@@ -54,10 +57,12 @@ def load_trace(path: str) -> Tuple[List[dict], List[dict], Optional[dict]]:
 
 def load_trace_meta(path: str):
     """``load_trace`` plus the file's merge metadata: ``{"pid",
-    "wall_epoch"}`` (either may be None on old captures)."""
+    "wall_epoch", "counters"}`` (pid/wall_epoch may be None on old
+    captures; counters are ``"C"`` counter-track samples — the
+    ``device.live_bytes`` memory lane)."""
     with open(path) as f:
         text = f.read()
-    meta = {"pid": None, "wall_epoch": None}
+    meta = {"pid": None, "wall_epoch": None, "counters": []}
     # chrome traces are one JSON document with "traceEvents"; JSONL lines
     # each start with "{" too, so try the whole-document parse first
     try:
@@ -81,6 +86,13 @@ def load_trace_meta(path: str):
                                  "tid": ev.get("tid"),
                                  "pid": ev.get("pid"),
                                  "args": ev.get("args") or {}})
+            elif ph == "C":
+                args = ev.get("args") or {}
+                meta["counters"].append({
+                    "name": ev["name"], "ts": ev.get("ts", 0.0) / 1e6,
+                    "tid": ev.get("tid"), "pid": ev.get("pid"),
+                    "value": args.get("value",
+                                      next(iter(args.values()), None))})
         other = doc.get("otherData") or {}
         meta["pid"] = other.get("pid")
         meta["wall_epoch"] = other.get("wall_epoch")
@@ -107,6 +119,13 @@ def load_trace_meta(path: str):
                              "tid": ev.get("tid"),
                              "pid": ev.get("pid"),
                              "args": ev.get("args") or {}})
+        elif ph == "C":
+            args = ev.get("args") or {}
+            meta["counters"].append({
+                "name": ev["name"], "ts": ev.get("ts", 0.0),
+                "tid": ev.get("tid"), "pid": ev.get("pid"),
+                "value": args.get("value",
+                                  next(iter(args.values()), None))})
         elif ph == "M":
             if "metrics" in ev:
                 metrics = ev["metrics"]
@@ -144,14 +163,15 @@ def phase_breakdown(spans: List[dict]) -> List[dict]:
 def merge_loaded(loaded: List[tuple]) -> tuple:
     """Merge N ``load_trace_meta`` results onto per-pid lanes, rebased via
     each file's wall-clock anchor. Returns ``(spans, instants, metrics,
-    lanes, clock_note)`` — ``clock_note`` is None only when EVERY file
-    carried an anchor (cross-file timestamps are then trustworthy)."""
+    lanes, clock_note, counters)`` — ``clock_note`` is None only when
+    EVERY file carried an anchor (cross-file timestamps are then
+    trustworthy); ``counters`` are the merged counter-track samples."""
     anchors = [m["wall_epoch"] for *_rest, m in loaded
                if m["wall_epoch"] is not None]
     base = min(anchors) if anchors else 0.0
     missing = [i for i, (*_r, m) in enumerate(loaded)
                if m["wall_epoch"] is None]
-    spans, instants, lanes = [], [], {}
+    spans, instants, counters, lanes = [], [], [], {}
     metrics_parts = []
     metric_pids = set()
     for i, (sp, ins, met, meta) in enumerate(loaded):
@@ -172,6 +192,11 @@ def merge_loaded(loaded: List[tuple]) -> tuple:
                       pid=ev.get("pid") or fallback_pid)
             instants.append(ev)
             n += 1
+        for ev in meta.get("counters") or ():
+            ev = dict(ev, ts=ev["ts"] + off,
+                      pid=ev.get("pid") or fallback_pid)
+            counters.append(ev)
+            n += 1
         lanes[str(fallback_pid)] = {"file_index": i, "events": n,
                                     "wall_epoch": meta["wall_epoch"]}
         # one registry per PROCESS: two files from one pid (a JSONL stream
@@ -183,6 +208,7 @@ def merge_loaded(loaded: List[tuple]) -> tuple:
             metrics_parts.append(met)
     spans.sort(key=lambda e: e["ts"])
     instants.sort(key=lambda e: e["ts"])
+    counters.sort(key=lambda e: e["ts"])
     if metrics_parts:
         if len(metrics_parts) == 1:
             metrics = metrics_parts[0]
@@ -200,7 +226,43 @@ def merge_loaded(loaded: List[tuple]) -> tuple:
                 "wall-clock anchor; their lanes are pinned at the shared "
                 "origin — cross-file ordering is approximate (clock skew "
                 "unbounded)")
-    return spans, instants, metrics, lanes, note
+    return spans, instants, metrics, lanes, note, counters
+
+
+def counter_tracks(counters: List[dict]) -> List[dict]:
+    """Aggregate counter samples per track name: sample count, min / max /
+    last value — the terminal view of the Perfetto memory lane."""
+    agg = {}
+    for c in counters:
+        v = c.get("value")
+        if v is None:
+            continue
+        ent = agg.setdefault(c["name"], {"name": c["name"], "samples": 0,
+                                         "min": v, "max": v, "last": v})
+        ent["samples"] += 1
+        ent["min"] = min(ent["min"], v)
+        ent["max"] = max(ent["max"], v)
+        ent["last"] = v
+    return sorted(agg.values(), key=lambda e: e["name"])
+
+
+def device_cost_table(instants: List[dict], top: int = 10) -> List[dict]:
+    """Top-N programs by device cost, from the ``device.compile`` instant
+    events the compile choke points emit (one per compiled program, args =
+    the compile_log cost fields: flops / bytes_accessed / peak_hbm_bytes).
+    Sorted by flops descending."""
+    rows = []
+    for ev in instants:
+        if ev["name"] != "device.compile":
+            continue
+        a = ev.get("args") or {}
+        rows.append({"site": a.get("site", "?"), "label": a.get("label", "?"),
+                     "flops": a.get("flops", 0) or 0,
+                     "bytes_accessed": a.get("bytes_accessed", 0) or 0,
+                     "peak_hbm_bytes": a.get("peak_hbm_bytes", 0) or 0,
+                     "pid": ev.get("pid")})
+    rows.sort(key=lambda r: -r["flops"])
+    return rows[:top]
 
 
 def report(paths, top: int = 10, _loaded=None) -> dict:
@@ -211,7 +273,7 @@ def report(paths, top: int = 10, _loaded=None) -> dict:
         paths = [paths]
     loaded = _loaded if _loaded is not None \
         else [load_trace_meta(p) for p in paths]
-    spans, instants, metrics, lanes, note = merge_loaded(loaded)
+    spans, instants, metrics, lanes, note, counters = merge_loaded(loaded)
     out = {
         "trace": paths[0] if len(paths) == 1 else list(paths),
         "n_spans": len(spans),
@@ -221,6 +283,8 @@ def report(paths, top: int = 10, _loaded=None) -> dict:
         "phases": phase_breakdown(spans),
         "top_spans": sorted(spans, key=lambda s: -s["dur"])[:top],
         "events": instants,
+        "counters": counter_tracks(counters),
+        "device_programs": device_cost_table(instants, top=top),
         "metrics": metrics,
     }
     return out
@@ -231,14 +295,15 @@ def merged_chrome(paths, _loaded=None) -> dict:
     a process lane per pid, thread tracks inside, clock-anchored."""
     loaded = _loaded if _loaded is not None \
         else [load_trace_meta(p) for p in paths]
-    spans, instants, metrics, lanes, note = merge_loaded(loaded)
+    spans, instants, metrics, lanes, note, counters = merge_loaded(loaded)
     events = []
     seen = set()
     # synthetic lanes (anchor-less files with no recorded pid) get
     # deterministic ids far above any real pid — str hashes randomize per
     # interpreter run and could collide with a genuine pid's lane
     synthetic: dict = {}
-    for ev in spans + instants:
+
+    def lane_of(ev):
         pid = ev.get("pid")
         if isinstance(pid, int):
             pid_num = pid
@@ -249,6 +314,10 @@ def merged_chrome(paths, _loaded=None) -> dict:
             events.append({"name": "process_name", "ph": "M",
                            "pid": pid_num, "tid": 0,
                            "args": {"name": f"pid {pid}"}})
+        return pid_num
+
+    for ev in spans + instants:
+        pid_num = lane_of(ev)
         out = {"name": ev["name"], "pid": pid_num, "tid": ev.get("tid", 0),
                "ts": ev["ts"] * 1e6}
         if "dur" in ev:
@@ -260,6 +329,11 @@ def merged_chrome(paths, _loaded=None) -> dict:
         if ev.get("args"):
             out["args"] = ev["args"]
         events.append(out)
+    for ev in counters:  # counter lanes (device.live_bytes) ride along
+        pid_num = lane_of(ev)
+        events.append({"name": ev["name"], "ph": "C", "pid": pid_num,
+                       "tid": ev.get("tid", 0), "ts": ev["ts"] * 1e6,
+                       "args": {"value": ev.get("value", 0)}})
     other = {"lanes": lanes}
     if note:
         other["clock_note"] = note
@@ -306,6 +380,24 @@ def render(rep: dict, stream=None) -> None:
             args = (" " + json.dumps(s["args"], default=str)
                     if s["args"] else "")
             w(f"  {_fmt_s(s['dur']):>12}  {s['name']}{args}\n")
+
+    if rep.get("counters"):
+        w("\nCounter tracks:\n")
+        w(f"  {'Track':<28}{'Samples':>8}{'Min':>14}{'Max':>14}"
+          f"{'Last':>14}\n")
+        for c in rep["counters"]:
+            w(f"  {c['name']:<28}{c['samples']:>8}{c['min']:>14.6g}"
+              f"{c['max']:>14.6g}{c['last']:>14.6g}\n")
+
+    if rep.get("device_programs"):
+        w("\nTop programs by device cost:\n")
+        w(f"  {'Site':<12}{'Program':<20}{'GFLOPs':>10}{'MB accessed':>13}"
+          f"{'Peak HBM MB':>13}\n")
+        for p in rep["device_programs"]:
+            w(f"  {p['site']:<12}{p['label']:<20}"
+              f"{p['flops'] / 1e9:>10.4g}"
+              f"{p['bytes_accessed'] / 1e6:>13.4g}"
+              f"{p['peak_hbm_bytes'] / 1e6:>13.4g}\n")
 
     if rep["events"]:
         w("\nTagged events:\n")
