@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve perf obs serve serve-bench dossier
+.PHONY: lint lint-tests test test-fast chaos chaos-serve perf obs health serve serve-bench dossier
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -63,6 +63,15 @@ obs:
 dossier:
 	$(PYTHON) -m pytest tests/test_device_obs.py -q -m perf -p no:cacheprovider
 	-$(PYTHON) tools/bench_compare.py
+
+# training-health plane (docs/OBSERVABILITY.md "Training health"): sentinel
+# detector units, the dispatch-bound proof (stats cost 0 extra program
+# executions), the NaN-provenance blame pass, the chaos flagship (injected
+# NaN -> breach -> blame -> auto-rollback -> bitwise-identical replay);
+# then the measured cost of leaving the sentinel on at default sampling
+health:
+	$(PYTHON) -m pytest tests/ -q -m health -p no:cacheprovider
+	$(PYTHON) tools/health_bench.py
 
 # serving suite: compiled engine program bound, SLO scheduler, endpoint
 # lifecycle + chaos degradation (docs/SERVING.md)

@@ -523,6 +523,27 @@ def bench_obs_overhead(platform):
     return res
 
 
+def bench_health_overhead(platform):
+    """Cost of the training-health plane (docs/OBSERVABILITY.md "Training
+    health"): the same train-step loop with the divergence sentinel off vs
+    attached at the default sampling period (stats variant only on sampled
+    steps), asserted under the 5% budget — the number that justifies
+    leaving the sentinel on for every production fit."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import health_bench
+
+    steps = int(os.environ.get("BENCH_HEALTH_STEPS",
+                               120 if platform == "tpu" else 60))
+    res = health_bench.run_health_overhead(steps=steps)
+    assert res["ok"], (
+        f"health_overhead_pct={res['health_overhead_pct']} >= "
+        f"{res['threshold_pct']}% at every={res['every']} — the sentinel "
+        f"is too expensive to leave on (ips {res['ips_off']} -> "
+        f"{res['ips_on']})")
+    return res
+
+
 def bench_update_engine_dispatches():
     """Compiled executions per optimizer step (tools/profile_step.py
     counters): the fused engine must stay at 1 program regardless of the
@@ -762,6 +783,15 @@ def main():
             extra["obs_overhead"] = bench_obs_overhead(platform)
         except Exception as e:
             extra["obs_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("health_overhead"):
+        try:
+            # the divergence sentinel must be cheap enough to leave ON for
+            # every production fit (docs/OBSERVABILITY.md "Training
+            # health"): off-vs-on train-step throughput at the default
+            # sampling period, <5% gated
+            extra["health_overhead"] = bench_health_overhead(platform)
+        except Exception as e:
+            extra["health_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
             and not over_budget("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's
@@ -809,6 +839,7 @@ def main():
         "lm_seq4096": "lm_seq4096_bf16",
         "serve": "serve",
         "obs_overhead": "obs_overhead",
+        "health_overhead": "health_overhead",
     }
     leg_error_key = {"bert_base_bf16": "bert_error"}  # irregular names
     extra["legs_run"] = [l for l, k in leg_result_key.items() if k in extra]

@@ -78,6 +78,10 @@ class LossScaler:
         self._factor = scale_factor
         self._window = scale_window
         self._unskipped = 0
+        # consecutive skipped steps (maintained in-graph by the fused
+        # engine, host-side by the eager oracle) — the health plane's
+        # skip-loop signal (health.scaler.skip_streak)
+        self.skip_streak = 0
 
     def has_overflow(self, params):
         """One batched finiteness reduction + a single device→host sync

@@ -16,6 +16,10 @@ lookup when chaos is off:
 - :mod:`mxnet_tpu.chaos.platform` — hang the guarded platform entry points
   (``MXNET_CHAOS_TUNNEL_HANG``) the way a dead accelerator tunnel does, so
   every driver's bounded-exit + platform-error-artifact path is testable.
+- :mod:`mxnet_tpu.chaos.nan` — poison a named tensor with NaN at a counted
+  occurrence of it entering an Executor forward (``MXNET_CHAOS_NAN``), so
+  the training-health plane's detection → provenance → auto-rollback chain
+  (obs/health.py) is deterministically testable end to end.
 
 Determinism is the point: a chaos test that flakes is worse than no test.
 Every injector fires on a counted occurrence of a named event, never on a
@@ -23,6 +27,6 @@ timer or a random draw.
 """
 from __future__ import annotations
 
-from . import platform, proc, rpc
+from . import nan, platform, proc, rpc
 
-__all__ = ["rpc", "proc", "platform"]
+__all__ = ["rpc", "proc", "platform", "nan"]

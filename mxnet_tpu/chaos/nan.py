@@ -1,0 +1,139 @@
+"""NaN fault injection — poison a named tensor at a counted occurrence.
+
+The training-health plane (obs/health.py) promises: a non-finite blowup is
+*detected* (sentinel breach), *attributed* (the blame pass names the first
+non-finite op), and *recovered from* (auto-rollback to the last valid
+checkpoint, bitwise-reproducible replay). None of that is testable unless a
+NaN can be injected deterministically — so, the chaos idiom: a rule names a
+tensor and fires on exact 1-based occurrence counts of that tensor being
+bound into an Executor forward. Occurrence counting is what makes the
+flagship test's replay clean: the rollback re-runs the poisoned batch, the
+occurrence is already consumed, the retried segment is bitwise identical to
+an uninjected run.
+
+Configuration
+-------------
+Programmatic (tests): ``configure([Rule("data", {5})])`` then ``reset()``.
+Env (subprocesses): ``MXNET_CHAOS_NAN`` as semicolon-separated
+``tensor@occ1,occ2`` — e.g. ``MXNET_CHAOS_NAN="data@5"`` poisons the 5th
+forward's ``data`` input. An empty occurrence list means every occurrence.
+Only float tensors can be poisoned (an int tensor matches but is skipped
+with a warning — NaN has no integer encoding).
+
+The hook (``executor.Executor.forward``) costs one module-level ``enabled()``
+check when no rules are installed — the chaos contract.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set
+
+from .. import obs
+
+__all__ = ["Rule", "configure", "reset", "enabled", "poison", "parse_env"]
+
+
+class Rule:
+    def __init__(self, tensor: str, occurrences: Optional[Set[int]] = None):
+        self.tensor = tensor
+        self.occurrences = set(occurrences) if occurrences else None
+
+    def __repr__(self):
+        occ = sorted(self.occurrences) if self.occurrences else "all"
+        return f"NanRule({self.tensor}@{occ})"
+
+
+class _State(threading.local):
+    """Thread-local counters (the RPC-chaos idiom): concurrent executors in
+    one test must not race each other's occurrence counts."""
+
+    def __init__(self):
+        self.rules: Optional[List[Rule]] = None
+        self.counters: Dict[int, int] = {}
+
+
+_STATE = _State()
+_PROGRAMMATIC: Optional[List[Rule]] = None
+
+
+def parse_env(spec: str) -> List[Rule]:
+    rules = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        tensor, _, occs = part.partition("@")
+        if not tensor:
+            raise ValueError(f"bad MXNET_CHAOS_NAN entry {part!r}")
+        occurrences = ({int(o) for o in occs.split(",") if o}
+                       if occs else None)
+        rules.append(Rule(tensor, occurrences))
+    return rules
+
+
+def configure(rules: List[Rule]) -> None:
+    global _PROGRAMMATIC
+    _PROGRAMMATIC = list(rules)
+    _STATE.rules = None
+    _STATE.counters = {}
+
+
+def reset() -> None:
+    global _PROGRAMMATIC
+    _PROGRAMMATIC = None
+    _STATE.rules = None
+    _STATE.counters = {}
+
+
+def _active_rules() -> List[Rule]:
+    if _PROGRAMMATIC is not None:
+        return _PROGRAMMATIC
+    if _STATE.rules is None:
+        spec = os.environ.get("MXNET_CHAOS_NAN", "")
+        _STATE.rules = parse_env(spec) if spec else []
+    return _STATE.rules
+
+
+def enabled() -> bool:
+    return bool(_active_rules())
+
+
+def poison(names, values) -> list:
+    """Given parallel (names, device values) about to enter a forward,
+    return values with any matching tensors poisoned (element 0 → NaN).
+    Call only after ``enabled()`` — the hot path pays one check."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rules = _active_rules()
+    out = list(values)
+    if not rules:
+        return out
+    by_name = {}
+    for i, n in enumerate(names):
+        by_name[n] = i
+    for rule in rules:
+        i = by_name.get(rule.tensor)
+        if i is None:
+            continue
+        key = id(rule)
+        _STATE.counters[key] = _STATE.counters.get(key, 0) + 1
+        occ = _STATE.counters[key]
+        if rule.occurrences is not None and occ not in rule.occurrences:
+            continue
+        v = out[i]
+        dtype = np.dtype(str(getattr(v, "dtype", "float32")))
+        if not (np.issubdtype(dtype, np.floating)
+                or str(dtype) == "bfloat16"):
+            import warnings
+
+            warnings.warn(f"MXNET_CHAOS_NAN: tensor {rule.tensor!r} has "
+                          f"non-float dtype {dtype} — not poisoned")
+            continue
+        arr = jnp.asarray(v)
+        flat = jnp.ravel(arr).at[0].set(jnp.nan)
+        out[i] = flat.reshape(arr.shape)
+        # tagged in the SAME timeline as the breach / blame / rollback it
+        # will cause — the whole fault experiment reads as one story
+        obs.event("chaos.nan", tensor=rule.tensor, occurrence=occ)
+        obs.inc("chaos.injected")
+        obs.inc("chaos.nan.injected")
+    return out

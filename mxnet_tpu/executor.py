@@ -274,6 +274,13 @@ class Executor:
         jitted, arg_names, aux_names, raw_fn = self._get_fn(bool(is_train))
         arg_vals = [self.arg_dict[n]._data for n in arg_names]
         aux_vals = [self.aux_dict[n]._data for n in aux_names]
+        from .chaos import nan as _nan_chaos
+
+        if _nan_chaos.enabled():
+            # deterministic NaN injection (MXNET_CHAOS_NAN) BEFORE the
+            # last-inputs capture, so the health blame pass replays the
+            # poisoned batch exactly as the compiled program saw it
+            arg_vals = _nan_chaos.poison(arg_names, arg_vals)
 
         from . import random as _random
         import jax.random as jr

@@ -2,6 +2,6 @@
 from .estimator import Estimator  # noqa: F401
 from .event_handler import (BatchBegin, BatchEnd, CheckpointHandler,  # noqa: F401
                             EarlyStoppingHandler, EpochBegin, EpochEnd,
-                            EventHandler, LoggingHandler, MetricHandler,
-                            StoppingHandler, TrainBegin, TrainEnd,
-                            ValidationHandler)
+                            EventHandler, HealthHandler, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, ValidationHandler)

@@ -72,31 +72,45 @@ class Estimator:
         self.stop_training = False
         for h in train_begin:
             h.train_begin(self)
-        while not self.stop_training:
-            for h in epoch_begin:
-                h.epoch_begin(self)
-            for batch in train_data:
-                data, label = _split_batch(batch)
-                for h in batch_begin:
-                    h.batch_begin(self, batch=batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                self.trainer.step(_batch_size(data, batch_axis))
-                for h in batch_end:
-                    if h.batch_end(self, batch=batch, pred=pred, label=label,
-                                   loss=loss):
+        # train_end handlers hold cleanup that must not leak past an
+        # aborted fit (HealthHandler's plane deactivation, checkpoint
+        # flushes): on an exception they still run, errors swallowed so
+        # cleanup can't mask the real failure
+        unwinding = True
+        try:
+            while not self.stop_training:
+                for h in epoch_begin:
+                    h.epoch_begin(self)
+                for batch in train_data:
+                    data, label = _split_batch(batch)
+                    for h in batch_begin:
+                        h.batch_begin(self, batch=batch)
+                    with autograd.record():
+                        pred = self.net(data)
+                        loss = self.loss(pred, label)
+                    loss.backward()
+                    self.trainer.step(_batch_size(data, batch_axis))
+                    for h in batch_end:
+                        if h.batch_end(self, batch=batch, pred=pred,
+                                       label=label, loss=loss):
+                            self.stop_training = True
+                    if self.stop_training:
+                        break
+                for h in epoch_end:
+                    if h.epoch_end(self):
                         self.stop_training = True
-                if self.stop_training:
-                    break
-            for h in epoch_end:
-                if h.epoch_end(self):
-                    self.stop_training = True
-            if hasattr(train_data, "reset"):
-                train_data.reset()
-        for h in train_end:
-            h.train_end(self)
+                if hasattr(train_data, "reset"):
+                    train_data.reset()
+            unwinding = False
+        finally:
+            for h in train_end:
+                try:
+                    h.train_end(self)
+                except Exception:
+                    if not unwinding:
+                        raise
+                    self.logger.warning("train_end handler failed during "
+                                        "unwind", exc_info=True)
         return self.train_metrics
 
     # ------------------------------------------------------------------

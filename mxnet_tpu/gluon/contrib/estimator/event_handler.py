@@ -13,7 +13,7 @@ import numpy as np
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "EventHandler", "StoppingHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler"]
+           "EarlyStoppingHandler", "HealthHandler"]
 
 
 class EventHandler:
@@ -301,6 +301,73 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
 
     def train_end(self, estimator, *args, **kwargs):
         self._manager.flush()  # drain the async writer before exit
+
+
+class HealthHandler(TrainBegin, BatchBegin, BatchEnd, TrainEnd):
+    """Wire the training-health sentinel (docs/OBSERVABILITY.md "Training
+    health") into an Estimator fit: feeds the per-batch loss (a device
+    reference — synced only at sampled steps), drives the monitor off the
+    trainer's fused engine + AMP scaler, and lets ``actions="lr_backoff"``
+    apply in place. ``stop_on_nonfinite=True`` additionally halts the fit
+    on a non-finite breach (an estimator has no checkpoint-rollback loop
+    of its own — stopping honestly beats training on NaN).
+    """
+
+    def __init__(self, monitor=True, stop_on_nonfinite=False, priority=-500):
+        from ....obs import health as health_mod
+
+        self.monitor = health_mod.as_monitor(monitor)
+        if self.monitor is None:
+            # a health handler with monitoring opted out is a contradiction
+            # — reject loudly instead of silently monitoring anyway
+            raise ValueError("HealthHandler needs a monitor: pass True, a "
+                             "kwargs dict, or a HealthMonitor (to disable "
+                             "health monitoring, don't add the handler)")
+        self.stop_on_nonfinite = stop_on_nonfinite
+        self.priority = priority
+        self._active = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        from ....obs import health as health_mod
+
+        if not self._active:
+            health_mod.activate()
+            self._active = True
+        trainer = estimator.trainer
+        if trainer is not None and self.monitor.param_names is None:
+            self.monitor.attach_names([p.name for p in trainer._params])
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        from ....obs import health as health_mod
+
+        # this batch's trainer.step runs before batch_end: emit the stats
+        # variant exactly when the sentinel will sample it
+        health_mod.request_stats(self.monitor.will_sample())
+
+    def batch_end(self, estimator, *args, **kwargs):
+        trainer = estimator.trainer
+        self.monitor.record_loss(kwargs.get("loss"))
+        rep = self.monitor.step(
+            engine=getattr(trainer._updaters[0], "_engine", None)
+            if trainer is not None else None,
+            scaler=getattr(trainer, "_amp_loss_scaler", None)
+            if trainer is not None else None,
+            optimizer=trainer._optimizer if trainer is not None else None)
+        if (self.stop_on_nonfinite and rep is not None
+                and any(b["rule"] == "nonfinite"
+                        for b in rep.get("breaches", ()))):
+            logging.getLogger("mxnet_tpu.estimator").error(
+                "HealthHandler: non-finite breach — stopping training")
+            return True
+        return False
+
+    def train_end(self, estimator, *args, **kwargs):
+        from ....obs import health as health_mod
+
+        if self._active:
+            health_mod.request_stats(None)
+            health_mod.deactivate()
+            self._active = False
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):

@@ -42,6 +42,7 @@ class Trainer:
         # unscale + found-inf skip fuse into the same program
         self._clip_global_norm = clip_global_norm
         self._amp_loss_scaler = None
+        self._health_monitor = None  # attach_health_monitor (obs/health.py)
 
     # ------------------------------------------------------------------
     @property
@@ -116,6 +117,11 @@ class Trainer:
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]
+        if self._health_monitor is not None:
+            from ..obs import health as health_mod
+
+            # stats variant only on sampled steps (cost amortizes 1/K)
+            health_mod.request_stats(self._health_monitor.will_sample())
         if self._kvstore is not None and self._update_on_kvstore:
             keys = list(range(len(self._params)))
             self._kvstore.pull(keys, out=[p.data() for p in self._params])
@@ -136,6 +142,36 @@ class Trainer:
             updater.update_batch(idxs, grads, weights,
                                  loss_scaler=self._amp_loss_scaler,
                                  clip_global_norm=self._clip_global_norm)
+        if self._health_monitor is not None:
+            # sampled numerics telemetry + sentinel (docs/OBSERVABILITY.md
+            # "Training health"); lr backoff applies in place — rollback
+            # needs a checkpoint manager and stays with the owning loop
+            self._health_monitor.step(
+                engine=getattr(updater, "_engine", None),
+                scaler=self._amp_loss_scaler,
+                optimizer=self._optimizer)
+
+    # ------------------------------------------------------------------
+    def attach_health_monitor(self, monitor=True):
+        """Attach the training-health sentinel (obs/health.py): ``True`` /
+        a kwargs dict / a HealthMonitor; ``None`` detaches. While attached,
+        the fused update program emits device-resident numerics stats and
+        ``step()`` feeds the sampled sentinel; record the per-batch loss
+        with ``monitor.record_loss(loss)`` (the estimator's HealthHandler
+        does). Returns the monitor."""
+        from ..obs import health as health_mod
+
+        if self._health_monitor is not None:
+            health_mod.deactivate()
+            health_mod.request_stats(None)
+            self._health_monitor = None
+        mon = health_mod.as_monitor(monitor)
+        if mon is not None:
+            if mon.param_names is None:
+                mon.attach_names([p.name for p in self._params])
+            health_mod.activate()
+            self._health_monitor = mon
+        return mon
 
     # ------------------------------------------------------------------
     def save_states(self, fname):

@@ -85,7 +85,10 @@ class FeedForward:
             checkpoint=None, resume="auto", **kwargs):
         """``checkpoint=`` (a directory or CheckpointManager) + the default
         ``resume="auto"`` give the legacy API the same crash-safe
-        checkpointing contract as Module.fit (docs/ROBUSTNESS.md)."""
+        checkpointing contract as Module.fit (docs/ROBUSTNESS.md), and
+        ``health=`` (forwarded through ``**kwargs``) the same divergence
+        sentinel + auto-rollback (docs/OBSERVABILITY.md "Training
+        health")."""
         from .io import NDArrayIter
 
         del logger  # accepted for signature parity; Module logs via logging

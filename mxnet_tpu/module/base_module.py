@@ -105,7 +105,8 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None,
             checkpoint=None, resume="auto", checkpoint_period=1,
-            checkpoint_batch_period=None, handle_preemption=True):
+            checkpoint_batch_period=None, handle_preemption=True,
+            health=None):
         """The classic training loop (reference BaseModule.fit).
 
         Crash-safe checkpointing (docs/ROBUSTNESS.md): pass ``checkpoint=``
@@ -120,11 +121,24 @@ class BaseModule:
         ``resume="never"`` ignores existing checkpoints. With
         ``handle_preemption`` a SIGTERM/SIGINT flushes a final checkpoint
         after the in-flight batch and returns cleanly.
+
+        Training health (docs/OBSERVABILITY.md "Training health"):
+        ``health=`` takes ``True`` / a kwargs dict / a
+        :class:`~mxnet_tpu.obs.health.HealthMonitor`. The sentinel samples
+        loss, grad norms, and non-finite counts every K steps (one batched
+        device fetch, zero extra program executions), fires ``on_breach``
+        callbacks, and — when the monitor's ``actions`` allow and a
+        ``checkpoint=`` manager is present — escalates warn → lr backoff →
+        rollback to the last checkpoint whose arrays are finite (full PR-2
+        state, so the retried segment is bitwise-reproducible). A
+        non-finite breach also triggers the NaN-provenance blame pass,
+        naming the first non-finite graph node as a tagged obs event.
         """
         assert num_epoch is not None, "num_epoch is required for fit"
         optimizer_params = optimizer_params or {"learning_rate": 0.01}
 
         from ..checkpoint import CheckpointManager, as_manager
+        from ..obs import health as health_mod
 
         # a manager built from a bare directory is ours to close at the end;
         # a caller-supplied manager outlives the fit (only flushed)
@@ -184,19 +198,32 @@ class BaseModule:
         can_position = (train_data.get_checkpoint_state() is not None
                         if hasattr(train_data, "get_checkpoint_state")
                         else False)
+        health_monitor = health_mod.as_monitor(health)
+        if health_monitor is not None:
+            # an attached monitor activates the in-graph stats even with
+            # the wider obs layer off (fused.py asks inline_stats_active)
+            health_mod.activate()
+            if health_monitor.param_names is None and \
+                    getattr(self, "_param_names", None):
+                health_monitor.attach_names(list(self._param_names))
 
+        # pending_batch set => enter the epoch mid-stream WITHOUT
+        # reset/reshuffle (the cursor is already positioned): entry resume
+        # and health rollback share this path
+        pending_batch = resume_state.nbatch if mid_epoch else None
+        epoch = begin_epoch
         try:
-            for epoch in range(begin_epoch, num_epoch):
+            while epoch < num_epoch:
                 tic = time.time()
                 eval_metric.reset()
-                if mid_epoch and epoch == begin_epoch:
-                    # interrupted epoch: cursor was restored before the
-                    # loop — continue exactly there, NO reset/reshuffle
-                    nbatch = resume_state.nbatch
+                if pending_batch is not None:
+                    nbatch = pending_batch
+                    pending_batch = None
                 else:
                     train_data.reset()
                     nbatch = -1
                 batches = iter(train_data)
+                rolled_back = False
                 while True:
                     # data_wait = time the step loop blocks on the iterator
                     # (decode + host→device when PrefetchingIter is behind)
@@ -206,6 +233,10 @@ class BaseModule:
                         break
                     nbatch += 1
                     self.forward_backward(data_batch)
+                    if health_monitor is not None:
+                        # stats variant only on steps the sentinel will
+                        # sample — the per-param norms' cost amortizes 1/K
+                        health_mod.request_stats(health_monitor.will_sample())
                     with obs.trace.span("update"):
                         self.update()
                     global_step += 1
@@ -215,6 +246,42 @@ class BaseModule:
                     obs.device.sample(step=global_step)
                     with obs.trace.span("metric"):
                         self.update_metric(eval_metric, data_batch.label)
+                    if health_monitor is not None:
+                        # sampled every K steps; sits BEFORE this step's
+                        # checkpoint save so a detected blowup can never
+                        # commit poisoned params as "the newest snapshot"
+                        health_monitor.record_metric(eval_metric)
+                        rep = health_monitor.step(
+                            global_step,
+                            engine=getattr(getattr(self, "_updater", None),
+                                           "_engine", None),
+                            optimizer=getattr(self, "_optimizer", None))
+                        if rep is not None and rep["breaches"]:
+                            if health_monitor.should_blame(rep) and \
+                                    getattr(self, "_exec", None) is not None:
+                                with obs.trace.span("health.blame"):
+                                    health_mod.blame_nonfinite(self._exec)
+                            if rep["action"] == "rollback":
+                                if manager is None:
+                                    self.logger.warning(
+                                        "health: rollback requested but fit "
+                                        "has no checkpoint= manager — "
+                                        "continuing (warn only)")
+                                else:
+                                    res = self._apply_health_rollback(
+                                        manager, health_monitor, train_data)
+                                    if res is not None:
+                                        state, positioned = res
+                                        global_step = state.global_step
+                                        if (state.nbatch is not None
+                                                and positioned):
+                                            epoch = state.epoch
+                                            pending_batch = state.nbatch
+                                        else:
+                                            epoch = state.epoch + 1
+                                            pending_batch = None
+                                        rolled_back = True
+                                        break
                     if batch_end_callback:
                         bp = BatchEndParam(epoch, nbatch, eval_metric,
                                            locals())
@@ -252,6 +319,11 @@ class BaseModule:
                             # interrupted fit for a completed one
                             raise KeyboardInterrupt
                         return  # SIGTERM: the VM is going away — exit clean
+                if rolled_back:
+                    # re-enter the (possibly earlier) epoch at the restored
+                    # cursor; eval_metric resets at the loop top, so the
+                    # poisoned running averages die with the bad segment
+                    continue
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
                 self.logger.info("Epoch[%d] Time cost=%.3f",
@@ -284,7 +356,11 @@ class BaseModule:
                     for name, val in res:
                         self.logger.info("Epoch[%d] Validation-%s=%f",
                                          epoch, name, val)
+                epoch += 1
         finally:
+            if health_monitor is not None:
+                health_mod.request_stats(None)
+                health_mod.deactivate()
             # runs on normal completion, the preemption return, AND
             # exceptions: signal handlers must never outlive the fit
             if manager is not None:
@@ -328,6 +404,38 @@ class BaseModule:
         restore_optimizer(getattr(self, "_updater", None),
                           getattr(self, "_optimizer", None), state)
         restore_rng(state)
+
+    def _apply_health_rollback(self, manager, monitor, train_data):
+        """Divergence-sentinel auto-rollback: restore the newest checkpoint
+        whose arrays are all finite (a CRC-valid snapshot written after the
+        blowup is poisoned, not valid) — params, optimizer slots/counters,
+        RNG streams, and the iterator cursor, exactly the PR-2 resume path,
+        so the retried segment is bitwise-reproducible. Returns
+        ``(state, iterator_positioned)`` or None when nothing usable
+        exists."""
+        from ..checkpoint.state import restore_iterator
+        from ..obs import health as health_mod
+
+        manager.flush()  # queued async saves must be on disk to be judged
+        state = health_mod.find_rollback_target(manager)
+        if state is None:
+            self.logger.warning(
+                "health: rollback requested but no valid finite checkpoint "
+                "exists — continuing without rollback")
+            return None
+        self.init_params(arg_params=state.arg_params(),
+                         aux_params=state.aux_params(), force_init=True)
+        self._restore_training_state(state)
+        positioned = restore_iterator(train_data, state)
+        monitor.note_rollback(state.global_step)
+        obs.event("health.rollback", step=state.global_step,
+                  epoch=state.epoch, nbatch=state.nbatch)
+        self.logger.warning(
+            "health: rolled back to checkpoint step %d (epoch %s%s)",
+            state.global_step, state.epoch,
+            f", batch {state.nbatch}" if state.nbatch is not None
+            and positioned else "")
+        return state, positioned
 
 
 _STOP = object()  # iterator-exhausted sentinel for the data_wait span

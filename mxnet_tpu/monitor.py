@@ -7,6 +7,13 @@ programs**: when a hook fires during tracing (hybridize / CachedOp), the
 stat is computed in-graph and shipped out through ``jax.debug.callback``,
 so every compiled replay still reports; activation gating happens at
 runtime inside the callback.
+
+Monitor is now a thin adapter over the training-health plane
+(obs/health.py): ``toc()`` moves every watched stat to host through the
+plane's ONE shared batched-fetch primitive (no private stat-fetch path to
+drift), and scalar stats land in the ``health.monitor.<tensor>`` gauges —
+tensor health reads beside loss/grad-norm telemetry in one registry
+(docs/OBSERVABILITY.md "Training health").
 """
 from __future__ import annotations
 
@@ -132,31 +139,30 @@ class Monitor:
         self.activated = False
         res = list(self.queue)
         self.queue = []
-        # ONE device→host transfer for ALL watched stats: the old path
-        # blocked on asnumpy once per tensor per batch (the same
-        # batched-get pattern Updater.get_states uses — PR 3)
+        # ONE device→host transfer for ALL watched stats through the
+        # health plane's shared batched-fetch (obs/health.py — the same
+        # primitive the sentinel's sampled step uses; Monitor keeps no
+        # private stat-fetch path)
+        from .obs import health as _health
+
         device_idx = [i for i, (_, _, v) in enumerate(res)
                       if isinstance(v, NDArray)]
         if device_idx:
-            from . import profiler
-
-            if profiler.counting_dispatches():
-                profiler.count_dispatch("d2h")  # one batched transfer
-            fetched = jax.device_get([res[i][2]._data for i in device_idx])
+            fetched = _health.batched_fetch([res[i][2] for i in device_idx])
             for i, val in zip(device_idx, fetched):
                 step, tag, _ = res[i]
                 res[i] = (step, tag, np.asarray(val))
         if self.sort:
             res.sort(key=lambda t: t[1])
-        # scalar stats land in the metrics registry too, so `obs` reports
-        # show tensor health beside latencies (docs/OBSERVABILITY.md)
+        # scalar stats land in the health plane's gauges, so tensor health
+        # reads beside loss/grad-norm telemetry (docs/OBSERVABILITY.md)
         from . import obs
 
         if obs.enabled():
             for step, tag, val in res:
                 arr = np.asarray(val)
                 if arr.size == 1:
-                    obs.set_gauge("monitor." + tag,
+                    obs.set_gauge("health.monitor." + tag,
                                   float(arr.reshape(())[()]))
         return res
 

@@ -41,9 +41,10 @@ from . import context, metrics, trace
 from . import export as export_mod
 from . import slo  # SLO monitor over merged telemetry
 from . import device  # device plane: XLA cost/memory accounting, MFU
+from . import health  # training-health plane: numerics sentinel + rollback
 
 __all__ = ["trace", "metrics", "context", "export_mod", "slo", "device",
-           "enable", "disable", "enabled", "span", "event", "inc",
+           "health", "enable", "disable", "enabled", "span", "event", "inc",
            "observe", "set_gauge", "export", "reset", "telemetry_part"]
 
 # re-exported hot-path helpers (obs.span is obs.trace.span)

@@ -388,6 +388,11 @@ class FusedUpdateEngine:
         self.exec_count = 0
         self.compile_log: List[dict] = []
         self._costs: Dict = {}  # cache key -> device cost record
+        # training-health plane (obs/health.py): when active, the step
+        # program also emits device-resident numerics stats; both stay
+        # device-side (zero syncs) until a sampled step batch-fetches them
+        self.last_health: Optional[dict] = None
+        self._skip_streak = np.int32(0)  # AMP consecutive-skip counter
 
     # -- keys --------------------------------------------------------------
     _TRACED_ATTRS = frozenset({"lr", "rescale_grad", "num_update",
@@ -455,12 +460,18 @@ class FusedUpdateEngine:
             scale, unskipped, factor, window = np.float32(1), np.int32(0), 2.0, 0
         cgn_val = np.float32(clip_global_norm if cgn_on else 0.0)
         extras = _extras_prep(opt, n)
+        # health stats are part of the program (extra outputs, zero extra
+        # dispatches) — the flag is a compile static, so a monitor-gated
+        # loop alternates between exactly TWO cached variants (stats on
+        # sampled steps, plain otherwise; updates bitwise-identical)
+        health_on = obs.health.stats_for_this_step()
+        streak_in = self._skip_streak if scaler_on else np.int32(0)
 
         key = (type(opt), self._static_key(), specs, mp,
                tuple(self._aval(x) for x in ws),
                tuple(self._aval(x) for x in gs),
                tuple(tuple(self._aval(x) for x in lp) for lp in state_leaves),
-               scaler_on, factor, window, cgn_on, self._donate)
+               scaler_on, factor, window, cgn_on, health_on, self._donate)
         _device = obs.device
 
         rec = obs.enabled()
@@ -468,13 +479,14 @@ class FusedUpdateEngine:
         jitted = self._cache.get(key)
         is_compile = jitted is None
         if is_compile:
-            jitted = self._build(specs, mp, scaler_on, factor, window, cgn_on)
+            jitted = self._build(specs, mp, scaler_on, factor, window, cgn_on,
+                                 health_on)
             entry = {
                 "optimizer": type(opt).__name__,
                 "static": self._static_key(),
                 "avals": key[4],
                 "state_structure": specs,
-                "flags": (scaler_on, cgn_on),
+                "flags": (scaler_on, cgn_on, health_on),
             }
             if _device.active():
                 # ONE compile serves accounting and execution: the AOT
@@ -482,7 +494,7 @@ class FusedUpdateEngine:
                 # XLA cost/memory analyses land in this compile_log entry
                 compiled, cost = _device.capture(
                     jitted, (ws, gs, state_leaves, lrs, wds, ts, rescale,
-                             scale, unskipped, cgn_val, extras),
+                             scale, unskipped, streak_in, cgn_val, extras),
                     site="update", label=type(opt).__name__)
                 if compiled is not None:
                     jitted = compiled
@@ -505,9 +517,9 @@ class FusedUpdateEngine:
             profiler.count_dispatch("h2d")  # the packed lr/wd/t hyper vectors
         with obs.trace.span("update.fused", optimizer=type(opt).__name__,
                             n_params=n, compile=is_compile) as sp:
-            new_ws, new_flat, new_ex, scaler_out = jitted(
+            new_ws, new_flat, new_ex, scaler_out, health_out = jitted(
                 ws, gs, state_leaves, lrs, wds, ts, rescale, scale, unskipped,
-                cgn_val, extras)
+                streak_in, cgn_val, extras)
             cost = self._costs.get(key) if rec and not is_compile else None
             if cost:
                 # analytic MFU + roofline on the executed program's span
@@ -537,32 +549,66 @@ class FusedUpdateEngine:
                 nd._set_data(nv)
         _extras_finalize(opt, new_ex)
         if scaler_on:
-            found, nsc, nun = scaler_out
+            found, nsc, nun, nstreak = scaler_out
             loss_scaler.loss_scale = NDArray(nsc)
             loss_scaler._unskipped = NDArray(nun)
             loss_scaler.last_overflow = NDArray(found)  # device flag, no sync
+            # consecutive-skip streak, maintained in-graph: the silent AMP
+            # skip-loop (counters advance on skip) finally has a signal —
+            # obs/health.py samples it and breaches on a long streak
+            loss_scaler.skip_streak = NDArray(nstreak)
+            self._skip_streak = nstreak
+        if health_out is not None:
+            g_all, g_norms, w_norms, u_norms, nonfin = health_out
+            self.last_health = {
+                "global_grad_norm": g_all, "grad_norms": g_norms,
+                "param_norms": w_norms, "update_norms": u_norms,
+                "nonfinite": nonfin, "indices": tuple(indices)}
+            if scaler_on:
+                self.last_health["found_inf"] = scaler_out[0]
+                self.last_health["skip_streak"] = scaler_out[3]
+        else:
+            self.last_health = None
 
     # -- compile -----------------------------------------------------------
-    def _build(self, specs, mp, scaler_on, factor, window, cgn_on):
+    def _build(self, specs, mp, scaler_on, factor, window, cgn_on,
+               health_on=False):
         opt = self.optimizer
         lowering = _LOWER[type(opt)]
 
         def step(ws, gs, state_leaves, lrs, wds, ts, rescale, scale,
-                 unskipped, cgn, extras):
+                 unskipped, streak, cgn, extras):
             gs = list(gs)
             found = jnp.zeros((), jnp.bool_)
             if scaler_on:
                 inv = 1.0 / scale
                 gs = [g * inv.astype(g.dtype) for g in gs]
+            nonfin = None
+            if health_on:
+                # per-grad non-finite counts; the scaler's found-inf
+                # reduction is their OR — one pass serves both signals
+                nonfin = [jnp.sum(
+                    (~jnp.isfinite(g.astype(jnp.float32))).astype(jnp.int32))
+                    for g in gs]
+                if scaler_on:
+                    for c in nonfin:
+                        found = found | (c > 0)
+            elif scaler_on:
                 for g in gs:
                     found = found | ~jnp.all(jnp.isfinite(
                         g.astype(jnp.float32)))
-            if cgn_on:
+            gsqs, gnorm = None, None
+            if cgn_on or health_on:
+                # ONE reduction serves clipping AND the health plane's
+                # global/per-param grad norms (pre-clip, post-unscale —
+                # the raw explosion signal)
+                gsqs = [jnp.sum(jnp.square(g.astype(jnp.float32) * rescale))
+                        for g in gs]
                 sq = jnp.float32(0.0)
-                for g in gs:
-                    sq = sq + jnp.sum(
-                        jnp.square(g.astype(jnp.float32) * rescale))
+                for s in gsqs:
+                    sq = sq + s
                 gnorm = jnp.sqrt(sq)
+            if cgn_on:
                 coef = jnp.minimum(jnp.float32(1.0), cgn / (gnorm + 1e-6))
                 gs = [g * coef.astype(g.dtype) for g in gs]
 
@@ -606,16 +652,34 @@ class FusedUpdateEngine:
                               scale))
                 new_unskipped = jnp.where(found | grow, 0, nskip).astype(
                     jnp.asarray(unskipped).dtype)
-                scaler_out = (found, new_scale, new_unskipped)
+                new_streak = jnp.where(found, streak + 1, 0).astype(
+                    jnp.int32)
+                scaler_out = (found, new_scale, new_unskipped, new_streak)
             else:
                 scaler_out = None
+
+            health_out = None
+            if health_on:
+                # device-resident numerics scalars, emitted as extra
+                # outputs of THIS program — zero extra dispatches; update
+                # norms measure the applied step (0 on a scaler skip)
+                f32 = jnp.float32
+                w_norms = [jnp.sqrt(jnp.sum(jnp.square(w.astype(f32))))
+                           for w in ws]
+                u_norms = [jnp.sqrt(jnp.sum(jnp.square(
+                    nw.astype(f32) - w.astype(f32))))
+                    for nw, w in zip(new_ws, ws)]
+                health_out = (gnorm,
+                              jnp.stack([jnp.sqrt(s) for s in gsqs]),
+                              jnp.stack(w_norms), jnp.stack(u_norms),
+                              jnp.stack(nonfin))
 
             flat_new = []
             for ns in new_states:
                 lv: list = []
                 _state_leaves(ns, lv)
                 flat_new.append(tuple(lv))
-            return tuple(new_ws), tuple(flat_new), ex, scaler_out
+            return tuple(new_ws), tuple(flat_new), ex, scaler_out, health_out
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)

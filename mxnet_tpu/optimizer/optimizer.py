@@ -473,6 +473,12 @@ class Updater:
             loss_scaler._unskipped = int(getattr(loss_scaler, "_unskipped", 0))
             loss_scaler.update_scale(skip)
             loss_scaler.last_overflow = skip
+            # consecutive-skip streak — host-side mirror of the fused
+            # engine's in-graph counter (obs/health.py samples it)
+            prev = getattr(loss_scaler, "skip_streak", 0)
+            if hasattr(prev, "asnumpy"):
+                prev = int(prev.asnumpy())
+            loss_scaler.skip_streak = (int(prev) + 1) if skip else 0
 
     def get_states(self, dump_optimizer=False):
         import pickle

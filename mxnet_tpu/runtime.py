@@ -110,6 +110,20 @@ _ENV_REGISTRY = {
                                  "(samples)."),
     "MXNET_DEVICE_LEAK_BYTES_PER_STEP": (str(1 << 20), "Leak-detector "
                                          "slope threshold (bytes/step)."),
+    # training-health plane (obs/health.py, docs/OBSERVABILITY.md
+    # "Training health")
+    "MXNET_OBS_HEALTH": (None, "1 = force the training-health plane's "
+                         "in-graph numerics stats on (0 = veto); default: "
+                         "on while a HealthMonitor is attached to a "
+                         "training loop."),
+    "MXNET_OBS_HEALTH_EVERY": ("10", "Health sampling period K: the "
+                               "sentinel fetches the device-resident "
+                               "stats with one batched device_get every "
+                               "K optimizer steps."),
+    "MXNET_CHAOS_NAN": (None, "Chaos: poison a named tensor with NaN at "
+                        "counted forward occurrences, e.g. 'data@5' "
+                        "(chaos/nan.py — tests the breach/provenance/"
+                        "rollback chain deterministically)."),
     # distributed (DMLC_* names kept for launcher compat)
     "DMLC_ROLE": (None, "worker|server|scheduler — set by tools/launch.py."),
     "DMLC_PS_ROOT_URI": (None, "Coordinator/PS host (reference ps-lite env)."),

@@ -41,6 +41,11 @@ def pytest_configure(config):
         "markers", "serve: inference-serving tests — compiled engine, "
         "dynamic batcher, socket endpoint (docs/SERVING.md); run via "
         "`pytest -m serve` or `make serve`")
+    config.addinivalue_line(
+        "markers", "health: training-health plane tests — divergence "
+        "sentinel, NaN provenance, checkpoint auto-rollback "
+        "(docs/OBSERVABILITY.md \"Training health\"); run via "
+        "`pytest -m health` or `make health`")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,636 @@
+"""Training-health plane suite (``pytest -m health`` / ``make health``).
+
+Covers the plane's contracts (docs/OBSERVABILITY.md "Training health"):
+
+1. sentinel detectors over synthetic series — loss spike (EWMA-judged),
+   grad-norm explosion, plateau (warn-only), scaler skip streak
+   (warn-once + breach), non-finite (fatal), the warn → lr-backoff →
+   rollback escalation ladder, rollback cooldown/cap suppression;
+2. the dispatch-bound proof — the in-graph stats add ZERO extra program
+   executions on a sampled step (one batched d2h fetch only) and exactly
+   nothing when the plane is off;
+3. deterministic NaN chaos (``MXNET_CHAOS_NAN`` / chaos/nan.py) —
+   occurrence counting, the provenance blame pass naming the first
+   non-finite node, rollback-target selection skipping poisoned
+   checkpoints;
+4. the flagship — NaN injected mid-epoch into a checkpointed Module.fit:
+   sentinel breach → blame names the op → auto-rollback → the resumed
+   segment is bitwise-identical to an uninjected run, and the whole story
+   (counter tracks, breach, provenance, rollback) renders in one chrome
+   trace via tools/trace_report.py;
+5. integration satellites — gluon Trainer attach (skip-streak breach
+   through a real AMP scaler), estimator HealthHandler, Monitor-as-
+   adapter gauges.
+"""
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import obs, profiler
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.chaos import nan as nan_chaos
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.module import Module
+from mxnet_tpu.obs import health as health_mod
+from mxnet_tpu.obs.health import HealthMonitor
+
+pytestmark = pytest.mark.health
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Telemetry + chaos + health module state reset around every test."""
+    obs.disable()
+    obs.reset()
+    nan_chaos.reset()
+    health_mod.request_stats(None)
+    health_mod._ACTIVE[0] = 0
+    yield
+    obs.disable()
+    obs.reset()
+    nan_chaos.reset()
+    health_mod.request_stats(None)
+    health_mod._ACTIVE[0] = 0
+
+
+class _FakeEngine:
+    """A stand-in engine whose ``last_health`` holds HOST values — the
+    monitor's batched fetch passes them through untouched, so detectors
+    can be unit-tested on synthetic series with no device work."""
+
+    def __init__(self, gnorm, nonfinite=(0, 0), streak=None):
+        n = len(nonfinite)
+        self.last_health = {
+            "global_grad_norm": np.float32(gnorm),
+            "grad_norms": np.full(n, gnorm / max(n, 1), np.float32),
+            "param_norms": np.ones(n, np.float32),
+            "update_norms": np.full(n, 1e-3, np.float32),
+            "nonfinite": np.asarray(nonfinite, np.int32),
+            "indices": tuple(range(n)),
+        }
+        if streak is not None:
+            self.last_health["skip_streak"] = np.int32(streak)
+
+
+# ---------------------------------------------------------------------------
+# sentinel detectors on synthetic series
+# ---------------------------------------------------------------------------
+
+def test_loss_spike_detector_judges_against_prior_ewma():
+    mon = HealthMonitor(every=1, loss_spike=3.0)
+    for i in range(5):
+        rep = mon.step(i, loss=1.0 + 0.01 * i)
+        assert rep["ok"], rep
+    rep = mon.step(6, loss=50.0)
+    rules = [b["rule"] for b in rep["breaches"]]
+    assert rules == ["loss_spike"]
+    # the spike did NOT inflate its own baseline: EWMA still near 1
+    assert rep["loss_ewma"] < 2.0
+
+
+def test_grad_norm_explosion_detector():
+    mon = HealthMonitor(every=1, grad_explosion=10.0)
+    for i in range(4):
+        rep = mon.step(i, engine=_FakeEngine(gnorm=1.0))
+        assert rep["ok"]
+    rep = mon.step(5, engine=_FakeEngine(gnorm=500.0))
+    assert [b["rule"] for b in rep["breaches"]] == ["grad_norm_explosion"]
+
+
+def test_plateau_detector_is_warn_only():
+    mon = HealthMonitor(every=1, plateau_window=6, plateau_eps=1e-3,
+                        actions="rollback")
+    rep = None
+    for i in range(6):
+        rep = mon.step(i, loss=1.0)
+    assert [b["rule"] for b in rep["breaches"]] == ["plateau"]
+    assert rep["action"] == "warn"  # advice, never an emergency
+    # re-arms over a fresh window: next sample does not re-breach
+    assert mon.step(7, loss=1.0)["ok"]
+
+
+def test_decreasing_loss_never_plateaus_or_spikes():
+    mon = HealthMonitor(every=1, plateau_window=8)
+    for i in range(30):
+        rep = mon.step(i, loss=2.0 * 0.9 ** i,
+                       engine=_FakeEngine(gnorm=1.0 + 0.01 * i))
+        assert rep["ok"], rep["breaches"]
+
+
+def test_nonfinite_is_fatal_and_names_worst_param():
+    mon = HealthMonitor(every=1, actions="rollback",
+                        param_names=["fc1_weight", "fc1_bias"])
+    rep = mon.step(1, engine=_FakeEngine(gnorm=float("nan"),
+                                         nonfinite=(7, 0)))
+    assert [b["rule"] for b in rep["breaches"]] == ["nonfinite"]
+    assert rep["action"] == "rollback"  # fatal jumps the ladder
+    assert rep["breaches"][0]["param"] == "fc1_weight"
+
+
+def test_skip_streak_breach_and_warn_once():
+    mon = HealthMonitor(every=1, skip_streak_threshold=3)
+    warned = []
+    mon.logger = type("L", (), {"warning": lambda self, *a: warned.append(a)})()
+    assert mon.step(1, engine=_FakeEngine(gnorm=1.0, streak=1))["ok"]
+    rep = mon.step(2, engine=_FakeEngine(gnorm=1.0, streak=4))
+    assert [b["rule"] for b in rep["breaches"]] == ["scaler_skip_streak"]
+    n_after_first = len(warned)
+    mon.step(3, engine=_FakeEngine(gnorm=1.0, streak=5))
+    # the dedicated warn-once fired exactly once for the ongoing streak
+    # (each sampled breach still logs its own one-line summary)
+    once = [w for w in warned if "skip streak reached" in str(w[0])]
+    assert len(once) == 1 and n_after_first >= 1
+
+
+def test_escalation_ladder_warn_backoff_rollback():
+    mon = HealthMonitor(every=1, loss_spike=2.0, actions="rollback",
+                        rollback_cooldown=0)
+    for i in range(4):
+        mon.step(i, loss=1.0)
+    actions = []
+    for i in range(3):
+        rep = mon.step(10 + i, loss=100.0 * (3 ** i))
+        actions.append(rep["action"])
+    assert actions == ["warn", "lr_backoff", "rollback"]
+
+
+def test_ladder_capped_by_actions_ceiling():
+    mon = HealthMonitor(every=1, loss_spike=2.0, actions="warn")
+    for i in range(4):
+        mon.step(i, loss=1.0)
+    for i in range(4):
+        rep = mon.step(10 + i, loss=1000.0 * (3 ** i))
+    assert rep["action"] == "warn"
+
+
+def test_rollback_cooldown_and_cap_suppress():
+    mon = HealthMonitor(every=1, actions="rollback", rollback_cooldown=100,
+                        max_rollbacks=2)
+    rep = mon.step(10, engine=_FakeEngine(gnorm=1.0, nonfinite=(3,)))
+    assert rep["action"] == "rollback"
+    mon.note_rollback(10)
+    # within cooldown: downgraded with an explicit note
+    rep = mon.step(20, engine=_FakeEngine(gnorm=1.0, nonfinite=(3,)))
+    assert rep["action"] == "warn" and "cooldown" in rep["note"]
+    mon.note_rollback(200)  # second (and last allowed) rollback
+    rep = mon.step(400, engine=_FakeEngine(gnorm=1.0, nonfinite=(3,)))
+    assert rep["action"] == "warn" and "cap" in rep["note"]
+
+
+def test_lr_backoff_applies_to_optimizer():
+    from mxnet_tpu.optimizer import create as opt_create
+
+    opt = opt_create("sgd", learning_rate=0.1)
+    mon = HealthMonitor(every=1, loss_spike=2.0, actions="lr_backoff")
+    for i in range(4):
+        mon.step(i, loss=1.0, optimizer=opt)
+    mon.step(10, loss=100.0, optimizer=opt)           # warn
+    rep = mon.step(11, loss=1000.0, optimizer=opt)    # lr_backoff
+    assert rep["action"] == "lr_backoff"
+    assert math.isclose(opt.learning_rate, 0.05)
+
+
+def test_on_breach_callbacks_fire_and_cannot_break_training():
+    seen = []
+    mon = HealthMonitor(every=1).on_breach(
+        lambda rep, br: seen.append(br)).on_breach(
+        lambda rep, br: 1 / 0)  # a broken pager hook must be swallowed
+    mon.step(1, engine=_FakeEngine(gnorm=1.0, nonfinite=(1,)))
+    assert len(seen) == 1 and seen[0][0]["rule"] == "nonfinite"
+
+
+def test_sampling_period_and_will_sample():
+    mon = HealthMonitor(every=4)
+    outs = []
+    for i in range(8):
+        assert mon.will_sample() == ((i + 1) % 4 == 0)
+        outs.append(mon.step(i, loss=1.0))
+    assert [o is not None for o in outs] == [False, False, False, True] * 2
+
+
+def test_as_monitor_coercions():
+    assert health_mod.as_monitor(None) is None
+    m = HealthMonitor()
+    assert health_mod.as_monitor(m) is m
+    assert isinstance(health_mod.as_monitor(True), HealthMonitor)
+    assert health_mod.as_monitor({"every": 3}).every == 3
+    with pytest.raises(TypeError):
+        health_mod.as_monitor(42)
+
+
+# ---------------------------------------------------------------------------
+# the dispatch-bound proof (pytest -m perf discipline)
+# ---------------------------------------------------------------------------
+
+def _tiny_module(seed=0):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(seed)
+    X = rng.randn(8, 5).astype(np.float32)
+    y = np.array([0, 1, 2, 3] * 2, np.float32)
+    it = NDArrayIter(X, y, batch_size=4, label_name="softmax_label")
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    return mod, next(iter(it))
+
+
+@pytest.mark.perf
+def test_health_dispatch_bound():
+    """Health-on adds ZERO extra program executions (the stats are extra
+    outputs of the one fused update program) — a sampled step pays one
+    batched d2h fetch; an unsampled step pays nothing; health-off is
+    byte-for-byte the baseline dispatch sequence."""
+    # baseline: health fully off
+    os.environ["MXNET_OBS_HEALTH"] = "0"
+    try:
+        mod, batch = _tiny_module()
+        for _ in range(2):
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        with profiler.count_dispatches() as c_off:
+            mod.update()
+        base_compiled = c_off.total_compiled
+        assert c_off.d2h == 0
+    finally:
+        os.environ.pop("MXNET_OBS_HEALTH")
+
+    # health on, monitor-gated: warm BOTH program variants, then measure
+    mod, batch = _tiny_module()
+    mon = HealthMonitor(every=2)
+    health_mod.activate()
+    try:
+        for _ in range(4):
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            health_mod.request_stats(mon.will_sample())
+            mod.update()
+            mon.step(engine=mod._updater._engine)
+
+        # unsampled step: exactly the baseline dispatch sequence
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        health_mod.request_stats(mon.will_sample())
+        assert not mon.will_sample()
+        with profiler.count_dispatches() as c_unsampled:
+            mod.update()
+            mon.step(engine=mod._updater._engine)
+        assert c_unsampled.total_compiled == base_compiled, \
+            c_unsampled.as_dict()
+        assert c_unsampled.d2h == 0
+
+        # sampled step: same ONE program (stats variant) + ONE batched d2h
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        health_mod.request_stats(mon.will_sample())
+        assert mon.will_sample()
+        with profiler.count_dispatches() as c_sampled:
+            mod.update()
+            rep = mon.step(engine=mod._updater._engine)
+        assert rep is not None and rep["ok"]
+        assert c_sampled.total_compiled == base_compiled, c_sampled.as_dict()
+        assert c_sampled.d2h == 1, c_sampled.as_dict()
+    finally:
+        health_mod.request_stats(None)
+        health_mod.deactivate()
+
+
+@pytest.mark.perf
+def test_health_off_is_zero_cost_noop():
+    """With nothing attached, the plane is inert: no stats in the program,
+    no flag beyond one check, no registry writes — and turning the obs
+    TRACING flag on must NOT drag the in-graph stats along (they are real
+    device work; nothing would ever read them without a monitor)."""
+    assert not health_mod.enabled()
+    assert not health_mod.stats_for_this_step()
+    mod, batch = _tiny_module()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert mod._updater._engine.last_health is None
+    assert obs.metrics.registry.get("health.samples") is None
+
+    obs.enable()
+    assert not health_mod.enabled()  # tracing alone never implies stats
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert mod._updater._engine.last_health is None
+
+
+def test_scaler_masked_overflow_is_not_a_fatal_breach():
+    """A found-inf step the scaler already SKIPPED (params untouched) must
+    not trip the fatal nonfinite rule — else routine fp16 scale-growth
+    overflow would burn the rollback budget a real blowup needs."""
+    mon = HealthMonitor(every=1, actions="rollback", skip_streak_threshold=8)
+    rep = mon.step(1, engine=_FakeEngine(gnorm=float("inf"),
+                                         nonfinite=(9, 0), streak=1))
+    assert rep["ok"], rep["breaches"]
+    assert rep["action"] == "none"
+    # scaler-less: the same sample IS fatal
+    mon2 = HealthMonitor(every=1, actions="rollback")
+    rep2 = mon2.step(1, engine=_FakeEngine(gnorm=float("inf"),
+                                           nonfinite=(9, 0)))
+    assert [b["rule"] for b in rep2["breaches"]] == ["nonfinite"]
+
+
+def test_health_handler_rejects_monitor_false():
+    from mxnet_tpu.gluon.contrib.estimator import HealthHandler
+
+    with pytest.raises(ValueError, match="needs a monitor"):
+        HealthHandler(monitor=False)
+
+
+def test_estimator_exception_still_deactivates_health_plane():
+    """An exception mid-fit must not leak the plane's activation (the
+    fused engine would silently keep emitting stats forever after)."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.estimator import Estimator, HealthHandler
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    est = Estimator(net, loss=gloss.SoftmaxCrossEntropyLoss())
+    handler = HealthHandler(monitor=HealthMonitor(every=1))
+
+    class _Boom:
+        def __iter__(self):
+            yield (nd.ones((2, 3)), nd.array([0.0, 1.0]))
+            raise RuntimeError("data source died")
+
+    with pytest.raises(RuntimeError, match="data source died"):
+        est.fit(train_data=_Boom(), epochs=1, event_handlers=[handler])
+    assert health_mod._ACTIVE[0] == 0
+    assert not health_mod.enabled()
+
+
+# ---------------------------------------------------------------------------
+# chaos NaN injection + provenance + rollback-target selection
+# ---------------------------------------------------------------------------
+
+def test_chaos_nan_env_parse_and_occurrence_counting():
+    rules = nan_chaos.parse_env("data@2,4;fc1_weight")
+    assert rules[0].tensor == "data" and rules[0].occurrences == {2, 4}
+    assert rules[1].tensor == "fc1_weight" and rules[1].occurrences is None
+    with pytest.raises(ValueError):
+        nan_chaos.parse_env("@3")
+
+    import jax.numpy as jnp
+
+    nan_chaos.configure([nan_chaos.Rule("x", {2})])
+    v = jnp.ones((4,))
+    out1 = nan_chaos.poison(["x"], [v])     # occurrence 1: clean
+    out2 = nan_chaos.poison(["x"], [v])     # occurrence 2: poisoned
+    out3 = nan_chaos.poison(["x"], [v])     # occurrence 3: clean again
+    assert bool(jnp.all(jnp.isfinite(out1[0])))
+    assert not bool(jnp.all(jnp.isfinite(out2[0])))
+    assert int(jnp.sum(~jnp.isfinite(out2[0]))) == 1  # exactly one element
+    assert bool(jnp.all(jnp.isfinite(out3[0])))
+
+
+def test_chaos_nan_skips_integer_tensors():
+    import jax.numpy as jnp
+
+    nan_chaos.configure([nan_chaos.Rule("idx", None)])
+    with pytest.warns(UserWarning, match="non-float"):
+        out = nan_chaos.poison(["idx"], [jnp.arange(4)])
+    assert bool(jnp.all(out[0] == jnp.arange(4)))
+
+
+def test_blame_pass_names_first_nonfinite_node(obs_on=None):
+    obs.enable()
+    mod, batch = _tiny_module()
+    nan_chaos.configure([nan_chaos.Rule("data", {1})])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    res = health_mod.blame_nonfinite(mod._exec)
+    assert res["node"] == "fc1" and res["op"] == "FullyConnected"
+    assert res["nonfinite_inputs"] == ["data"]
+    evs = [e for e in obs.trace.events() if e[1] == "health.nan_provenance"]
+    assert len(evs) == 1 and evs[0][6]["node"] == "fc1"
+
+
+def test_blame_pass_clean_forward_reports_backward():
+    mod, batch = _tiny_module()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    res = health_mod.blame_nonfinite(mod._exec)
+    assert res["node"] is None and "backward" in res["detail"]
+
+
+def test_find_rollback_target_skips_poisoned_checkpoints(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.checkpoint.state import TrainingState
+
+    man = CheckpointManager(str(tmp_path), async_write=False)
+    good = TrainingState({"arg:w": np.ones((3,), np.float32)},
+                         {"format": 1, "epoch": 0, "nbatch": 1,
+                          "global_step": 1})
+    man.save(good, 1)
+    poisoned = TrainingState(
+        {"arg:w": np.array([1.0, np.nan, 3.0], np.float32)},
+        {"format": 1, "epoch": 0, "nbatch": 2, "global_step": 2})
+    man.save(poisoned, 2)
+    # CRC-valid but non-finite: the newest snapshot must be REJECTED
+    target = health_mod.find_rollback_target(man)
+    assert target is not None and target.global_step == 1
+    man.close()
+
+
+# ---------------------------------------------------------------------------
+# the flagship: NaN mid-epoch -> breach -> blame -> rollback -> bitwise
+# ---------------------------------------------------------------------------
+
+def _flagship_net():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _flagship_run(ckpt_dir, poison_at=None, health=None):
+    np.random.seed(7)
+    mx.random.seed(7)
+    rng = np.random.RandomState(1234)
+    X = rng.randn(64, 10).astype(np.float32)
+    y = rng.randint(0, 4, 64).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=8, shuffle=True,
+                     label_name="softmax_label")
+    mod = Module(_flagship_net(), context=mx.cpu())
+    if poison_at is not None:
+        nan_chaos.configure([nan_chaos.Rule("data", {poison_at})])
+    else:
+        nan_chaos.reset()
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            eval_metric="ce", checkpoint=str(ckpt_dir), resume="never",
+            checkpoint_batch_period=1, health=health)
+    nan_chaos.reset()
+    arg, _aux = mod.get_params()
+    return {k: v.asnumpy() for k, v in arg.items()}
+
+
+def test_flagship_nan_breach_blame_rollback_bitwise(tmp_path):
+    """Acceptance flagship: a NaN injected mid-epoch produces a tagged
+    provenance event naming the first non-finite op, a sentinel breach,
+    an auto-rollback, and a resumed segment bitwise-identical to an
+    uninjected run — all visible in one chrome trace with loss/grad-norm
+    counter tracks."""
+    import json
+
+    obs.enable()
+    ref = _flagship_run(tmp_path / "ref")
+    mon = HealthMonitor(every=1, actions="rollback")
+    out = _flagship_run(tmp_path / "chaos", poison_at=5, health=mon)
+
+    assert mon.rollbacks_done == 1
+    for k in ref:
+        assert np.array_equal(ref[k], out[k]), f"param {k} drifted"
+
+    trace_path = str(tmp_path / "trace.json")
+    obs.export(trace_path)
+    with open(trace_path) as f:
+        doc = json.load(f)  # valid chrome-trace JSON
+    names = {e.get("name") for e in doc["traceEvents"]}
+    for required in ("chaos.nan", "health.breach", "health.nan_provenance",
+                     "health.rollback", "health.loss", "health.grad_norm"):
+        assert required in names, f"missing {required} in trace"
+    prov = [e for e in doc["traceEvents"]
+            if e.get("name") == "health.nan_provenance"]
+    assert prov[0]["args"]["node"] == "fc1"
+
+    # ...and tools/trace_report.py tells the same story as a section
+    import trace_report
+
+    rep = trace_report.report([trace_path])
+    h = rep["health"]
+    assert h is not None
+    assert any(b["rule"] == "nonfinite" for b in h["breaches"])
+    assert h["provenance"][0]["node"] == "fc1"
+    assert any(a["what"] == "health.rollback" for a in h["actions"])
+    assert {t["name"] for t in h["tracks"]} >= {"health.loss",
+                                                "health.grad_norm"}
+
+
+def test_fit_health_without_checkpoint_warns_not_crashes(tmp_path):
+    """A rollback request with no checkpoint manager degrades to a warning
+    — the fit completes (on NaN'd params, honestly reported)."""
+    mon = HealthMonitor(every=1, actions="rollback")
+    np.random.seed(3)
+    mx.random.seed(3)
+    rng = np.random.RandomState(5)
+    X = rng.randn(32, 10).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=8, label_name="softmax_label")
+    mod = Module(_flagship_net(), context=mx.cpu())
+    nan_chaos.configure([nan_chaos.Rule("data", {2})])
+    mod.fit(it, num_epoch=1, optimizer="sgd", eval_metric="ce", health=mon)
+    assert mon.last_report is not None
+    assert mon.rollbacks_done == 0
+
+
+# ---------------------------------------------------------------------------
+# integration satellites: Trainer, estimator, Monitor adapter
+# ---------------------------------------------------------------------------
+
+def test_trainer_attach_skip_streak_breach_through_real_scaler():
+    from mxnet_tpu import amp, autograd, nd
+    from mxnet_tpu.gluon import Trainer, nn
+
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01})
+    scaler = amp.LossScaler()
+    amp.init_trainer(tr, scaler)
+    mon = tr.attach_health_monitor(
+        HealthMonitor(every=1, skip_streak_threshold=3))
+    x = nd.ones((2, 3))
+    try:
+        for i in range(5):
+            with autograd.record():
+                loss = (net(x) ** 2).sum() * np.nan  # every step overflows
+            loss.backward()
+            tr.step(2)
+        rep = mon.last_report
+        assert rep is not None
+        rules = {b["rule"] for b in rep["breaches"]}
+        assert "scaler_skip_streak" in rules
+        # a scaler-masked overflow is NOT fatal (update skipped, params
+        # untouched) — only the streak breaches
+        assert "nonfinite" not in rules
+        assert rep["skip_streak"] >= 3
+    finally:
+        tr.attach_health_monitor(None)
+
+
+def test_estimator_health_handler_samples_and_stops_on_nonfinite():
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.estimator import Estimator, HealthHandler
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize()
+    est = Estimator(net, loss=gloss.SoftmaxCrossEntropyLoss())
+    handler = HealthHandler(monitor=HealthMonitor(every=2),
+                            stop_on_nonfinite=True)
+    rng = np.random.RandomState(0)
+    batches = [(nd.array(rng.randn(4, 6).astype(np.float32)),
+                nd.array(np.array([0, 1, 2, 3], np.float32)))
+               for _ in range(6)]
+    est.fit(train_data=batches, epochs=1, event_handlers=[handler])
+    rep = handler.monitor.last_report
+    assert rep is not None and rep["loss"] is not None
+    assert rep["grad_norm"] is not None  # engine stats flowed through
+
+
+def test_monitor_adapter_routes_health_gauges():
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.monitor import Monitor
+
+    obs.enable()
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize()
+    mon = Monitor(interval=1, pattern=".*dense.*")
+    mon.install_gluon(net)
+    try:
+        mon.tic()
+        net(mx.nd.ones((2, 6)))
+        with profiler.count_dispatches() as c:
+            stats = mon.toc()
+    finally:
+        mon.uninstall_gluon()
+    assert len(stats) >= 2
+    assert c.d2h == 1  # still ONE batched transfer, via health.batched_fetch
+    gauges = [n for n in obs.metrics.registry.names()
+              if n.startswith("health.monitor.")]
+    assert len(gauges) >= 2
+
+
+def test_health_metrics_land_in_prometheus_exposition():
+    from mxnet_tpu.obs.export import to_prometheus
+
+    obs.enable()
+    mon = HealthMonitor(every=1)
+    mon.step(1, loss=1.25, engine=_FakeEngine(gnorm=2.0))
+    text = to_prometheus(obs.metrics.snapshot())
+    assert "health_loss" in text and "health_grad_norm" in text

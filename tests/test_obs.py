@@ -497,9 +497,10 @@ def test_monitor_batches_stat_transfers(obs_on):
     # ONE batched device_get for all stats (the old code paid one blocking
     # asnumpy per watched tensor)
     assert c.d2h == 1
-    # ...and the stats land in the registry as monitor.* gauges
+    # ...and the stats land in the registry as health-plane gauges (the
+    # Monitor is an adapter over obs/health.py since the health PR)
     gauges = [n for n in obs.metrics.registry.names()
-              if n.startswith("monitor.")]
+              if n.startswith("health.monitor.")]
     assert len(gauges) >= 2
 
 

@@ -265,6 +265,39 @@ def device_cost_table(instants: List[dict], top: int = 10) -> List[dict]:
     return rows[:top]
 
 
+def health_section(instants: List[dict], counters: List[dict],
+                   metrics: Optional[dict]) -> Optional[dict]:
+    """The training-health story in one block: the loss / grad-norm counter
+    tracks' trajectory, every sentinel breach (rule + detail), NaN
+    provenance verdicts (first non-finite node), lr backoffs, rollbacks,
+    and injected NaN chaos — the events obs/health.py emits
+    (docs/OBSERVABILITY.md "Training health"). None when the trace carries
+    no health plane at all."""
+    tracks = [c for c in counter_tracks(counters)
+              if c["name"].startswith("health.")]
+    breaches, provenance, actions = [], [], []
+    for ev in instants:
+        a = ev.get("args") or {}
+        if ev["name"] == "health.breach":
+            breaches.append({"t": ev["ts"], "rule": a.get("rule"),
+                             "detail": a.get("detail"),
+                             "step": a.get("step")})
+        elif ev["name"] == "health.nan_provenance":
+            provenance.append({"t": ev["ts"], "node": a.get("node"),
+                               "op": a.get("op"),
+                               "nonfinite_inputs":
+                                   a.get("nonfinite_inputs")})
+        elif ev["name"] in ("health.rollback", "health.lr_backoff",
+                            "chaos.nan"):
+            actions.append({"t": ev["ts"], "what": ev["name"], **a})
+    gauges = {k: v for k, v in ((metrics or {}).get("gauges") or {}).items()
+              if k.startswith("health.")}
+    if not (tracks or breaches or provenance or actions or gauges):
+        return None
+    return {"tracks": tracks, "breaches": breaches,
+            "provenance": provenance, "actions": actions, "gauges": gauges}
+
+
 def report(paths, top: int = 10, _loaded=None) -> dict:
     """Build the full report as data (the CLI renders it; tests assert on
     it). ``paths``: one path or a list — multiple inputs merge onto
@@ -285,6 +318,7 @@ def report(paths, top: int = 10, _loaded=None) -> dict:
         "events": instants,
         "counters": counter_tracks(counters),
         "device_programs": device_cost_table(instants, top=top),
+        "health": health_section(instants, counters, metrics),
         "metrics": metrics,
     }
     return out
@@ -398,6 +432,27 @@ def render(rep: dict, stream=None) -> None:
               f"{p['flops'] / 1e9:>10.4g}"
               f"{p['bytes_accessed'] / 1e6:>13.4g}"
               f"{p['peak_hbm_bytes'] / 1e6:>13.4g}\n")
+
+    h = rep.get("health")
+    if h:
+        w("\nTraining health:\n")
+        for c in h["tracks"]:
+            w(f"  {c['name']:<28}{c['samples']:>6} samples  "
+              f"min {c['min']:.6g}  max {c['max']:.6g}  "
+              f"last {c['last']:.6g}\n")
+        for b in h["breaches"]:
+            w(f"  ! t={b['t']:.3f}s breach [{b['rule']}] "
+              f"{b.get('detail') or ''}\n")
+        for p in h["provenance"]:
+            w(f"  ! t={p['t']:.3f}s NaN provenance: first non-finite at "
+              f"{p.get('node')} ({p.get('op')}), bad inputs: "
+              f"{p.get('nonfinite_inputs')}\n")
+        for a in h["actions"]:
+            extra = {k: v for k, v in a.items() if k not in ("t", "what")}
+            w(f"  > t={a['t']:.3f}s {a['what']} "
+              f"{json.dumps(extra, default=str) if extra else ''}\n")
+        if not (h["breaches"] or h["provenance"] or h["actions"]):
+            w("  no breaches — run healthy\n")
 
     if rep["events"]:
         w("\nTagged events:\n")
