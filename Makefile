@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve perf obs health serve serve-bench dossier
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench dossier
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -35,6 +35,15 @@ chaos:
 chaos-serve:
 	$(PYTHON) -m pytest tests/test_fleet.py tests/test_platform.py -q -p no:cacheprovider
 	$(PYTHON) tools/serve_bench.py --chaos --duration 9 --qps 80
+
+# elastic-training suite (docs/ROBUSTNESS.md "Elastic training"): worker
+# membership/heartbeats, generation-scoped barriers released over
+# survivors, PS snapshot+WAL durability, checkpointed rejoin — incl. the
+# slow flagships (1-of-3 worker SIGKILL mid-epoch; PS SIGKILL mid-push);
+# then the measured recovery/rejoin/overhead numbers
+elastic:
+	$(PYTHON) -m pytest tests/ -q -m elastic -p no:cacheprovider
+	$(PYTHON) tools/elastic_bench.py
 
 # dispatch-overhead guarantees (docs/PERFORMANCE.md): the perf-marked tests
 # assert a Trainer.step updates all params in <=2 compiled programs, then

@@ -544,6 +544,29 @@ def bench_health_overhead(platform):
     return res
 
 
+def bench_elastic(platform):
+    """Elastic-training plane (docs/ROBUSTNESS.md "Elastic training"):
+    worker-death recovery time and rejoin-to-training latency, plus the
+    membership plane's idle cost on PS RPC throughput (interleaved
+    off-vs-on segments, best-of each side), gated under the same 5%
+    budget as the obs/health overhead legs — heartbeats must cost nothing
+    when nothing is failing."""
+    del platform  # host-side plane: same measurement on any backend
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import elastic_bench
+
+    res = elastic_bench.run_elastic_bench(
+        workers=int(os.environ.get("BENCH_ELASTIC_WORKERS", 3)),
+        ops=int(os.environ.get("BENCH_ELASTIC_OPS", 200)))
+    assert res["ok"], (
+        f"elastic plane out of budget: overhead "
+        f"{res['elastic_overhead_pct']}% (gate {res['threshold_pct']}%), "
+        f"recovery {res['elastic_recovery_s']}s, "
+        f"rejoin {res['rejoin_to_training_s']}s")
+    return res
+
+
 def bench_update_engine_dispatches():
     """Compiled executions per optimizer step (tools/profile_step.py
     counters): the fused engine must stay at 1 program regardless of the
@@ -792,6 +815,18 @@ def main():
             extra["health_overhead"] = bench_health_overhead(platform)
         except Exception as e:
             extra["health_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("elastic"):
+        try:
+            # elastic training must be free when nothing fails: membership
+            # overhead <5% gated, plus measured death-recovery and
+            # rejoin-to-training times (docs/ROBUSTNESS.md "Elastic
+            # training"); extra.elastic.elastic_recovery_s is the
+            # trajectory number alongside serve's chaos metrics
+            extra["elastic"] = bench_elastic(platform)
+            extra["elastic_recovery_s"] = \
+                extra["elastic"]["elastic_recovery_s"]
+        except Exception as e:
+            extra["elastic_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
             and not over_budget("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's
@@ -840,6 +875,7 @@ def main():
         "serve": "serve",
         "obs_overhead": "obs_overhead",
         "health_overhead": "health_overhead",
+        "elastic": "elastic",
     }
     leg_error_key = {"bert_base_bf16": "bert_error"}  # irregular names
     extra["legs_run"] = [l for l, k in leg_result_key.items() if k in extra]

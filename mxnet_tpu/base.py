@@ -24,6 +24,7 @@ __all__ = [
     "dtype_np",
     "dtype_name",
     "capped_backoff",
+    "configure_socket_keepalive",
 ]
 
 
@@ -39,6 +40,31 @@ def capped_backoff(attempt: int, base_interval: float,
 
     delay = min(float(max_interval), float(base_interval) * (2.0 ** attempt))
     return delay * (0.5 + random.random() / 2.0)
+
+
+def configure_socket_keepalive(sock, idle: int = 30, interval: int = 5,
+                               count: int = 3) -> None:
+    """Enable TCP keepalive on ``sock`` (half-open-connection detection).
+
+    The ONE keepalive policy shared by the PS client, the serve client, and
+    the elastic heartbeater: a peer that vanished without a FIN (SIGKILL'd
+    VM, dropped tunnel) is detected by the kernel after
+    ``idle + interval*count`` seconds instead of whenever the OS default
+    (often hours) gives up. The per-platform TCP_KEEP* constants are probed
+    — missing ones just fall back to the system defaults; any OSError is
+    swallowed because keepalive is an optimization, never a correctness
+    requirement (the RPC layers still carry their own timeouts)."""
+    import socket as _socket
+
+    try:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_KEEPALIVE, 1)
+        for opt, val in (("TCP_KEEPIDLE", idle), ("TCP_KEEPINTVL", interval),
+                         ("TCP_KEEPCNT", count)):
+            if hasattr(_socket, opt):
+                sock.setsockopt(_socket.IPPROTO_TCP,
+                                getattr(_socket, opt), val)
+    except OSError:
+        pass
 
 
 class MXNetError(RuntimeError):

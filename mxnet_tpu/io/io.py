@@ -93,6 +93,17 @@ class DataIter:
         raise NotImplementedError(
             f"{type(self).__name__} does not support mid-epoch resume")
 
+    # -- elastic-training hook (docs/ROBUSTNESS.md "Elastic training") ----
+    def set_partition(self, part_index, num_parts):
+        """Recut this iterator's rank shard (``part_index`` of
+        ``num_parts`` over the FULL dataset). Called at epoch boundaries
+        when fleet membership changed — survivors absorb a dead worker's
+        shard, a rejoiner takes its recut slice. Iterators that cannot be
+        recut raise; the elastic fit loop treats that as
+        "keep the construction-time shard"."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support shard recutting")
+
 
 def _shard(arr, part_index, num_parts):
     if num_parts <= 1:
@@ -112,16 +123,53 @@ class NDArrayIter(DataIter):
                  last_batch_handle="pad", data_name="data", label_name="softmax_label",
                  part_index=0, num_parts=1):
         super().__init__(batch_size)
-        self.data = _normalize(data, data_name)
-        self.label = _normalize(label, label_name)
-        self.data = [(k, _shard(v, part_index, num_parts)) for k, v in self.data]
-        self.label = [(k, _shard(v, part_index, num_parts)) for k, v in self.label]
+        # the FULL dataset is retained so elastic training can recut the
+        # rank shard at an epoch boundary (set_partition); self.data/label
+        # always hold the current shard's view
+        self._full_data = _normalize(data, data_name)
+        self._full_label = _normalize(label, label_name)
         self._shuffle = shuffle
         self._last = last_batch_handle
-        self.num_data = self.data[0][1].shape[0] if self.data else 0
-        self.cursor = -batch_size
-        self._order = np.arange(self.num_data)
+        self.part_index, self.num_parts = int(part_index), int(num_parts)
+        self._apply_partition()
         if shuffle:
+            np.random.shuffle(self._order)
+
+    def _apply_partition(self):
+        self.data = [(k, _shard(v, self.part_index, self.num_parts))
+                     for k, v in self._full_data]
+        self.label = [(k, _shard(v, self.part_index, self.num_parts))
+                      for k, v in self._full_label]
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        self.cursor = -self.batch_size
+        self._order = np.arange(self.num_data)
+
+    def set_partition(self, part_index, num_parts):
+        """Recut the rank shard over the full dataset (elastic fit loops
+        call this at epoch boundaries only — it rewinds the cursor and
+        resets the shuffle order, which the next ``reset()`` reshuffles).
+
+        Shards are trimmed to the EQUAL size ``n // num_parts`` (drop-last
+        over the remainder): elastic sync is lockstep, so every live rank
+        must run the same number of batches per epoch — and a user cannot
+        pre-size a dataset divisibly for every possible surviving fleet
+        size. At most ``num_parts - 1`` trailing samples sit out per
+        epoch.
+
+        Always recuts — even for an unchanged ``(part_index, num_parts)``:
+        an iterator pre-sharded at construction keeps the remainder-
+        unbalanced cut until this runs, and skipping the trim for it would
+        quietly reintroduce the unequal batch counts."""
+        self.part_index, self.num_parts = int(part_index), int(num_parts)
+        self._apply_partition()
+        total = self._full_data[0][1].shape[0] if self._full_data else 0
+        even = total // max(1, self.num_parts)
+        if self.num_data > even:
+            self.data = [(k, v[:even]) for k, v in self.data]
+            self.label = [(k, v[:even]) for k, v in self.label]
+            self.num_data = even
+            self._order = np.arange(even)
+        if self._shuffle:
             np.random.shuffle(self._order)
 
     @property
@@ -245,6 +293,9 @@ class CSVIter(DataIter):
     def set_checkpoint_state(self, state):
         self._inner.set_checkpoint_state(state)
 
+    def set_partition(self, part_index, num_parts):
+        self._inner.set_partition(part_index, num_parts)
+
 
 class MNISTIter(DataIter):
     """MNIST IDX file iterator (reference src/io/iter_mnist.cc analog)."""
@@ -283,6 +334,9 @@ class MNISTIter(DataIter):
 
     def set_checkpoint_state(self, state):
         self._inner.set_checkpoint_state(state)
+
+    def set_partition(self, part_index, num_parts):
+        self._inner.set_partition(part_index, num_parts)
 
 
 class ImageRecordIter(DataIter):
@@ -338,7 +392,9 @@ class ImageRecordIter(DataIter):
                     break
                 self._offsets.append(pos)
             self._rec = rec
-        self._offsets = _shard(np.asarray(self._offsets), part_index, num_parts)
+        self._full_offsets = np.asarray(self._offsets)
+        self.part_index, self.num_parts = int(part_index), int(num_parts)
+        self._offsets = _shard(self._full_offsets, part_index, num_parts)
         self._order = np.arange(len(self._offsets))
         self.cursor = 0
         if shuffle:
@@ -369,6 +425,25 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self.cursor = 0
+        if self._shuffle:
+            np.random.shuffle(self._order)
+
+    def set_partition(self, part_index, num_parts):
+        """Recut the record-offset shard (elastic epoch boundary); under the
+        read lock because prefetch workers may still be draining. Shards
+        are trimmed to the equal ``n // num_parts`` size (drop-last) so
+        every live rank runs the same batch count — the lockstep-reduce
+        invariant. Always recuts (see NDArrayIter.set_partition: a
+        construction-time shard is remainder-unbalanced until trimmed)."""
+        with self._read_lock:
+            self.part_index, self.num_parts = int(part_index), int(num_parts)
+            self._offsets = _shard(self._full_offsets, self.part_index,
+                                   self.num_parts)
+            even = len(self._full_offsets) // max(1, self.num_parts)
+            if len(self._offsets) > even:
+                self._offsets = self._offsets[:even]
+            self._order = np.arange(len(self._offsets))
+            self.cursor = 0
         if self._shuffle:
             np.random.shuffle(self._order)
 
@@ -615,6 +690,16 @@ class PrefetchingIter(DataIter):
     def reset(self):
         self._drain()
         self.iter.reset()
+
+    def set_partition(self, part_index, num_parts):
+        """Recut the backing iterator's shard; in-flight prefetches are
+        drained first so no batch from the old cut leaks into the new.
+        (Positioning/checkpoint state stays intentionally unimplemented:
+        the backing cursor runs ahead of the consumer by up to ``prefetch``
+        reserved batches, so a naive snapshot would skip batches on
+        resume.)"""
+        self._drain()
+        self.iter.set_partition(part_index, num_parts)
 
     def _drain(self):
         for f in self._queue:

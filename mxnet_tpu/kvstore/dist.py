@@ -17,6 +17,14 @@ TPU-native redesign:
   workers push grads, the server applies the optimizer on arrival, workers
   pull fresh weights with no barrier (native/ps/ps_server.cc or the python
   twin mxnet_tpu/kvstore/ps_server.py).
+- **elastic** ``dist_sync`` (``MXNET_ELASTIC=1`` + a PS address, see
+  docs/ROBUSTNESS.md "Elastic training"): the sync reduction rides the PS
+  wire as a generation-scoped allreduce (``kvstore/elastic.py``) instead
+  of a jax.distributed collective, so a SIGKILL'd worker releases — not
+  wedges — every barrier, survivors recut the data shards at the next
+  epoch boundary, and a restarted worker rejoins from the shared
+  checkpoint. Also the only multi-process sync transport on backends
+  without multiprocess collectives (the CPU backend, notably).
 
 Create the kvstore before touching any jax arrays: ``jax.distributed``
 must initialize before the local backend is first used (same
@@ -27,6 +35,7 @@ from __future__ import annotations
 import os
 
 from ..base import MXNetError, get_env
+from . import elastic as elastic_mod
 from .kvstore import KVStore, _as_list
 
 __all__ = ["DistKVStore"]
@@ -43,14 +52,24 @@ class DistKVStore(KVStore):
         self._ps = None
         self._mesh = None
         self._gc = None
+        self._elastic = None
         self._batch = {}  # pending local merges awaiting the fused collective
+        addr = get_env("MXNET_PS_ADDR", get_env("DMLC_PS_ROOT_URI", None))
+        port = int(get_env("MXNET_PS_PORT", get_env("DMLC_PS_ROOT_PORT", 9091, int), int) or 9091)
         if self._is_async:
-            addr = get_env("MXNET_PS_ADDR", get_env("DMLC_PS_ROOT_URI", None))
-            port = int(get_env("MXNET_PS_PORT", get_env("DMLC_PS_ROOT_PORT", 9091, int), int) or 9091)
             if addr:
                 from .ps_client import PSClient
 
                 self._ps = PSClient(addr, port)
+        elif elastic_mod.elastic_enabled() and addr:
+            # elastic dist_sync: reductions over the PS wire, scoped to the
+            # live membership generation (docs/ROBUSTNESS.md). Joining here
+            # (kvstore-creation time) keeps the reference's create-first
+            # ordering; a restarted worker lands quarantined and Module.fit
+            # resolves the rejoin at the next epoch boundary.
+            self._elastic = elastic_mod.ElasticWorkerSession(
+                addr, port, rank=self._rank, expected=self._num_workers)
+            self._elastic.ensure_joined()
         else:
             self._maybe_init_jax_distributed()
 
@@ -106,6 +125,13 @@ class DistKVStore(KVStore):
 
         from ..ndarray import NDArray
 
+        if self._elastic is not None:
+            local = np.asarray(nd_arr.asnumpy())
+            if bcast_from is not None and self._rank != bcast_from:
+                local = np.zeros_like(local)
+            summed, _n = self._elastic.allreduce("__allreduce__", local)
+            return NDArray(np.asarray(summed, local.dtype).reshape(
+                local.shape))
         if self._num_workers <= 1 or jax.process_count() == 1:
             return nd_arr
         mesh = self._dcn_mesh()
@@ -124,6 +150,72 @@ class DistKVStore(KVStore):
     @property
     def num_workers(self):
         return self._num_workers
+
+    @property
+    def elastic(self):
+        """The :class:`~mxnet_tpu.kvstore.elastic.ElasticWorkerSession` in
+        elastic dist_sync mode, else None. ``Module.fit`` keys its elastic
+        hooks (quarantined rejoin, grad sync, epoch rendezvous + shard
+        recut) off this."""
+        return self._elastic
+
+    def _fused_flat_reduce(self, arrays, key: str, zero_local: bool):
+        """One fused sum-reduction of many arrays: flatten-concat, reduce
+        over the fleet (elastic generation-scoped reduce or the jax
+        collective), split back. ``zero_local`` contributes zeros (the
+        broadcast idiom: the sum is then the sole contributor's values).
+        Returns ``(summed_arrays, contributors)``."""
+        import numpy as np
+
+        shapes = [a.shape for a in arrays]
+        sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]
+        flat = np.concatenate(
+            [np.asarray(a, np.float32).ravel() for a in arrays]) \
+            if arrays else np.zeros(0, np.float32)
+        if zero_local:
+            flat = np.zeros_like(flat)
+        if self._elastic is not None:
+            summed, n = self._elastic.allreduce(key, flat)
+        else:
+            from ..ndarray import NDArray
+
+            summed = self._allreduce(NDArray(flat)).asnumpy()
+            n = self._num_workers
+        summed = np.asarray(summed, np.float32)
+        out, off = [], 0
+        for shape, size in zip(shapes, sizes):
+            out.append(summed[off:off + size].reshape(shape))
+            off += size
+        return out, n
+
+    def allreduce_mean(self, arrays):
+        """Mean-allreduce a list of numpy arrays over the LIVE fleet in one
+        fused reduction. Returns ``(means, contributors)``. Under
+        elasticity the divisor is the count that actually contributed —
+        when a worker dies mid-epoch the survivors' gradient *scale* stays
+        a mean, it just averages fewer shards (documented tolerance in
+        docs/ROBUSTNESS.md)."""
+        summed, n = self._fused_flat_reduce(arrays, "__grads__",
+                                            zero_local=False)
+        return [s / max(1, n) for s in summed], n
+
+    def broadcast_arrays(self, arrays, root: bool):
+        """One fused broadcast over the live fleet: the root's values win
+        (non-roots contribute zeros to the sum-reduce — the
+        ``_allreduce(bcast_from=)`` idiom). Used by the elastic fit's
+        initial-parameter sync so differently-initialized ranks can never
+        silently train divergent models."""
+        out, _n = self._fused_flat_reduce(arrays, "__bcast__",
+                                          zero_local=not root)
+        return out
+
+    def close(self):
+        """Leave the fleet cleanly (elastic mode): deregisters this worker
+        so the membership generation bumps now instead of after K missed
+        heartbeats."""
+        if self._elastic is not None:
+            self._elastic.close()
+            self._elastic = None
 
     def init(self, key, value):
         if self._ps is not None:
@@ -239,6 +331,17 @@ class DistKVStore(KVStore):
         on the wire) and decode+sum them in one jitted program per worker."""
         import numpy as np
 
+        if self._elastic is not None:
+            # elastic transport: decode the local codes and sum the floats
+            # through the generation-scoped reduce (same numerics — the
+            # quantization/error-feedback already happened in compress())
+            from .compression import dequantize_2bit
+
+            decoded = np.asarray(
+                dequantize_2bit(packed, threshold, packed.size * 4),
+                np.float32)
+            summed, _n = self._elastic.allreduce("__packed__", decoded)
+            return np.asarray(summed, np.float32)
         if self._num_workers <= 1:
             from .compression import dequantize_2bit
 
@@ -298,6 +401,11 @@ class DistKVStore(KVStore):
             self._ps.barrier()
             return
         self._flush_batch()
+        if self._elastic is not None:
+            # generation-scoped: the server counts LIVE members, so a dead
+            # rank releases the rendezvous over the survivors
+            self._elastic.barrier()
+            return
         if self._num_workers > 1:
             import numpy as np
 

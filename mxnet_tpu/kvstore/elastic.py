@@ -1,0 +1,999 @@
+"""Elastic training plane: worker membership, generations, survivable sync.
+
+The reference's distributed identity is ps-lite, whose design premise is
+surviving flaky peers (PAPER.md §1) — yet a classic ``dist_sync`` run dies
+with its weakest worker: one SIGKILL wedges every barrier until a blanket
+timeout. This module gives the *training* plane the same supervision story
+PR 6 gave serving (``serve/fleet.py``):
+
+- **Membership** (:class:`ElasticState`, server side): workers announce
+  themselves (``OP_JOIN``) and heartbeat (``OP_HB``) on the existing PS
+  wire framing. A liveness monitor declares a worker dead after K missed
+  heartbeats and bumps a monotonically increasing **generation** number;
+  every barrier, reduction round, and epoch rendezvous is scoped to the
+  live membership, so a dead rank *releases* collective waits over the
+  survivors instead of timing them out.
+- **Generation-scoped sync reduction** (``OP_REDUCE``): the allreduce
+  transport for elastic ``dist_sync`` — workers contribute one array per
+  round; the round completes when every *live* member contributed (a
+  mid-round death shrinks the requirement). Contributions are deduped by
+  client id and completed rounds are LRU-cached, so a retried frame whose
+  ack was lost is answered idempotently (the ``(client_id, seq)`` push
+  idiom from PR 2).
+- **Epoch rendezvous** (``OP_EPOCH``): a generation-scoped barrier at
+  epoch boundaries where membership changes are *applied*: quarantined
+  joiners are activated, the data-shard assignment (``part_index`` /
+  ``num_parts`` over ranks) is recut, and reduce-round numbering resets.
+  A worker that restarts mid-epoch is **quarantined** until the next
+  boundary (the fleet resync idiom from ``serve/fleet.py`` applied to
+  training ranks) and meanwhile restores weights/optimizer/RNG from the
+  shared PR-2 checkpoint directory — the checkpointed rejoin.
+- **Worker session** (:class:`ElasticWorkerSession`, client side): join /
+  await-activation / allreduce / epoch_end plus a background
+  :class:`Heartbeater` on its own socket.
+
+PS state durability (server snapshots through ``checkpoint/``'s atomic+CRC
+machinery, warm restart with the seq-dedup table intact) lives in
+``ps_server.py``; the capture/install helpers are here (:func:`capture_server_state`
+/ :func:`install_server_state`).
+
+Env knobs (registered in ``mxnet_tpu/runtime.py``): ``MXNET_ELASTIC``,
+``MXNET_ELASTIC_HEARTBEAT_S``, ``MXNET_ELASTIC_MISS_K``,
+``MXNET_ELASTIC_JOIN_TIMEOUT_S``, ``MXNET_ELASTIC_REDUCE_TIMEOUT_S``.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import obs
+from ..base import MXNetError, get_env
+
+__all__ = ["ElasticState", "ElasticWorkerSession", "Heartbeater", "JoinInfo",
+           "ElasticError", "StaleMemberError", "elastic_enabled",
+           "heartbeat_interval", "miss_threshold", "capture_server_state",
+           "install_server_state", "ELASTIC_OP_NAMES"]
+
+# Opcodes 16-20: the elastic-training range on the PS wire (0-9 = kvstore,
+# 32-42 = serve — same length-prefixed framing, see ps_server.py docstring).
+OP_HB, OP_JOIN, OP_REDUCE, OP_EPOCH, OP_LEAVE = 16, 17, 18, 19, 20
+
+ELASTIC_OP_NAMES = {OP_HB: "heartbeat", OP_JOIN: "join", OP_REDUCE: "reduce",
+                    OP_EPOCH: "epoch", OP_LEAVE: "leave"}
+
+# OP_EPOCH payload carrying this epoch value means "block until my
+# quarantined membership is activated" (the rejoin wait).
+WAIT_ACTIVATION = (1 << 64) - 1
+
+# member status codes on the wire
+ST_OK, ST_ERROR, ST_QUARANTINED, ST_STALE = 0, 1, 2, 3
+
+
+class ElasticError(MXNetError):
+    """An elastic-plane RPC failed structurally (timeout / protocol)."""
+
+
+class StaleMemberError(ElasticError):
+    """The server no longer counts this worker as a live member — it was
+    declared dead (missed heartbeats) or never activated. The worker must
+    re-join at the next epoch boundary; continuing to push would mix a
+    stale generation into the live fleet's reductions."""
+
+
+def elastic_enabled() -> bool:
+    return bool(get_env("MXNET_ELASTIC", False, bool))
+
+
+def heartbeat_interval() -> float:
+    return float(get_env("MXNET_ELASTIC_HEARTBEAT_S", 0.5, float))
+
+
+def miss_threshold() -> int:
+    return int(get_env("MXNET_ELASTIC_MISS_K", 4, int))
+
+
+def _join_timeout() -> float:
+    return float(get_env("MXNET_ELASTIC_JOIN_TIMEOUT_S", 600.0, float))
+
+
+def _reduce_timeout() -> float:
+    return float(get_env("MXNET_ELASTIC_REDUCE_TIMEOUT_S", 120.0, float))
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+class _Member:
+    __slots__ = ("cid", "rank", "state", "last_hb", "joined_gen")
+
+    def __init__(self, cid: int, rank: int, state: str, gen: int):
+        self.cid = cid
+        self.rank = rank
+        self.state = state  # active | quarantined | dead
+        self.last_hb = time.monotonic()
+        self.joined_gen = gen
+
+
+class _Round:
+    __slots__ = ("contribs",)
+
+    def __init__(self):
+        self.contribs: Dict[int, np.ndarray] = {}
+
+
+class ElasticState:
+    """Server-side membership + generation-scoped collectives.
+
+    One Condition guards everything; collective waits (reduce rounds, epoch
+    rendezvous) re-evaluate their completion condition on every wake, so a
+    membership change (death, activation) *releases* them over the
+    surviving set instead of leaving them to time out.
+    """
+
+    def __init__(self, hb_interval: Optional[float] = None,
+                 miss_k: Optional[int] = None, on_change=None):
+        self.cv = threading.Condition()
+        self.members: Dict[int, _Member] = {}
+        self.generation = 0
+        self.epoch = 0  # the epoch currently in progress fleet-wide
+        self.started = False  # any reduce/epoch seen → later joins quarantine
+        self.hb_interval = (heartbeat_interval() if hb_interval is None
+                            else float(hb_interval))
+        self.miss_k = miss_threshold() if miss_k is None else int(miss_k)
+        self._rounds: Dict = {}        # (key, round) -> _Round
+        self._completed: "OrderedDict" = OrderedDict()  # LRU: retried rounds
+        self._epoch_arrived: set = set()
+        self._last_release: Optional[dict] = None
+        # callbacks poked (outside cv) after any membership change — the
+        # PSServer hangs its barrier-release re-check here
+        self._on_change = list(on_change or [])
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- views (call with cv held unless noted) -------------------------
+    def has_members(self) -> bool:
+        return bool(self.members)
+
+    def active_members(self):
+        return [m for m in self.members.values() if m.state == "active"]
+
+    def active_count(self) -> int:
+        return len(self.active_members())
+
+    def assignment(self, cid: int):
+        """(part_index, num_parts) over actives ordered by (rank, cid)."""
+        order = sorted(self.active_members(), key=lambda m: (m.rank, m.cid))
+        for i, m in enumerate(order):
+            if m.cid == cid:
+                return i, len(order)
+        return 0, max(1, len(order))
+
+    def liveness_table(self):
+        """[(rank, cid, state, heartbeat_age_s)] — the structured
+        barrier-timeout report and STATS both read this."""
+        now = time.monotonic()
+        with self.cv:
+            return [(m.rank, m.cid, m.state, round(now - m.last_hb, 3))
+                    for m in self.members.values()]
+
+    # -- membership -----------------------------------------------------
+    def join(self, cid: int, rank: int):
+        with self.cv:
+            m = self.members.get(cid)
+            if m is None:
+                state = "quarantined" if self.started else "active"
+                m = _Member(cid, rank, state, self.generation)
+                self.members[cid] = m
+                if state == "active":
+                    self._bump_generation("join", cid=cid, rank=rank)
+                obs.inc("elastic.joins")
+                obs.event("elastic.member_joined", cid=cid, rank=rank,
+                          state=state, generation=self.generation)
+            elif m.state != "dead":
+                # same guard as heartbeat(): a declared-dead cid's join
+                # retries must not refresh last_hb — that would pin the
+                # corpse past the prune GC and lock the cid out forever
+                # (post-prune, a fresh join re-registers it cleanly)
+                m.last_hb = time.monotonic()
+            part, nparts = self.assignment(cid)
+            reply = (m.state, self.generation, self.epoch, part, nparts,
+                     self.active_count())
+        self._ensure_monitor()
+        self._notify_change()
+        return reply
+
+    def heartbeat(self, cid: int):
+        with self.cv:
+            m = self.members.get(cid)
+            if m is None:
+                return ST_ERROR, self.generation, self.active_count()
+            if m.state != "dead":
+                # a DEAD member's beats must not refresh last_hb: a zombie
+                # that keeps heartbeating would otherwise defeat the
+                # liveness loop's prune_after GC forever
+                m.last_hb = time.monotonic()
+            st = ST_OK if m.state == "active" else (
+                ST_QUARANTINED if m.state == "quarantined" else ST_STALE)
+            return st, self.generation, self.active_count()
+
+    def leave(self, cid: int):
+        with self.cv:
+            m = self.members.pop(cid, None)
+            if m is not None and m.state == "active":
+                self._bump_generation("leave", cid=cid, rank=m.rank)
+                if not self.active_members():
+                    # fleet takeover (same rule as the all-dead case): a
+                    # joiner quarantined behind a fleet that has finished
+                    # and left would otherwise wait for a boundary nobody
+                    # can ever reach
+                    self._takeover_locked()
+                self._reevaluate_locked()
+        self._notify_change()
+
+    def _bump_generation(self, reason: str, **attrs):
+        """Caller holds cv."""
+        self.generation += 1
+        obs.set_gauge("elastic.generation", self.generation)
+        obs.set_gauge("elastic.active_workers", self.active_count())
+        obs.event("elastic.generation_bump", reason=reason,
+                  generation=self.generation, **attrs)
+
+    # -- liveness monitor ------------------------------------------------
+    def _ensure_monitor(self):
+        if self._monitor is None or not self._monitor.is_alive():
+            self._monitor = threading.Thread(
+                target=self._liveness_loop, daemon=True,
+                name="mxtpu-elastic-liveness")
+            self._monitor.start()
+
+    def close(self):
+        self._stop.set()
+        with self.cv:
+            self.cv.notify_all()
+
+    def _liveness_loop(self):
+        window = self.hb_interval * self.miss_k
+        # corpses are pruned once they can no longer matter: a restarted
+        # worker draws a FRESH cid, so dead entries only accumulate with
+        # churn (the seq-dedup table is LRU-bounded for the same reason) —
+        # a pruned zombie's request gets the same ST_STALE as a dead one
+        prune_after = max(30.0, window * 10)
+        while not self._stop.wait(self.hb_interval):
+            now = time.monotonic()
+            changed = False
+            with self.cv:
+                for m in list(self.members.values()):
+                    if m.state in ("active", "quarantined") \
+                            and now - m.last_hb > window:
+                        m.state = "dead"
+                        changed = True
+                        obs.inc("elastic.deaths")
+                        obs.event("elastic.member_dead", cid=m.cid,
+                                  rank=m.rank,
+                                  heartbeat_age_s=round(now - m.last_hb, 3))
+                    elif m.state == "dead" \
+                            and now - m.last_hb > prune_after:
+                        del self.members[m.cid]
+                if changed:
+                    self._bump_generation("death")
+                    # fleet takeover: every active died while joiners wait
+                    # quarantined — activate them or they wait forever for
+                    # a boundary nobody can reach
+                    if not self.active_members():
+                        self._takeover_locked()
+                    self._reevaluate_locked()
+            if changed:
+                self._notify_change()
+
+    def _notify_change(self):
+        for cb in self._on_change:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — observer must not kill liveness
+                pass
+
+    def _reevaluate_locked(self):
+        """Membership shrank: any collective wait may now be complete."""
+        for ck in list(self._rounds):
+            self._try_complete_round_locked(ck)
+        self._try_release_boundary_locked()
+        self.cv.notify_all()
+
+    # -- generation-scoped reduce ---------------------------------------
+    def reduce(self, cid: int, key: str, round_id: int, arr: np.ndarray,
+               timeout: float):
+        """Blocking sum-allreduce contribution. Returns
+        ``(status, generation, contributors, result)``."""
+        with self.cv:
+            self.started = True
+            m = self.members.get(cid)
+            if m is None or m.state != "active":
+                obs.inc("elastic.stale_rejected")
+                return ST_STALE, self.generation, 0, None
+            ck = (key, int(round_id))
+            done = self._completed.get(ck)
+            if done is not None:  # idempotent retry of a released round
+                return ST_OK, self.generation, done[0], done[1]
+            r = self._rounds.setdefault(ck, _Round())
+            r.contribs.setdefault(cid, arr)  # dedup a duplicated frame
+            self._try_complete_round_locked(ck)
+            deadline = time.monotonic() + timeout
+            while ck not in self._completed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return ST_ERROR, self.generation, 0, None
+                self.cv.wait(timeout=min(remaining, self.hb_interval))
+                self._try_complete_round_locked(ck)
+            done = self._completed[ck]
+            return ST_OK, self.generation, done[0], done[1]
+
+    def _try_complete_round_locked(self, ck):
+        r = self._rounds.get(ck)
+        if r is None:
+            return
+        required = {m.cid for m in self.active_members()}
+        if not required or not required.issubset(r.contribs):
+            return
+        contribs = list(r.contribs.values())
+        result = contribs[0].copy()
+        for c in contribs[1:]:
+            result += c
+        n = len(contribs)  # contributions from since-dead members included
+        self._completed[ck] = (n, result)
+        # a released round can only be retried by a client still ON it, and
+        # clients advance one round past their own success — so per key
+        # only the last two rounds are reachable. Each cached result is a
+        # full flattened gradient vector; keeping 64 of them would pin
+        # ~64x model size on the server
+        key = ck[0]
+        for stale in [c for c in self._completed
+                      if c[0] == key and c[1] < ck[1] - 1]:
+            del self._completed[stale]
+        while len(self._completed) > 64:
+            self._completed.popitem(last=False)
+        del self._rounds[ck]
+        obs.inc("elastic.reduce_rounds")
+        if set(r.contribs) != required:
+            # released over a different set than required right now — a
+            # member died mid-round (its gradient, if sent, still counts)
+            obs.inc("elastic.reduce_partial")
+        self.cv.notify_all()
+
+    # -- epoch rendezvous ------------------------------------------------
+    def epoch_end(self, cid: int, epoch: int, timeout: float):
+        """Generation-scoped boundary barrier. Returns
+        ``(status, generation, next_epoch, part, nparts, active_count)``.
+
+        ``epoch == WAIT_ACTIVATION`` is the quarantined-rejoin wait: block
+        until this member is activated at a boundary, then report the same
+        release the actives saw.
+        """
+        deadline = time.monotonic() + timeout
+        with self.cv:
+            m = self.members.get(cid)
+            if m is None or m.state == "dead":
+                return (ST_STALE, self.generation, self.epoch, 0, 1,
+                        self.active_count())
+            if epoch == WAIT_ACTIVATION:
+                while m.state == "quarantined":
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return (ST_ERROR, self.generation, self.epoch, 0, 1,
+                                self.active_count())
+                    self.cv.wait(timeout=min(remaining, self.hb_interval))
+                    m = self.members.get(cid)
+                    if m is None or m.state == "dead":
+                        return (ST_STALE, self.generation, self.epoch, 0, 1,
+                                self.active_count())
+                return self._release_reply_locked(cid)
+            self.started = True
+            if m.state != "active":
+                return (ST_STALE, self.generation, self.epoch, 0, 1,
+                        self.active_count())
+            if epoch > self.epoch:
+                # server behind the fleet (restarted without — or with a
+                # stale — snapshot while workers resumed from shared
+                # checkpoints): the FLEET's epoch is authoritative. Without
+                # this jump, the first release would clear the arrivals and
+                # every worker would wait out the full join timeout for a
+                # boundary count that can never re-form. The jump is a
+                # boundary resync, so it clears the collective tables like
+                # a release — a lower-epoch waiter woken by it exits as
+                # "already released" and must NOT then find pre-jump
+                # cached rounds answering its restarted round numbers.
+                self.epoch = int(epoch)
+                self._epoch_arrived.clear()
+                self._rounds.clear()
+                self._completed.clear()
+                self.cv.notify_all()
+            if epoch < self.epoch:  # retry of an already-released boundary
+                return self._release_reply_locked(cid)
+            self._epoch_arrived.add(cid)
+            self._try_release_boundary_locked()
+            while self.epoch <= epoch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._epoch_arrived.discard(cid)
+                    return (ST_ERROR, self.generation, self.epoch, 0, 1,
+                            self.active_count())
+                self.cv.wait(timeout=min(remaining, self.hb_interval))
+                if cid not in self.members \
+                        or self.members[cid].state == "dead":
+                    return (ST_STALE, self.generation, self.epoch, 0, 1,
+                            self.active_count())
+                self._try_release_boundary_locked()
+            return self._release_reply_locked(cid)
+
+    def _try_release_boundary_locked(self):
+        required = {m.cid for m in self.active_members()}
+        if not required or not required.issubset(self._epoch_arrived):
+            return
+        activated = self._activate_quarantined_locked()
+        self._epoch_arrived.clear()
+        # reduce rounds are scoped to the epoch: the boundary is a true
+        # barrier (no reduce can be in flight past it), so clearing the
+        # tables lets round numbering restart at 0 — which is also how a
+        # rejoiner syncs its counter without any extra protocol
+        self._rounds.clear()
+        self._completed.clear()
+        self.epoch += 1
+        self._last_release = {"generation": self.generation,
+                              "epoch": self.epoch}
+        obs.event("elastic.epoch_released", epoch=self.epoch,
+                  generation=self.generation, activated=activated,
+                  active=self.active_count())
+        self.cv.notify_all()
+
+    def _takeover_locked(self) -> int:
+        """Every active member died/left: quarantined joiners become the
+        fleet. The dead fleet's round tables MUST be cleared exactly like
+        a boundary release — the joiners restart round numbering at 0, and
+        a cached released round from the old fleet answering their round 0
+        would hand back a stale gradient sum."""
+        activated = self._activate_quarantined_locked()
+        if activated:
+            self._rounds.clear()
+            self._completed.clear()
+            self._epoch_arrived.clear()
+        return activated
+
+    def _activate_quarantined_locked(self) -> int:
+        joiners = [m for m in self.members.values()
+                   if m.state == "quarantined"]
+        for m in joiners:
+            m.state = "active"
+            obs.inc("elastic.rejoins")
+            obs.event("elastic.member_activated", cid=m.cid, rank=m.rank)
+        if joiners:
+            self._bump_generation("activate",
+                                  ranks=[m.rank for m in joiners])
+        return len(joiners)
+
+    def _release_reply_locked(self, cid):
+        part, nparts = self.assignment(cid)
+        return (ST_OK, self.generation, self.epoch, part, nparts,
+                self.active_count())
+
+
+# ---------------------------------------------------------------------------
+# PS state durability (weights + optimizer + seq-dedup + generation)
+# ---------------------------------------------------------------------------
+
+def capture_server_state(server):
+    """Consistent snapshot of a PSServer for the durable-warm-restart path.
+
+    Per-key consistency: each key's weight, its optimizer slot, and its
+    seq-dedup entries are copied while holding *that key's* lock — the same
+    lock ``OP_PUSH_SEQ`` applies+records under — so a snapshot can never
+    contain an applied update without its seq (the double-apply hole) for
+    any single key. Cross-key skew is harmless: exactly-once is a per-key
+    invariant.
+    """
+    from ..checkpoint.state import (TrainingState, _flatten_opt_state,
+                                    capture_optimizer)
+
+    arrays: Dict[str, np.ndarray] = {}
+    seq_entries = []
+    opt_tree = []
+    updater = server._updater
+    with server._global_lock:
+        keys = list(server._weights)
+    for key in keys:
+        lock = server._locks.get(key, server._global_lock)
+        with lock:
+            w = server._weights.get(key)
+            if w is None:
+                continue
+            arrays[f"w:{key}"] = np.ascontiguousarray(w)
+            with server._seq_lock:
+                for cid, seq in server._seq_by_key.get(key, {}).items():
+                    seq_entries.append([str(cid), key, int(seq)])
+            if updater is not None and key in updater.states:
+                deferred: list = []
+                desc = _flatten_opt_state(updater.states[key], key, deferred)
+                # host-copy NOW, inside the key lock — a deferred batched
+                # transfer would read slots the next push already mutated
+                for dkey, val in deferred:
+                    host = val.asnumpy() if hasattr(val, "asnumpy") \
+                        else np.asarray(val)
+                    arrays[dkey] = np.ascontiguousarray(host)
+                opt_tree.append(["s", key, desc])
+    meta = {
+        "kind": "ps_server",
+        "opt_spec": server._opt_spec,
+        "optimizer": {"state_tree": opt_tree},
+        "seq": seq_entries,
+        "num_workers": server._num_workers,
+    }
+    if server._optimizer is not None:
+        # scalar counters the slots don't carry (reuse PR-2's capture)
+        scal = capture_optimizer(None, server._optimizer, arrays)
+        meta["optimizer"].update(
+            {k: v for k, v in scal.items() if k != "state_tree"})
+    el = server._elastic
+    if el is not None:
+        with el.cv:
+            meta["generation"] = el.generation
+            meta["epoch"] = el.epoch
+            meta["started"] = el.started
+            # membership rides the snapshot so an elastic fleet SURVIVES a
+            # PS warm restart: restored members resume heartbeating on
+            # their existing sockets and in-flight reduces simply retry
+            # (idempotent) against the fresh round tables — without this,
+            # every worker's next RPC would be a stale rejection and the
+            # restart designed to preserve exactly-once would kill the
+            # whole training fleet instead
+            meta["members"] = [
+                [str(m.cid), int(m.rank), m.state]
+                for m in el.members.values() if m.state != "dead"]
+    return TrainingState(arrays, meta)
+
+
+def install_server_state(server, state) -> None:
+    """Warm-restart a PSServer from a :func:`capture_server_state` snapshot:
+    weights, server optimizer (spec re-parsed, slots + counters restored),
+    the seq-dedup table (so replayed pushes from before the crash still
+    dedupe — exactly-once survives the restart), and the membership
+    generation (monotonic across incarnations)."""
+    import threading as _threading
+
+    from ..checkpoint.state import _unflatten_opt_state, restore_optimizer
+
+    for name, arr in state.arrays.items():
+        if name.startswith("w:"):
+            key = name[2:]
+            server._weights[key] = np.array(arr)
+            server._locks[key] = _threading.Lock()
+    with server._seq_lock:
+        for cid, key, seq in state.meta.get("seq", []):
+            server._record_seq(int(cid), key, int(seq))
+    spec = state.meta.get("opt_spec")
+    if spec:
+        server._set_optimizer_bytes(spec.encode("ascii"), warm=False)
+        meta = state.meta.get("optimizer", {})
+        if server._updater is not None:
+            server._updater.states = {
+                key: _unflatten_opt_state(desc, state.arrays)
+                for _tag, key, desc in meta.get("state_tree", [])}
+        restore_optimizer(None, server._optimizer, state)
+    if server._elastic is not None and "generation" in state.meta:
+        el = server._elastic
+        with el.cv:
+            el.generation = int(state.meta["generation"])
+            el.epoch = int(state.meta.get("epoch", 0))
+            el.started = bool(state.meta.get("started", False))
+            for cid, rank, mstate in state.meta.get("members", []):
+                m = _Member(int(cid), int(rank), mstate, el.generation)
+                el.members[int(cid)] = m  # fresh last_hb: a grace window
+        el._ensure_monitor()  # a stale restored member still gets reaped
+    obs.event("elastic.ps_warm_restart",
+              keys=len([k for k in state.arrays if k.startswith("w:")]),
+              seq_entries=len(state.meta.get("seq", [])),
+              generation=state.meta.get("generation"))
+
+
+class PushWAL:
+    """Write-ahead log for seq-tagged pushes (the durability half of
+    exactly-once across a server SIGKILL).
+
+    A snapshot alone cannot give "zero lost updates": a push ACKED after
+    the last snapshot would vanish with the process — and an acked push is
+    one the client will never resend. So every applied seq-push appends a
+    CRC-framed record (cid, seq, key, grad payload) here and is fsynced
+    BEFORE the ack leaves. Warm restart replays records through the
+    ordinary seq-dedup path: anything the snapshot already contains has
+    ``seq <= applied_seq[(cid, key)]`` (the per-key snapshot consistency
+    guarantee) and is skipped, anything newer re-applies — exactly once,
+    mechanically.
+
+    Record framing: ``u32 len | u32 crc32(body) | body`` with
+    ``body = u8 kind | u64 cid | u64 seq | u16 klen | key | payload``
+    (kind 0 = dense array payload, 1 = sparse (indices, rows) payload,
+    2 = key birth from OP_INIT — first-wins on replay, cid/seq unused).
+    A torn tail record (SIGKILL mid-append) fails the CRC and truncates
+    the replay there — by construction that push was never acked, so the
+    client retries it. Files rotate at each snapshot commit
+    (``wal-<next-snapshot-step>.bin``) and older ones are GC'd; replay
+    walks every surviving file in step order (dedup makes overlap safe).
+
+    ``MXNET_PS_WAL_FSYNC=0`` trades the fsync-per-push for speed (then a
+    power loss can drop the tail; a plain SIGKILL usually cannot, since
+    the page cache survives the process).
+    """
+
+    def __init__(self, directory: str):
+        import os
+
+        self._dir = directory
+        self._lock = threading.Lock()
+        self._file = None
+        self._fsync = bool(get_env("MXNET_PS_WAL_FSYNC", True, bool))
+        self._os = os
+
+    def _path(self, step: int) -> str:
+        return self._os.path.join(self._dir, f"wal-{step:08d}.bin")
+
+    def rotate(self, next_step: int) -> None:
+        """Open a fresh log for the interval after snapshot ``next_step-1``
+        and GC logs older than the newest durable snapshot."""
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+            self._file = open(self._path(next_step), "ab")
+            for name in self._os.listdir(self._dir):
+                if name.startswith("wal-") and name.endswith(".bin"):
+                    try:
+                        step = int(name[4:-4])
+                    except ValueError:
+                        continue
+                    if step < next_step - 1:
+                        try:
+                            self._os.remove(
+                                self._os.path.join(self._dir, name))
+                        except OSError:
+                            pass
+
+    def append(self, kind: int, cid: int, seq: int, key: str,
+               payload: bytes) -> None:
+        from ..checkpoint.atomic import crc32_bytes
+
+        kb = key.encode()
+        body = (struct.pack("<BQQH", kind, cid, seq, len(kb)) + kb
+                + bytes(payload))
+        rec = struct.pack("<II", len(body), crc32_bytes(body)) + body
+        with self._lock:
+            if self._file is None:
+                self._file = open(self._path(0), "ab")
+            f = self._file
+            f.write(rec)
+            f.flush()
+        if self._fsync:
+            # fsync OUTSIDE the lock: it durably covers everything written
+            # to the fd so far (including our record), and holding the one
+            # WAL lock across per-push fsyncs would serialize pushes for
+            # ALL keys behind disk latency — concurrent fsyncs on one fd
+            # instead coalesce in the kernel (natural group commit)
+            try:
+                self._os.fsync(f.fileno())
+            except (OSError, ValueError):
+                # rotate/close raced us: close() already flushed the
+                # record to the page cache, which survives a SIGKILL
+                # (only a simultaneous power loss could drop it — the
+                # same envelope as MXNET_PS_WAL_FSYNC=0)
+                pass
+
+    def replay(self, apply_fn) -> int:
+        """Feed every intact record to ``apply_fn(kind, cid, seq, key,
+        payload)`` in file/step order; a torn or corrupt record stops that
+        file AND truncates it there — ``rotate`` may reopen the same file
+        for appending, and a new acked record written *behind* a torn
+        tail would be unreachable at the next replay (a silently lost
+        acked push). Returns the number of records offered."""
+        from ..checkpoint.atomic import crc32_bytes
+
+        files = sorted(
+            n for n in self._os.listdir(self._dir)
+            if n.startswith("wal-") and n.endswith(".bin"))
+        count = 0
+        for name in files:
+            path = self._os.path.join(self._dir, name)
+            try:
+                blob = open(path, "rb").read()
+            except OSError:
+                continue
+            off = 0
+            while off + 8 <= len(blob):
+                ln, crc = struct.unpack_from("<II", blob, off)
+                body = blob[off + 8:off + 8 + ln]
+                if len(body) < ln or crc32_bytes(body) != crc:
+                    break  # torn tail: that push was never acked
+                kind, cid, seq, klen = struct.unpack_from("<BQQH", body, 0)
+                key = body[19:19 + klen].decode()
+                apply_fn(kind, cid, seq, key, body[19 + klen:])
+                count += 1
+                off += 8 + ln
+            if off < len(blob):
+                try:
+                    with open(path, "r+b") as f:
+                        f.truncate(off)
+                except OSError:
+                    pass
+        return count
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+class JoinInfo:
+    """What a worker knows about its place in the fleet after a
+    join / activation / epoch rendezvous."""
+
+    __slots__ = ("active", "generation", "epoch", "part_index", "num_parts",
+                 "active_count", "changed")
+
+    def __init__(self, active, generation, epoch, part_index, num_parts,
+                 active_count, changed=False):
+        self.active = active
+        self.generation = generation
+        self.epoch = epoch
+        self.part_index = part_index
+        self.num_parts = num_parts
+        self.active_count = active_count
+        self.changed = changed
+
+    def __repr__(self):
+        return (f"JoinInfo(active={self.active}, gen={self.generation}, "
+                f"epoch={self.epoch}, shard={self.part_index}/"
+                f"{self.num_parts})")
+
+
+class Heartbeater:
+    """Background heartbeat sender on its OWN socket (the main client's
+    single-RPC-at-a-time lock must never delay a heartbeat behind a
+    blocking reduce). Connection failures back off with the shared jittered
+    curve and never raise — a missing server looks like missed heartbeats,
+    which is exactly what the liveness monitor is for."""
+
+    def __init__(self, host: str, port: int, cid: int, rank: int,
+                 interval: Optional[float] = None):
+        self._addr = (host, port)
+        self._cid = cid
+        self._rank = rank
+        self.interval = (heartbeat_interval() if interval is None
+                         else float(interval))
+        self._sock = None
+        self._stop = threading.Event()
+        self.last_status = ST_OK
+        self.generation = 0
+        self.active_count = 0
+        self._failures = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mxtpu-elastic-heartbeat")
+        self._thread.start()
+
+    def _loop(self):
+        import socket as _socket
+
+        from ..base import capped_backoff, configure_socket_keepalive
+        from .ps_server import OP_NAMES  # noqa: F401 — ensures names merged
+        from .ps_server import _recv_msg, _send_msg
+
+        payload = struct.pack("<QQ", self._cid, self._rank)
+        while not self._stop.is_set():
+            try:
+                if self._sock is None:
+                    self._sock = _socket.create_connection(
+                        self._addr, timeout=max(2.0, self.interval * 4))
+                    configure_socket_keepalive(self._sock)
+                _send_msg(self._sock, OP_HB, "", payload)
+                _, _, reply = _recv_msg(self._sock)
+                self._failures = 0
+                if len(reply) >= 13:
+                    st, gen, count = struct.unpack_from("<BQI", reply, 0)
+                    self.last_status = st
+                    self.generation = gen
+                    self.active_count = count
+                obs.inc("elastic.heartbeats")
+            except (ConnectionError, OSError):
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                self._failures += 1
+                obs.inc("elastic.heartbeat_failures")
+                self._stop.wait(capped_backoff(self._failures, self.interval,
+                                               self.interval * 4))
+                continue
+            self._stop.wait(self.interval)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class ElasticWorkerSession:
+    """Worker-side handle on the elastic plane (owned by the elastic
+    :class:`~mxnet_tpu.kvstore.dist.DistKVStore`): join, heartbeat,
+    generation-scoped allreduce, epoch rendezvous, checkpointed rejoin."""
+
+    def __init__(self, host: str, port: int, rank: int = 0,
+                 expected: Optional[int] = None,
+                 hb_interval: Optional[float] = None,
+                 reduce_timeout: Optional[float] = None):
+        from .ps_client import PSClient
+
+        self._cli = PSClient(host, port, timeout=30.0, retries=8,
+                             retry_interval=0.2)
+        # elastic servers are guaranteed to speak the ping opcode — turn on
+        # idle ping-before-reuse unless explicitly configured off
+        if self._cli._idle_ping_s is None:
+            self._cli._idle_ping_s = 30.0
+        self.cid = self._cli._client_id
+        self.rank = int(rank)
+        self._expected = expected
+        self._reduce_timeout = (_reduce_timeout() if reduce_timeout is None
+                                else float(reduce_timeout))
+        self._hb_interval = hb_interval
+        self._hb: Optional[Heartbeater] = None
+        self._round = 0
+        self._joined: Optional[JoinInfo] = None
+        self.generation = 0
+
+    # -- membership -----------------------------------------------------
+    def ensure_joined(self, wait_for_expected: bool = True,
+                      timeout: float = 30.0) -> JoinInfo:
+        """Register with the fleet (idempotent). A cold-start fleet admits
+        joiners as active; once training started, joins are quarantined
+        until the next epoch boundary. With ``expected`` set (the launcher's
+        ``DMLC_NUM_WORKER``), an active cold-start join waits briefly for
+        the full expected fleet so the first shard cut is over all ranks."""
+        if self._joined is not None:
+            return self._joined
+        info = self._join_rpc()
+        if self._hb is None:
+            self._hb = Heartbeater(self._cli._addr[0], self._cli._addr[1],
+                                   self.cid, self.rank,
+                                   interval=self._hb_interval)
+        if (info.active and wait_for_expected and self._expected
+                and info.active_count < self._expected):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                info = self._join_rpc()
+                if info.active_count >= self._expected:
+                    break
+                time.sleep(0.05)
+        self._joined = info
+        self.generation = info.generation
+        return info
+
+    def _join_rpc(self) -> JoinInfo:
+        payload = struct.pack("<QQ", self.cid, self.rank)
+        _, _, reply = self._cli._rpc(OP_JOIN, "", payload)
+        st, gen, epoch, part, nparts, count = struct.unpack_from(
+            "<BQQIII", reply, 0)
+        if st == ST_STALE:
+            raise StaleMemberError(
+                "this worker was declared dead by the fleet; restart the "
+                "process to rejoin with a fresh identity")
+        return JoinInfo(st == ST_OK, gen, epoch, part, nparts, count)
+
+    def await_activation(self, timeout: Optional[float] = None) -> JoinInfo:
+        """Block (server-side) until the next epoch boundary activates this
+        quarantined worker; returns the post-activation assignment. Safe to
+        retry — an already-active member gets the last release's reply."""
+        timeout = _join_timeout() if timeout is None else float(timeout)
+        obs.inc("elastic.quarantine_waits")
+        with obs.trace.span("elastic.await_activation"):
+            info = self._epoch_rpc(WAIT_ACTIVATION, timeout)
+        self._round = 0
+        self._joined = info
+        obs.event("elastic.activated", epoch=info.epoch,
+                  generation=info.generation, part=info.part_index,
+                  nparts=info.num_parts)
+        return info
+
+    # -- collectives ----------------------------------------------------
+    def allreduce(self, key: str, arr: np.ndarray,
+                  timeout: Optional[float] = None):
+        """Generation-scoped sum over the live fleet. Returns
+        ``(summed, contributors)``. Retries are idempotent (the server
+        dedups by cid and caches released rounds)."""
+        from .ps_server import _pack_array, _unpack_array
+
+        timeout = self._reduce_timeout if timeout is None else float(timeout)
+        # the wait bound rides IN the request so the server always answers
+        # (result or ST_ERROR) before the client's socket gives up — a
+        # socket-timeout retry against a still-blocked round would just
+        # stack handler threads
+        payload = (struct.pack("<QQd", self.cid, self._round, timeout)
+                   + _pack_array(np.ascontiguousarray(arr)))
+        with obs.trace.span("elastic.allreduce", key=key,
+                            round=self._round):
+            _, _, reply = self._cli._rpc(OP_REDUCE, key, payload,
+                                         timeout=timeout + 10.0)
+        st, gen, contributors = struct.unpack_from("<BQI", reply, 0)
+        if st == ST_STALE:
+            raise StaleMemberError(
+                f"reduce for key {key!r} rejected: this worker is not a "
+                f"live member of generation {gen}")
+        if st != ST_OK:
+            raise ElasticError(
+                f"elastic reduce timed out for key {key!r} round "
+                f"{self._round} (generation {gen})")
+        if gen != self.generation:
+            obs.event("elastic.generation_observed", generation=gen,
+                      contributors=contributors)
+            self.generation = gen
+        self._round += 1
+        return _unpack_array(reply[13:]), contributors
+
+    def epoch_end(self, epoch: int, timeout: Optional[float] = None
+                  ) -> JoinInfo:
+        """Epoch-boundary rendezvous: blocks until every live member
+        arrives (deaths shrink the requirement), activates quarantined
+        rejoiners, and returns the possibly-recut shard assignment.
+        Resets reduce-round numbering (the server cleared its tables)."""
+        timeout = _join_timeout() if timeout is None else float(timeout)
+        with obs.trace.span("elastic.epoch_end", epoch=epoch):
+            info = self._epoch_rpc(int(epoch), timeout)
+        prev = self._joined
+        info.changed = (prev is None
+                        or prev.part_index != info.part_index
+                        or prev.num_parts != info.num_parts)
+        self._round = 0
+        self._joined = info
+        return info
+
+    def _epoch_rpc(self, epoch: int, timeout: float) -> JoinInfo:
+        payload = struct.pack("<QQd", self.cid, epoch, timeout)
+        _, _, reply = self._cli._rpc(OP_EPOCH, "", payload,
+                                     timeout=timeout + 10.0)
+        st, gen, nxt, part, nparts, count = struct.unpack_from(
+            "<BQQIII", reply, 0)
+        if st == ST_STALE:
+            raise StaleMemberError(
+                "epoch rendezvous rejected: this worker was declared dead")
+        if st != ST_OK:
+            raise ElasticError(
+                f"epoch rendezvous timed out (epoch {epoch})")
+        self.generation = gen
+        return JoinInfo(True, gen, nxt, part, nparts, count)
+
+    def barrier(self, timeout: float = 90.0):
+        """Generation-scoped barrier (the server counts live members, not a
+        static worker count, once anyone has joined)."""
+        self._cli.barrier(timeout=timeout)
+
+    # -- teardown -------------------------------------------------------
+    def leave(self):
+        try:
+            self._cli._rpc(OP_LEAVE, "",
+                           struct.pack("<Q", self.cid), retries=1)
+        except MXNetError:
+            pass  # the server may already be gone — liveness cleans up
+
+    def close(self):
+        if self._hb is not None:
+            self._hb.stop()
+            self._hb = None
+        self.leave()

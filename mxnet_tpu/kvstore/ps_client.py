@@ -23,19 +23,21 @@ import numpy as np
 
 from .. import obs
 from ..obs import context as obs_context
-from ..base import MXNetError, capped_backoff
+from ..base import (MXNetError, capped_backoff, configure_socket_keepalive,
+                    get_env)
 from ..chaos import rpc as chaos_rpc
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
                         OP_PUSH, OP_PUSH_SEQ, OP_PUSH_SPARSE,
                         OP_PUSH_SPARSE_SEQ, OP_SET_OPT, OP_SHUTDOWN,
                         _pack_array, _pack_sparse, _recv_msg, _send_msg,
                         _unpack_array)
+from .elastic import OP_HB
 
 
 class PSClient:
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  retries: int = 5, retry_interval: float = 0.5,
-                 retry_max_interval: float = 5.0):
+                 retry_max_interval: float = 5.0, idle_ping: float = None):
         self._addr = (host, port)
         self._timeout = timeout
         self._retries = max(1, int(retries))
@@ -43,6 +45,18 @@ class PSClient:
         self._retry_max_interval = retry_max_interval
         self._lock = threading.Lock()
         self._sock = None
+        # half-open-connection detection (shared policy with the serve
+        # client — base.configure_socket_keepalive): TCP keepalive on every
+        # connection, plus a cheap ping-before-reuse once a connection has
+        # sat idle past this threshold, so a dead server is detected at the
+        # NEXT rpc instead of hanging until the OS keepalive gives up.
+        # Ping needs a server that speaks OP_HB (the python server; elastic
+        # sessions enable it) — MXNET_PS_IDLE_PING_S opts legacy/C++-server
+        # fleets in explicitly; unset/None = keepalive only.
+        self._idle_ping_s = (idle_ping if idle_ping is not None
+                             else get_env("MXNET_PS_IDLE_PING_S", None,
+                                          float))
+        self._last_io = time.monotonic()
         # exactly-once pushes: (client_id, seq) dedups server-side, so a
         # retried PUSH whose reply was lost is NOT applied twice (stronger
         # than the reference ps-lite's at-least-once resend)
@@ -61,6 +75,30 @@ class PSClient:
                 pass
         self._sock = socket.create_connection(self._addr,
                                               timeout=self._timeout)
+        configure_socket_keepalive(self._sock)
+        self._last_io = time.monotonic()
+
+    def _ping_stale_connection(self):
+        """Cheap OP_HB round-trip before reusing a long-idle connection; on
+        any failure the socket is dropped so the caller's normal
+        reconnect-retry path takes over (mirrors the serve client's
+        lazy-connect discipline — never trust an idle socket)."""
+        if (self._sock is None or not self._idle_ping_s
+                or time.monotonic() - self._last_io < self._idle_ping_s):
+            return
+        try:
+            self._sock.settimeout(min(self._timeout, 3.0))
+            _send_msg(self._sock, OP_HB, "", b"")
+            _recv_msg(self._sock)
+            self._sock.settimeout(self._timeout)
+            self._last_io = time.monotonic()
+        except (ConnectionError, OSError):
+            obs.inc("kvstore.rpc.stale_connections")
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _backoff(self, attempt: int) -> float:
         """Capped exponential backoff with full-range jitter (shared policy:
@@ -82,6 +120,7 @@ class PSClient:
         opname = chaos_rpc.OP_NAMES.get(opcode, str(opcode))
         for attempt in range(retries):
             try:
+                self._ping_stale_connection()  # may drop a half-open sock
                 if self._sock is None:
                     self._connect()
                 if timeout is not None:
@@ -118,6 +157,7 @@ class PSClient:
                         obs.inc("kvstore.bytes_pulled", len(reply[2]))
                 if timeout is not None:
                     self._sock.settimeout(self._timeout)
+                self._last_io = time.monotonic()
                 return reply
             except (ConnectionError, OSError) as e:  # incl. timeouts
                 last_err = e
@@ -225,8 +265,33 @@ class PSClient:
             _, _, reply = self._rpc_locked(OP_BARRIER, payload=payload,
                                            timeout=timeout)
         if bytes(reply[:1]) == b"\x01":
+            # the server names exactly which ranks are missing (and their
+            # last-heartbeat age) in a JSON detail after the status byte —
+            # surface it instead of a generic straggler shrug
+            detail = ""
+            if len(reply) > 1:
+                try:
+                    import json
+
+                    d = json.loads(bytes(reply[1:]).decode())
+                    if d.get("stale_member"):
+                        from .elastic import StaleMemberError
+
+                        raise StaleMemberError(
+                            "barrier rejected: this worker is not a live "
+                            "fleet member (declared dead after missed "
+                            "heartbeats); restart to rejoin")
+                    missing = ", ".join(
+                        f"rank {m['rank']} ({m['state']}, last heartbeat "
+                        f"{m['last_heartbeat_age_s']}s ago)"
+                        for m in d.get("missing", []))
+                    detail = (f": {d.get('arrived')}/{d.get('expected')} "
+                              f"arrived" + (f"; missing {missing}"
+                                            if missing else ""))
+                except (ValueError, KeyError, UnicodeDecodeError):
+                    pass
             raise TimeoutError(
-                "kvstore barrier timed out waiting for stragglers")
+                "kvstore barrier timed out waiting for stragglers" + detail)
 
     def shutdown(self):
         self._rpc(OP_SHUTDOWN)

@@ -15,12 +15,25 @@ lives in native/ps (same framing), used when built.
 Wire format (little-endian):
   u32 total_len | u8 opcode | u16 key_len | key bytes | payload
   opcodes: 0=INIT 1=PUSH 2=PULL 3=SET_OPT 4=BARRIER 5=SHUTDOWN
+  (6-9 sparse/seq variants; 16-20 elastic membership — see elastic.py;
+  32-42 are the serving plane's range, serve/server.py)
   payload for INIT/PUSH: u8 ndim | u32*ndim shape | u8 dtype_code | raw bytes
   reply for PULL: same array framing; others: u8 status
+
+Elastic training (docs/ROBUSTNESS.md "Elastic training"): with worker
+heartbeats flowing, every barrier and epoch rendezvous is scoped to the
+LIVE membership — a SIGKILL'd worker is declared dead after K missed
+heartbeats and collective waits release over the survivors instead of
+timing out. With ``snapshot_dir`` set the server also periodically
+snapshots weights / optimizer state / the seq-dedup table through the
+checkpoint/ atomic+CRC machinery and warm-restarts from the newest valid
+snapshot, so a SIGKILL'd server comes back with exactly-once semantics
+intact (clients retry with capped backoff; replayed pushes dedupe).
 """
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import pickle
 import socket
@@ -33,7 +46,10 @@ import numpy as np
 
 from .. import obs
 from ..obs import context as obs_context
-from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE
+from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE, get_env
+from . import elastic as elastic_mod
+from .elastic import (ELASTIC_OP_NAMES, OP_EPOCH, OP_HB, OP_JOIN, OP_LEAVE,
+                      OP_REDUCE, ST_ERROR, ST_OK, ST_QUARANTINED, ST_STALE)
 
 (OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
  OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ, OP_PUSH_SPARSE_SEQ) = range(10)
@@ -44,6 +60,12 @@ OP_NAMES = {OP_INIT: "init", OP_PUSH: "push", OP_PULL: "pull",
             OP_SHUTDOWN: "shutdown", OP_PUSH_SPARSE: "push_sparse",
             OP_PULL_SPARSE: "pull_sparse", OP_PUSH_SEQ: "push_seq",
             OP_PUSH_SPARSE_SEQ: "push_sparse_seq"}
+OP_NAMES.update(ELASTIC_OP_NAMES)
+
+# one rule table fault-injects both planes (the serve/server.py idiom)
+from ..chaos import rpc as _chaos_rpc  # noqa: E402
+
+_chaos_rpc.OP_NAMES.update(ELASTIC_OP_NAMES)
 
 
 def _pack_array(arr: np.ndarray) -> bytes:
@@ -149,19 +171,45 @@ class PSServer:
     """
 
     def __init__(self, host="0.0.0.0", port=9091, num_workers=1,
-                 barrier_timeout=60.0):
+                 barrier_timeout=60.0, snapshot_dir=None,
+                 snapshot_period=None, hb_interval=None, miss_k=None):
         self._weights: Dict[str, np.ndarray] = {}
         self._locks: Dict[str, threading.Lock] = {}
         self._updater = None
+        self._optimizer = None
+        self._opt_spec: Optional[str] = None
         self._global_lock = threading.Lock()
         from collections import OrderedDict
 
         self._num_workers = num_workers
+        # elastic membership plane: created lazily at the first OP_JOIN so a
+        # classic fleet (no heartbeats) pays nothing — not even the liveness
+        # thread. The config is captured now for the lazy construction.
+        self._elastic: Optional[elastic_mod.ElasticState] = None
+        self._elastic_cfg = (hb_interval, miss_k)
+        self._elastic_lock = threading.Lock()
+        # durable-state plane (docs/ROBUSTNESS.md "Elastic training"):
+        # periodic snapshots through checkpoint/'s atomic+CRC manager, warm
+        # restart from the newest valid one
+        self._snapshot_dir = snapshot_dir or get_env(
+            "MXNET_PS_SNAPSHOT_DIR", None)
+        self._snapshot_period = float(
+            snapshot_period if snapshot_period is not None
+            else get_env("MXNET_PS_SNAPSHOT_PERIOD_S", 5.0, float))
+        self._snap_mgr = None
+        self._snap_step = 0
+        self._snap_thread: Optional[threading.Thread] = None
+        self._snap_lock = threading.Lock()
+        self._wal: Optional[elastic_mod.PushWAL] = None
         # (client_id, key) -> last applied seq; LRU-bounded so client churn
         # (each process draws a fresh id) cannot grow the map forever.
         # Own lock: handlers for DIFFERENT keys share this dict, so the
         # per-key weight locks are not enough (mirrors the C++ seq_mu_).
         self._applied_seq: "OrderedDict" = OrderedDict()
+        # key-indexed mirror of _applied_seq (same lock), so a durable
+        # snapshot can copy ONE key's entries under that key's lock
+        # instead of rescanning the 64k-entry LRU per key
+        self._seq_by_key: Dict[str, Dict[int, int]] = {}
         self._seq_lock = threading.Lock()
         self._barrier_timeout = barrier_timeout  # straggler window (seconds)
         self._barrier_count = 0
@@ -181,6 +229,8 @@ class PSServer:
         self._stop = threading.Event()
         self._threads = []
         self._conns = []
+        if self._snapshot_dir:
+            self._init_durability()
 
     def serve_forever(self):
         while not self._stop.is_set():
@@ -202,8 +252,145 @@ class PSServer:
         t.start()
         return t
 
+    # ------------------------------------------------------------------
+    # elastic membership + durable state
+    # ------------------------------------------------------------------
+    def _elastic_state(self) -> elastic_mod.ElasticState:
+        """The membership plane, created at the first OP_JOIN. The change
+        callback pokes the barrier condvar so a declared death releases a
+        waiting (now survivor-complete) barrier immediately."""
+        with self._elastic_lock:
+            if self._elastic is None:
+                hb, miss = self._elastic_cfg
+                self._elastic = elastic_mod.ElasticState(
+                    hb_interval=hb, miss_k=miss,
+                    on_change=[self._on_membership_change])
+            return self._elastic
+
+    def _on_membership_change(self):
+        with self._barrier_cv:
+            self._release_barrier_locked()
+            self._barrier_cv.notify_all()
+
+    def _required_workers(self) -> int:
+        """Barrier quorum: the LIVE membership once anyone heartbeats, the
+        static launch-time worker count otherwise (classic fleets)."""
+        el = self._elastic
+        if el is not None:
+            with el.cv:
+                if el.has_members():
+                    return max(1, el.active_count())
+        return self._num_workers
+
+    def _init_durability(self):
+        from ..checkpoint.manager import CheckpointManager
+
+        self._snap_mgr = CheckpointManager(self._snapshot_dir, prefix="ps",
+                                           keep_last=3, async_write=False)
+        state = self._snap_mgr.load_latest()
+        if state is not None and state.meta.get("kind") == "ps_server":
+            if state.meta.get("generation") is not None:
+                self._elastic_state()  # restore generation monotonicity
+            elastic_mod.install_server_state(self, state)
+            self._snap_step = (self._snap_mgr.latest_step() or 0) + 1
+        # replay acked-but-unsnapshotted pushes through the seq-dedup path
+        # (anything the snapshot already covers skips itself), THEN open a
+        # fresh log — zero lost, zero double-applied across the restart.
+        # Two passes: key births (kind 2) first, then pushes in order —
+        # the live handlers append birth and first-push records on
+        # DIFFERENT locks, so a concurrent worker's acked push can land in
+        # the log ahead of the key's birth record; a single ordered pass
+        # would silently drop that acked push at `key not in weights`
+        self._wal = elastic_mod.PushWAL(self._snapshot_dir)
+        pushes = []
+
+        def _births_first(kind, cid, seq, key, payload):
+            if kind == 2:
+                self._replay_push(kind, cid, seq, key, payload)
+            else:
+                pushes.append((kind, cid, seq, key, payload))
+
+        replayed = self._wal.replay(_births_first)
+        for rec in pushes:
+            self._replay_push(*rec)
+        if replayed:
+            obs.event("elastic.ps_wal_replayed", records=replayed)
+        self._wal.rotate(self._snap_step)
+        if self._snapshot_period > 0:
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_loop, daemon=True,
+                name="mxtpu-ps-snapshot")
+            self._snap_thread.start()
+
+    def _replay_push(self, kind: int, cid: int, seq: int, key: str,
+                     payload: bytes):
+        """WAL replay: the OP_PUSH_SEQ / OP_PUSH_SPARSE_SEQ apply path
+        minus the wire — dedup by (cid, seq), apply, record. Kind 2 is a
+        key-birth record (OP_INIT): first-wins, like the live handler."""
+        if kind == 2:
+            with self._global_lock:
+                if key not in self._weights:
+                    self._weights[key] = _unpack_array(memoryview(payload))
+                    self._locks[key] = threading.Lock()
+            return
+        if kind == 3:  # optimizer spec (OP_SET_OPT), in order vs pushes
+            spec = bytes(payload).decode("ascii", errors="replace")
+            if spec != (self._opt_spec or "") or self._updater is None:
+                # an unchanged spec from a WAL file overlapping the
+                # snapshot must NOT rebuild the Updater — that would wipe
+                # the snapshot-restored slots (momentum etc.)
+                self._set_optimizer_bytes(bytes(payload), warm=False)
+            return
+        if key not in self._weights:
+            return
+        buf = memoryview(payload)
+        with self._locks[key]:
+            with self._seq_lock:
+                fresh = self._applied_seq.get((cid, key), -1) < seq
+            if not fresh:
+                return
+            if kind == 0:
+                grad = _unpack_array(buf)
+                if self._updater is not None:
+                    self._apply(key, grad, self._weights[key])
+                else:
+                    self._weights[key] = self._weights[key] + grad
+            else:
+                if not self._apply_sparse(key, buf, locked=True):
+                    return
+            with self._seq_lock:
+                self._record_seq(cid, key, seq)
+
+    def _snapshot_loop(self):
+        while not self._stop.wait(self._snapshot_period):
+            try:
+                self.snapshot_now()
+            except Exception:  # noqa: BLE001 — a failed snapshot must not
+                obs.inc("elastic.ps_snapshot_errors")  # kill the server
+
+    def snapshot_now(self):
+        """Write one durable snapshot (atomic commit, CRC manifest). Safe
+        to call concurrently with request handling: per-key consistency is
+        taken under the same locks the push path applies under."""
+        if self._snap_mgr is None:
+            return
+        with self._snap_lock:  # serialize: periodic vs explicit callers
+            state = elastic_mod.capture_server_state(self)
+            step, self._snap_step = self._snap_step, self._snap_step + 1
+            with obs.trace.span("elastic.ps_snapshot", step=step):
+                self._snap_mgr.save(state, step, block=True)
+            if self._wal is not None:
+                # pushes newer than this snapshot land in the fresh log;
+                # older logs are covered by the snapshot and GC'd
+                self._wal.rotate(step + 1)
+            obs.inc("elastic.ps_snapshots")
+
     def stop(self):
         self._stop.set()
+        if self._elastic is not None:
+            self._elastic.close()
+        if self._wal is not None:
+            self._wal.close()
         try:
             self._sock.close()
         except OSError:
@@ -272,9 +459,16 @@ class PSServer:
         if opcode == OP_INIT:
             arr = _unpack_array(payload)
             with self._global_lock:
-                if key not in self._weights:
+                created = key not in self._weights
+                if created:
                     self._weights[key] = arr
                     self._locks[key] = threading.Lock()
+            if created and self._wal is not None:
+                # key birth rides the WAL (kind 2, one small fsynced
+                # append) so a warm restart never sees a push for a key it
+                # doesn't know — without paying a full-state snapshot per
+                # key, let alone per re-init from every non-winning worker
+                self._wal.append(2, 0, 0, key, bytes(payload))
             _send_msg(conn, OP_INIT, key, b"\x00")
         elif opcode == OP_PUSH:
             grad = _unpack_array(payload)
@@ -295,6 +489,8 @@ class PSServer:
                 return True
             cid, seq = struct.unpack_from("<QQ", payload, 0)
             grad = _unpack_array(payload[16:])
+            from ..chaos.proc import kill_point
+
             with self._locks[key]:
                 with self._seq_lock:
                     fresh = self._applied_seq.get((cid, key), -1) < seq
@@ -307,6 +503,16 @@ class PSServer:
                     # failed apply doesn't burn the seq
                     with self._seq_lock:
                         self._record_seq(cid, key, seq)
+                    if self._wal is not None:
+                        # durable BEFORE the ack: an acked push may never
+                        # be resent, so it must survive a SIGKILL here
+                        self._wal.append(0, cid, seq, key,
+                                         bytes(payload[16:]))
+            # chaos: die with the update applied+recorded but unacked —
+            # the client MUST retry and the retry MUST dedupe, across a
+            # warm restart when snapshots are on (docs/ROBUSTNESS.md)
+            kill_point("ps:post_apply")
+            kill_point("ps:pre_reply")
             _send_msg(conn, OP_PUSH_SEQ, key, b"\x00")
         elif opcode == OP_PULL:
             with self._locks.get(key, self._global_lock):
@@ -338,6 +544,9 @@ class PSServer:
                     if ok:  # a rejected frame must not burn the seq
                         with self._seq_lock:
                             self._record_seq(cid, key, seq)
+                        if self._wal is not None:
+                            self._wal.append(1, cid, seq, key,
+                                             bytes(payload[16:]))
             _send_msg(conn, OP_PUSH_SPARSE_SEQ, key,
                       b"\x00" if ok else b"\x01")
         elif opcode == OP_PULL_SPARSE:
@@ -354,11 +563,74 @@ class PSServer:
             _send_msg(conn, OP_PULL_SPARSE, key, reply)
         elif opcode == OP_SET_OPT:
             self._set_optimizer_bytes(bytes(payload))
+            if self._wal is not None and self._opt_spec:
+                # the spec must survive a restart — as one small WAL
+                # record, not an inline full-state snapshot that could
+                # stall this RPC past the client timeout on large models
+                self._wal.append(3, 0, 0, "",
+                                 self._opt_spec.encode("ascii"))
             _send_msg(conn, OP_SET_OPT, key, b"\x00")
         elif opcode == OP_BARRIER:
+            ok, detail = self._barrier(payload)
             _send_msg(conn, OP_BARRIER, key,
-                      b"\x00" if self._barrier(payload) else b"\x01")
+                      b"\x00" if ok else b"\x01" + detail)
+        elif opcode == OP_HB:
+            # empty payload = connection-liveness ping (the client's
+            # ping-before-reuse path) — replies without touching membership
+            if len(payload) >= 16:
+                cid, _rank = struct.unpack_from("<QQ", payload, 0)
+                st, gen, count = self._elastic_state().heartbeat(cid)
+            elif self._elastic is not None:
+                with self._elastic.cv:
+                    st, gen, count = (ST_OK, self._elastic.generation,
+                                      self._elastic.active_count())
+            else:
+                st, gen, count = ST_OK, 0, 0
+            _send_msg(conn, OP_HB, key, struct.pack("<BQI", st, gen, count))
+        elif opcode == OP_JOIN:
+            cid, rank = struct.unpack_from("<QQ", payload, 0)
+            state, gen, epoch, part, nparts, count = \
+                self._elastic_state().join(cid, rank)
+            st = {"active": ST_OK, "quarantined": ST_QUARANTINED}.get(
+                state, ST_STALE)
+            _send_msg(conn, OP_JOIN, key,
+                      struct.pack("<BQQIII", st, gen, epoch, part, nparts,
+                                  count))
+        elif opcode == OP_REDUCE:
+            if self._elastic is None or len(payload) < 24:
+                _send_msg(conn, OP_REDUCE, key,
+                          struct.pack("<BQI", ST_ERROR, 0, 0))
+                return True
+            cid, round_id, wait = struct.unpack_from("<QQd", payload, 0)
+            arr = _unpack_array(payload[24:])
+            st, gen, n, result = self._elastic.reduce(
+                cid, key, round_id, arr,
+                timeout=max(1.0, min(float(wait), 3600.0)))
+            head = struct.pack("<BQI", st, gen, n)
+            _send_msg(conn, OP_REDUCE, key,
+                      head + (_pack_array(result) if st == ST_OK else b""))
+        elif opcode == OP_EPOCH:
+            if self._elastic is None or len(payload) < 24:
+                _send_msg(conn, OP_EPOCH, key,
+                          struct.pack("<BQQIII", ST_ERROR, 0, 0, 0, 1, 0))
+                return True
+            cid, epoch, wait = struct.unpack_from("<QQd", payload, 0)
+            st, gen, nxt, part, nparts, count = self._elastic.epoch_end(
+                cid, epoch, timeout=max(1.0, min(float(wait), 3600.0)))
+            _send_msg(conn, OP_EPOCH, key,
+                      struct.pack("<BQQIII", st, gen, nxt, part, nparts,
+                                  count))
+        elif opcode == OP_LEAVE:
+            if self._elastic is not None and len(payload) >= 8:
+                (cid,) = struct.unpack_from("<Q", payload, 0)
+                self._elastic.leave(cid)
+            _send_msg(conn, OP_LEAVE, key, b"\x00")
         elif opcode == OP_SHUTDOWN:
+            if self._snap_mgr is not None:
+                try:
+                    self.snapshot_now()  # parting durable state
+                except Exception:  # noqa: BLE001
+                    pass
             _send_msg(conn, OP_SHUTDOWN, key, b"\x00")
             self.stop()
             return False
@@ -368,8 +640,14 @@ class PSServer:
         """Caller holds ``self._seq_lock``. LRU-bounded (client churn)."""
         self._applied_seq[(cid, key)] = seq
         self._applied_seq.move_to_end((cid, key))
+        self._seq_by_key.setdefault(key, {})[cid] = seq
         while len(self._applied_seq) > 65536:
-            self._applied_seq.popitem(last=False)
+            (ocid, okey), _oseq = self._applied_seq.popitem(last=False)
+            per_key = self._seq_by_key.get(okey)
+            if per_key is not None:
+                per_key.pop(ocid, None)
+                if not per_key:
+                    del self._seq_by_key[okey]
 
     def _apply_sparse(self, key, payload, locked=False) -> bool:
         """Validate + apply a row-sparse push. Returns False (never corrupts)
@@ -393,25 +671,109 @@ class PSServer:
                 np.add.at(w, idx, rows.astype(w.dtype))
         return True
 
-    def _barrier(self, payload) -> bool:
-        """Generation-counted rendezvous; a straggler timeout rolls its
-        arrival back instead of poisoning the next round.
+    def _release_barrier_locked(self) -> bool:
+        """Caller holds ``_barrier_cv``. Releases the round when the quorum
+        — the LIVE membership under elasticity, the static worker count
+        otherwise — has arrived. Called on arrival AND on membership
+        change, so a declared death releases a survivor-complete round.
+
+        Membership-scoped release compares the live cid SET against the
+        arrived token cids (the reduce/epoch discipline), not a raw count:
+        a member that arrived and then died must not stand in for a live
+        member that never reached the barrier."""
+        el = self._elastic
+        required_cids = None
+        if el is not None:
+            with el.cv:
+                if el.has_members():
+                    required_cids = {m.cid for m in el.active_members()}
+        if required_cids is None:
+            if self._barrier_count < self._num_workers:
+                return False
+        else:
+            arrived = {tok[0] for tok, g in self._barrier_arrived.items()
+                       if g == self._barrier_gen}
+            # (tokenless legacy arrivals carry no identity and only count
+            # in the static-quorum mode above)
+            if not arrived or not required_cids.issubset(arrived):
+                return False
+        self._barrier_count = 0
+        self._barrier_gen += 1
+        for tok in self._barrier_arrived:
+            self._barrier_released[tok] = True
+        self._barrier_arrived.clear()
+        while len(self._barrier_released) > 65536:
+            self._barrier_released.popitem(last=False)
+        self._barrier_cv.notify_all()
+        return True
+
+    def _barrier_timeout_detail(self) -> bytes:
+        """Structured straggler report: exactly which ranks are missing and
+        how stale their heartbeats are (unknowable without the membership
+        plane — then only the arrived/expected counts are reported). Rides
+        after the \\x01 status byte; also emitted as a
+        ``kvstore.barrier_timeout`` obs event."""
+        detail = {"expected": self._required_workers(),
+                  "arrived": self._barrier_count}
+        if self._elastic is not None:
+            arrived_cids = {tok[0] for tok, g in self._barrier_arrived.items()
+                            if g == self._barrier_gen}
+            missing = [
+                {"rank": rank, "client_id": cid, "state": state,
+                 "last_heartbeat_age_s": age}
+                for rank, cid, state, age in self._elastic.liveness_table()
+                if state == "active" and cid not in arrived_cids]
+            detail["missing"] = sorted(missing, key=lambda m: m["rank"])
+        obs.event("kvstore.barrier_timeout", **{
+            k: v for k, v in detail.items() if k != "missing"},
+            missing_ranks=[m["rank"] for m in detail.get("missing", [])])
+        obs.inc("kvstore.barrier_timeouts")
+        try:
+            return json.dumps(detail).encode()
+        except (TypeError, ValueError):
+            return b"{}"
+
+    def _barrier(self, payload):
+        """Membership-scoped rendezvous; a straggler timeout rolls its
+        arrival back instead of poisoning the next round, and reports a
+        structured straggler detail (returns ``(ok, detail_bytes)``).
 
         Idempotent when the client sends a (client_id, barrier_epoch) token
-        (16-byte payload): a retransmit within the round is counted once
-        (arrival keyed by token), and a retransmit that lands after the round
-        released — the lost-reply case — is acked immediately from the
+        (16-byte payload): a retransmit while the round gathers is counted
+        once (arrival keyed by token), and a retransmit that lands after the
+        round released — the lost-reply case — is acked immediately from the
         released LRU instead of entering the next round. Tokenless legacy
         frames fall back to plain arrival counting.
+
+        With the elastic membership plane active the quorum is the LIVE
+        member count: a worker SIGKILL'd mid-epoch is declared dead after K
+        missed heartbeats and the round releases over the survivors —
+        the barrier is scoped to the membership generation, not a static
+        worker count.
         """
         token = (struct.unpack_from("<QQ", payload, 0)
                  if len(payload) >= 16 else None)
-        ok = True
+        # membership-scoped quorum needs membership-checked ARRIVALS too: a
+        # zombie (declared dead, still running) counting toward the live
+        # quorum would release a round a live member never reached —
+        # reject it structurally, like OP_REDUCE's ST_STALE. Tokenless
+        # legacy frames carry no identity and keep counting (no members →
+        # static quorum → unchanged behavior).
+        if token is not None and self._elastic is not None:
+            with self._elastic.cv:
+                if self._elastic.has_members():
+                    m = self._elastic.members.get(token[0])
+                    if m is None or m.state != "active":
+                        obs.inc("elastic.stale_rejected")
+                        return False, json.dumps(
+                            {"stale_member": True,
+                             "client_id": token[0]}).encode()
+        ok, detail = True, b""
         with self._barrier_cv:
             counted = True
             if token is not None:
                 if token in self._barrier_released:
-                    return True  # round already completed; just re-ack
+                    return True, b""  # round completed; just re-ack
                 if token in self._barrier_arrived:
                     # retransmit while the round is still gathering: wait for
                     # the release the original arrival is counted toward
@@ -424,20 +786,16 @@ class PSServer:
             else:
                 gen = self._barrier_gen
                 self._barrier_count += 1
-            if counted and self._barrier_count >= self._num_workers:
-                self._barrier_count = 0
-                self._barrier_gen += 1
-                for tok in self._barrier_arrived:
-                    self._barrier_released[tok] = True
-                self._barrier_arrived.clear()
-                while len(self._barrier_released) > 65536:
-                    self._barrier_released.popitem(last=False)
-                self._barrier_cv.notify_all()
-            else:
+            if not (counted and self._release_barrier_locked()):
                 deadline = time.monotonic() + self._barrier_timeout
                 while self._barrier_gen == gen:
+                    # re-check on every wake: a membership change may have
+                    # shrunk the quorum to the already-arrived set
+                    if self._release_barrier_locked():
+                        break
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
+                        detail = self._barrier_timeout_detail()
                         # roll back only an arrival THIS handler counted; a
                         # timed-out retransmit must not erase the original's
                         if counted:
@@ -448,12 +806,13 @@ class PSServer:
                         ok = False
                         break
                     self._barrier_cv.wait(timeout=remaining)
-        return ok
+        return ok, detail
 
-    def _set_optimizer_bytes(self, blob: bytes):
+    def _set_optimizer_bytes(self, blob: bytes, warm: bool = True):
         """SET_OPT payload is text: ``name key=val key=val …`` — a format the
         C++ server (native/ps/ps_server.cc) parses too. Legacy pickle blobs
-        still accepted."""
+        still accepted. ``warm=False`` skips the background XLA pre-warm
+        (the warm-restart path re-installs the optimizer before serving)."""
         from ..optimizer import Updater, create as opt_create
 
         try:
@@ -463,11 +822,19 @@ class PSServer:
             for kv in parts[1:]:
                 k, _, v = kv.partition("=")
                 kwargs[k] = float(v)
+            self._opt_spec = text
         except (UnicodeDecodeError, ValueError, IndexError):
             spec = pickle.loads(blob)
             name, kwargs = spec["name"], spec["kwargs"]
+            # normalize to the text form so a durable snapshot can always
+            # re-install it (capture_server_state persists _opt_spec)
+            self._opt_spec = name + " " + " ".join(
+                f"{k}={v}" for k, v in kwargs.items())
         opt = opt_create(name, **kwargs)
+        self._optimizer = opt
         self._updater = Updater(opt)
+        if not warm:
+            return
         # Pre-warm the XLA executables for every known weight shape with a
         # THROWAWAY updater, in the background (warming inside this RPC
         # handler would stall SET_OPT past the client timeout): the first
@@ -517,8 +884,17 @@ def main():
     ap = argparse.ArgumentParser(description="mxnet_tpu async parameter server")
     ap.add_argument("--port", type=int, default=9091)
     ap.add_argument("--num-workers", type=int, default=1)
+    ap.add_argument("--snapshot-dir", default=None,
+                    help="durable-state directory (atomic+CRC snapshots; "
+                    "warm restart picks up the newest valid one). Falls "
+                    "back to MXNET_PS_SNAPSHOT_DIR")
+    ap.add_argument("--snapshot-period", type=float, default=None,
+                    help="seconds between snapshots "
+                    "(MXNET_PS_SNAPSHOT_PERIOD_S, default 5)")
     args = ap.parse_args()
-    srv = PSServer(port=args.port, num_workers=args.num_workers)
+    srv = PSServer(port=args.port, num_workers=args.num_workers,
+                   snapshot_dir=args.snapshot_dir,
+                   snapshot_period=args.snapshot_period)
     print(f"PSServer listening on :{srv.port}", flush=True)
     srv.serve_forever()
 

@@ -88,7 +88,11 @@ class FeedForward:
         checkpointing contract as Module.fit (docs/ROBUSTNESS.md), and
         ``health=`` (forwarded through ``**kwargs``) the same divergence
         sentinel + auto-rollback (docs/OBSERVABILITY.md "Training
-        health")."""
+        health"). Passing an elastic ``kvstore=`` (a DistKVStore created
+        under ``MXNET_ELASTIC=1``) through ``**kwargs`` likewise inherits
+        the elastic-training plane — generation-scoped gradient sync,
+        survivor shard recuts, checkpointed rejoin (docs/ROBUSTNESS.md
+        "Elastic training")."""
         from .io import NDArrayIter
 
         del logger  # accepted for signature parity; Module logs via logging

@@ -140,6 +140,35 @@ class BaseModule:
         from ..checkpoint import CheckpointManager, as_manager
         from ..obs import health as health_mod
 
+        # elastic training (docs/ROBUSTNESS.md "Elastic training"): when
+        # the kvstore carries an ElasticWorkerSession, membership is
+        # resolved BEFORE the checkpoint resume below — a restarted worker
+        # lands quarantined, blocks here until the live fleet's next epoch
+        # boundary activates it, and then resumes from the newest shared
+        # checkpoint (which the survivors flushed before that same
+        # boundary's rendezvous): the checkpointed rejoin
+        elastic = getattr(kvstore, "elastic", None) \
+            if not isinstance(kvstore, str) else None
+        elastic_info = None
+        if elastic is not None:
+            elastic_info = elastic.ensure_joined()
+            if not elastic_info.active:
+                self.logger.info(
+                    "elastic: quarantined (generation %d, fleet at epoch "
+                    "%d) — waiting for the next epoch boundary",
+                    elastic_info.generation, elastic_info.epoch)
+                elastic_info = elastic.await_activation()
+                self.logger.info(
+                    "elastic: activated at epoch %d generation %d, shard "
+                    "%d/%d", elastic_info.epoch, elastic_info.generation,
+                    elastic_info.part_index, elastic_info.num_parts)
+            if hasattr(train_data, "set_partition"):
+                try:
+                    train_data.set_partition(elastic_info.part_index,
+                                             elastic_info.num_parts)
+                except NotImplementedError:
+                    pass  # keep the construction-time shard
+
         # a manager built from a bare directory is ours to close at the end;
         # a caller-supplied manager outlives the fit (only flushed)
         owns_manager = not isinstance(checkpoint, CheckpointManager)
@@ -161,8 +190,16 @@ class BaseModule:
             # matters even across epochs, because reshuffling permutes it
             # IN PLACE (same RNG state + different starting arrangement =
             # different epoch order)
-            restored = restore_iterator(train_data, resume_state)
-            mid_epoch = resume_state.nbatch is not None
+            if elastic is not None:
+                # elastic rejoin is epoch-boundary-only and the shard
+                # assignment from activation (set_partition above) is
+                # authoritative — the checkpoint's cursor/order describe
+                # ANOTHER rank's (possibly differently-sized) shard
+                restored = False
+                mid_epoch = False
+            else:
+                restored = restore_iterator(train_data, resume_state)
+                mid_epoch = resume_state.nbatch is not None
             if mid_epoch and not restored:
                 self.logger.warning(
                     "checkpoint was taken mid-epoch (batch %d) but the "
@@ -175,6 +212,34 @@ class BaseModule:
                 "resuming from checkpoint step %d (epoch %d%s)",
                 resume_state.global_step, begin_epoch,
                 f", batch {resume_state.nbatch}" if mid_epoch else "")
+        if elastic_info is not None and elastic_info.epoch > begin_epoch:
+            # The fleet is ahead of this worker's newest checkpoint. With
+            # live peers this is unrecoverable drift, not a warning: the
+            # per-step sync averages GRADIENTS, never weights, so stale
+            # params would never converge to the fleet's — every rank
+            # would silently train a different model from here on. Fail
+            # loudly unless explicitly overridden (e.g. a deliberate
+            # whole-fleet restart against a durable server whose epoch
+            # label survived — params then agree by construction).
+            from ..base import MXNetError, get_env
+
+            if elastic_info.active_count > 1 and not get_env(
+                    "MXNET_ELASTIC_ALLOW_STALE_REJOIN", False, bool):
+                raise MXNetError(
+                    f"elastic: the fleet is at epoch {elastic_info.epoch} "
+                    f"but this worker's newest shared checkpoint resumes "
+                    f"at epoch {begin_epoch} — rejoining with stale "
+                    f"parameters would silently desync the ranks (gradient "
+                    f"sync never re-syncs weights). Share one checkpoint "
+                    f"directory with checkpoint_period=1, or set "
+                    f"MXNET_ELASTIC_ALLOW_STALE_REJOIN=1 to proceed "
+                    f"anyway.")
+            self.logger.warning(
+                "elastic: fleet is at epoch %d but resume found epoch %d — "
+                "fast-forwarding (parameters come from the newest shared "
+                "checkpoint)", elastic_info.epoch, begin_epoch)
+            begin_epoch = elastic_info.epoch
+            mid_epoch = False
         if manager is not None and handle_preemption:
             manager.install_signal_handlers()
 
@@ -190,6 +255,23 @@ class BaseModule:
         if resume_state is not None:
             self._restore_training_state(resume_state)
             global_step = resume_state.global_step
+        if (elastic is not None and resume_state is None
+                and elastic_info.active and elastic_info.epoch == 0):
+            # cold co-start: broadcast the lead rank's initial params once.
+            # Gradient sync alone never re-syncs weights, so ranks with
+            # different init RNG state would silently train divergent
+            # models forever. (Resumed workers already hold the shared
+            # checkpoint's params; rejoiners go through the checkpointed
+            # rejoin path instead.) Unconditional for every co-start
+            # active — NOT gated on a join-time active_count, which can
+            # differ across ranks and would split the fleet into divergent
+            # collective sequences: a solo broadcast completes instantly,
+            # and a straggler joining after it is answered from the
+            # released-round cache with the root's params, which is
+            # exactly the broadcast's meaning.
+            with obs.trace.span("elastic.bcast_params"):
+                self._elastic_broadcast_params(
+                    kvstore, root=elastic_info.part_index == 0)
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
@@ -233,6 +315,13 @@ class BaseModule:
                         break
                     nbatch += 1
                     self.forward_backward(data_batch)
+                    if elastic is not None:
+                        # generation-scoped mean over the LIVE fleet: a
+                        # worker SIGKILL'd mid-epoch shrinks the round's
+                        # required set after K missed heartbeats and this
+                        # returns over the survivors — no barrier timeout
+                        with obs.trace.span("elastic.sync_grads"):
+                            self._elastic_sync_grads(kvstore)
                     if health_monitor is not None:
                         # stats variant only on steps the sentinel will
                         # sample — the per-param norms' cost amortizes 1/K
@@ -262,7 +351,20 @@ class BaseModule:
                                 with obs.trace.span("health.blame"):
                                     health_mod.blame_nonfinite(self._exec)
                             if rep["action"] == "rollback":
-                                if manager is None:
+                                if elastic is not None:
+                                    # a rollback is rank-local (this rank's
+                                    # shard metrics breached) but elastic
+                                    # sync is lockstep — one rank replaying
+                                    # extra batches would issue reduce
+                                    # rounds its peers never join and wedge
+                                    # the fleet into timeouts
+                                    self.logger.warning(
+                                        "health: rollback requested but "
+                                        "elastic lockstep sync is active — "
+                                        "continuing without rollback "
+                                        "(rank-local replay would desync "
+                                        "the fleet's reduce rounds)")
+                                elif manager is None:
                                     self.logger.warning(
                                         "health: rollback requested but fit "
                                         "has no checkpoint= manager — "
@@ -349,6 +451,26 @@ class BaseModule:
                         manager.save(self._capture_training_state(
                             epoch, None, global_step, train_data),
                             global_step)
+                if elastic is not None:
+                    if manager is not None:
+                        # the boundary snapshot must be durable BEFORE the
+                        # rendezvous: a worker activated at this boundary
+                        # resumes from it, and the rendezvous is the only
+                        # ordering guarantee it has
+                        manager.flush()
+                    info = elastic.epoch_end(epoch)
+                    if info.changed:
+                        self.logger.info(
+                            "elastic: membership changed at epoch %d "
+                            "boundary (generation %d) — shard recut to "
+                            "%d/%d", epoch, info.generation,
+                            info.part_index, info.num_parts)
+                        if hasattr(train_data, "set_partition"):
+                            try:
+                                train_data.set_partition(info.part_index,
+                                                         info.num_parts)
+                            except NotImplementedError:
+                                pass
                 if eval_data is not None:
                     res = self.score(eval_data, validation_metric,
                                      epoch=epoch,
@@ -379,6 +501,50 @@ class BaseModule:
                     # don't mask the in-flight training exception
                     self.logger.warning("checkpoint cleanup failed",
                                         exc_info=True)
+
+    # -- elastic plumbing -------------------------------------------------
+    def _elastic_broadcast_params(self, kv, root: bool):
+        """One fused broadcast of the lead rank's params + aux states into
+        every rank's bound executor (cold co-start only)."""
+        exec_ = getattr(self, "_exec", None)
+        if exec_ is None or not hasattr(kv, "broadcast_arrays"):
+            return
+        from ..ndarray import array as nd_array
+
+        names = [n for n in getattr(self, "_param_names", [])
+                 if n in exec_.arg_dict]
+        targets = [exec_.arg_dict[n] for n in names] \
+            + [exec_.aux_dict[n] for n in getattr(self, "_aux_names", [])
+               if n in exec_.aux_dict]
+        if not targets:
+            return
+        vals = kv.broadcast_arrays([t.asnumpy() for t in targets], root)
+        if not root:
+            for t, v in zip(targets, vals):
+                t._set_data(nd_array(np.asarray(v, t.dtype))._data)
+
+    def _elastic_sync_grads(self, kv):
+        """Mean-allreduce this step's gradients over the live fleet (one
+        fused flat reduction through ``DistKVStore.allreduce_mean``) and
+        write the means back into the bound executor's grad arrays, so the
+        local optimizer applies an identical update on every surviving
+        rank. The divisor is the count that actually contributed — fewer
+        after a mid-epoch death (docs/ROBUSTNESS.md documents the
+        tolerance)."""
+        exec_ = getattr(self, "_exec", None)
+        if exec_ is None or not hasattr(kv, "allreduce_mean"):
+            return
+        fixed = getattr(self, "_fixed_param_names", set())
+        names = [n for n in getattr(self, "_param_names", [])
+                 if n not in fixed and exec_.grad_dict.get(n) is not None]
+        if not names:
+            return
+        from ..ndarray import array as nd_array
+
+        grads = [exec_.grad_dict[n] for n in names]
+        means, _n = kv.allreduce_mean([g.asnumpy() for g in grads])
+        for g, m in zip(grads, means):
+            g._set_data(nd_array(np.asarray(m, g.dtype))._data)
 
     # -- checkpoint plumbing ----------------------------------------------
     def _capture_training_state(self, epoch, nbatch, global_step,

@@ -141,11 +141,16 @@ def sequence_sharded_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     h_ax = head_axis if sizes.get(head_axis, 1) > 1 else None
     spec = P(b_ax, h_ax, axis_name, None)
     kwargs = {}
-    try:  # vma tracking can't see through pallas_call yet (jax suggests this)
+    try:  # replication tracking can't see through pallas_call yet (jax
+        # suggests disabling it); the flag is check_rep up to jax 0.4.x
+        # and check_vma after the shard_map graduation — probe for either
         import inspect
 
-        if "check_vma" in inspect.signature(shard_map).parameters:
-            kwargs["check_vma"] = False
+        params = inspect.signature(shard_map).parameters
+        for flag in ("check_vma", "check_rep"):
+            if flag in params:
+                kwargs[flag] = False
+                break
     except (ValueError, TypeError):
         pass
     fn = shard_map(partial(_ring_body, axis_name=axis_name, causal=causal,

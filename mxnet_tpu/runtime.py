@@ -137,6 +137,46 @@ _ENV_REGISTRY = {
     "MXNET_PS_ADDR": (None, "dist_async parameter-server host (falls back "
                       "to DMLC_PS_ROOT_URI)."),
     "MXNET_PS_PORT": ("9091", "dist_async parameter-server port."),
+    # elastic training (docs/ROBUSTNESS.md "Elastic training")
+    "MXNET_ELASTIC": (None, "1 = elastic dist_sync: reductions ride the PS "
+                      "wire scoped to the live membership generation; a "
+                      "dead worker releases barriers over survivors, a "
+                      "restarted one rejoins from the shared checkpoint "
+                      "(kvstore/elastic.py; launch.py -e)."),
+    "MXNET_ELASTIC_HEARTBEAT_S": ("0.5", "Worker heartbeat interval; also "
+                                  "the PS liveness-monitor sweep period."),
+    "MXNET_ELASTIC_MISS_K": ("4", "Missed heartbeats before the PS "
+                             "declares a worker dead and bumps the "
+                             "membership generation."),
+    "MXNET_ELASTIC_JOIN_TIMEOUT_S": ("600", "Max wait for a quarantined "
+                                     "rejoiner's epoch-boundary "
+                                     "activation (and epoch rendezvous)."),
+    "MXNET_ELASTIC_REDUCE_TIMEOUT_S": ("120", "Generation-scoped reduce "
+                                       "wait bound (carried in the "
+                                       "request; the server answers "
+                                       "before the socket gives up)."),
+    "MXNET_ELASTIC_ALLOW_STALE_REJOIN": (None, "1 = let a rejoiner whose "
+                                         "newest shared checkpoint lags "
+                                         "the fleet's epoch proceed "
+                                         "anyway (ranks then train "
+                                         "DIVERGENT models — fit raises "
+                                         "by default)."),
+    "MXNET_PS_SNAPSHOT_DIR": (None, "PS durable-state directory: atomic+"
+                              "CRC snapshots + push WAL; warm restart "
+                              "resumes from the newest valid snapshot "
+                              "with the seq-dedup table intact."),
+    "MXNET_PS_SNAPSHOT_PERIOD_S": ("5", "Seconds between periodic PS "
+                                   "snapshots (0 = only INIT/SET_OPT/"
+                                   "shutdown snapshots)."),
+    "MXNET_PS_WAL_FSYNC": ("1", "0 = skip the fsync-per-acked-push in the "
+                           "PS write-ahead log (faster; a power loss may "
+                           "then drop the tail — a plain SIGKILL "
+                           "usually cannot)."),
+    "MXNET_PS_IDLE_PING_S": (None, "Idle threshold (seconds) after which "
+                             "the PS client pings before reusing a "
+                             "connection (half-open detection; needs a "
+                             "python server — elastic sessions default "
+                             "to 30)."),
 }
 
 

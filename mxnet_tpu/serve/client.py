@@ -36,7 +36,7 @@ import numpy as np
 
 from .. import obs
 from ..obs import context as obs_context
-from ..base import capped_backoff
+from ..base import capped_backoff, configure_socket_keepalive
 from ..chaos import rpc as chaos_rpc
 from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
                                  _unpack_arrays)
@@ -83,6 +83,10 @@ class ServeClient:
                 pass
         self._sock = socket.create_connection(self._addr,
                                               timeout=self._timeout)
+        # half-open detection: the shared keepalive policy (base.py) the PS
+        # client uses — a SIGKILL'd replica is noticed by the kernel, not
+        # only by the next RPC timeout
+        configure_socket_keepalive(self._sock)
 
     def _backoff(self, attempt: int) -> float:
         return capped_backoff(attempt, self._retry_interval,

@@ -46,6 +46,11 @@ def pytest_configure(config):
         "sentinel, NaN provenance, checkpoint auto-rollback "
         "(docs/OBSERVABILITY.md \"Training health\"); run via "
         "`pytest -m health` or `make health`")
+    config.addinivalue_line(
+        "markers", "elastic: elastic-training tests — worker membership/"
+        "heartbeats, generation-scoped barriers, PS durability, "
+        "checkpointed rejoin (docs/ROBUSTNESS.md \"Elastic training\"); "
+        "run via `pytest -m elastic` or `make elastic`")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,107 @@
+"""Elastic-training worker for the flagship chaos test (tests/test_elastic.py).
+
+Trains a small linear-regression Module through the REAL ``Module.fit``
+elastic path: generation-scoped gradient sync over the PS wire, shard
+recuts at epoch boundaries, shared-checkpoint rejoin. The harness SIGKILLs
+one of these mid-epoch (on a ``CHAOS_STEP`` marker), restarts it, and
+asserts the fleet's run-to-completion loss matches an uninjected run
+within documented tolerance (docs/ROBUSTNESS.md "Elastic training").
+
+Markers on stdout (the orchestration contract):
+    CHAOS_STEP <n>          after every optimizer step
+    EPOCH_START <e> parts=<p>  at the first batch of each epoch
+    FINAL_LOSS <mse>        full-train-set MSE after the last epoch
+    elastic_worker rank <r>: OK
+"""
+import argparse
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_data(seed: int, samples: int):
+    """Deterministic synthetic regression problem — identical on every
+    rank (the iterator's shard recut slices it per assignment)."""
+    rng = np.random.RandomState(seed)
+    x = rng.randn(samples, 16).astype(np.float32)
+    w = rng.randn(16, 1).astype(np.float32)
+    y = (x @ w).ravel() + 0.01 * rng.randn(samples).astype(np.float32)
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--batch-size", type=int, default=8)
+    # divisible by batch_size * parts for parts in {1,2,3}: every live
+    # fleet size cuts to EQUAL whole-batch shards (lockstep reduce rounds
+    # require equal per-worker batch counts — documented constraint)
+    ap.add_argument("--samples", type=int, default=96)
+    ap.add_argument("--step-delay", type=float, default=0.0,
+                    help="sleep per step — the chaos test stretches epochs "
+                    "so a restarted worker (~seconds of interpreter+jax "
+                    "startup) rejoins a fleet that is still mid-training")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import NDArrayIter
+
+    # create-kvstore-first ordering; elastic via MXNET_ELASTIC=1 +
+    # MXNET_PS_ADDR/PORT in the environment (set by the test harness)
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+
+    x, y = make_data(args.seed, args.samples)
+    mx.random.seed(args.seed)
+    np.random.seed(args.seed)
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("lin_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = mx.sym.LinearRegressionOutput(fc, label, name="lro")
+    mod = mx.mod.Module(out, data_names=("data",), label_names=("lin_label",))
+
+    it = NDArrayIter({"data": x}, {"lin_label": y},
+                     batch_size=args.batch_size, shuffle=False,
+                     label_name="lin_label")
+
+    state = {"step": 0, "epoch": None}
+
+    def on_batch(param):
+        if param.epoch != state["epoch"]:
+            state["epoch"] = param.epoch
+            print(f"EPOCH_START {param.epoch} parts={it.num_parts}",
+                  flush=True)
+        state["step"] += 1
+        print(f"CHAOS_STEP {state['step']}", flush=True)
+        if args.step_delay:
+            import time
+
+            time.sleep(args.step_delay)
+
+    mod.fit(it, num_epoch=args.epochs, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05,
+                              "rescale_grad": 1.0 / args.batch_size},
+            eval_metric="mse", checkpoint=args.ckpt_dir, resume="auto",
+            checkpoint_period=1, batch_end_callback=on_batch,
+            handle_preemption=False)
+
+    full = NDArrayIter({"data": x}, {"lin_label": y},
+                       batch_size=args.batch_size, label_name="lin_label")
+    loss = dict(mod.score(full, "mse"))["mse"]
+    print(f"FINAL_LOSS {loss:.6f}", flush=True)
+    kv.close()
+    print(f"elastic_worker rank {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

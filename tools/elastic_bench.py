@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Elastic-training bench (docs/ROBUSTNESS.md "Elastic training").
+
+Three measured numbers, all in-process (PSServer + ElasticWorkerSessions
+on localhost — no subprocess jitter in the timings):
+
+- ``elastic_recovery_s``: worker-death recovery — wall time from the
+  moment a worker goes silent (reduce contributions AND heartbeats stop,
+  the SIGKILL shape) to the survivors' next reduce round completed
+  WITHOUT it. Should track ``heartbeat_s * miss_k`` plus one liveness
+  sweep — not a barrier timeout.
+- ``rejoin_to_training_s``: a fresh worker joins mid-epoch (quarantined)
+  → wall time until the next epoch boundary activates it with a shard
+  assignment (excludes interpreter startup, which dominates real rejoin
+  but measures nothing about this plane).
+- ``elastic_overhead_pct``: the membership plane's idle cost — PS
+  push+pull round-trip throughput against a server with NO members vs a
+  twin server with ``workers`` sessions heartbeating at the default
+  interval. Segments are INTERLEAVED between the two servers and the
+  best segment of each side compared, so host load noise (this is a
+  1-core box) hits both sides equally — the health/obs overhead legs'
+  discipline. Must sit within noise (<5%, bench.py-gated).
+
+CLI: ``python tools/elastic_bench.py [--workers 3] [--ops 200]`` prints
+one JSON object; ``bench.py`` embeds the same dict as ``extra.elastic``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _segment(cli, grad, ops: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(ops):
+        cli.push("bench_w", grad)
+        cli.pull("bench_w")
+    return ops / (time.perf_counter() - t0)
+
+
+def run_elastic_bench(workers: int = 3, ops: int = 200, segments: int = 5,
+                      hb_interval: float = 0.2, miss_k: int = 3,
+                      threshold_pct: float = 5.0) -> dict:
+    import numpy as np
+
+    from mxnet_tpu.kvstore.elastic import ElasticWorkerSession
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv_plain = PSServer(host="127.0.0.1", port=0, hb_interval=hb_interval,
+                         miss_k=miss_k)
+    srv_el = PSServer(host="127.0.0.1", port=0, hb_interval=hb_interval,
+                      miss_k=miss_k)
+    srv_plain.start()
+    srv_el.start()
+    sessions = []
+    try:
+        sessions = [ElasticWorkerSession("127.0.0.1", srv_el.port, rank=r,
+                                         hb_interval=hb_interval)
+                    for r in range(workers)]
+        for s in sessions:
+            s.ensure_joined(wait_for_expected=False)
+
+        # -- idle overhead: interleaved segments, best-of each side ------
+        grad = np.ones(256, np.float32)
+        clis = {}
+        for name, srv in (("off", srv_plain), ("on", srv_el)):
+            clis[name] = PSClient("127.0.0.1", srv.port, timeout=10,
+                                  retries=3, retry_interval=0.1)
+            clis[name].init("bench_w", np.zeros(256, np.float32))
+            _segment(clis[name], grad, ops // 4)  # warm both paths
+        qps = {"off": [], "on": []}
+        for _ in range(segments):
+            for name in ("off", "on"):
+                qps[name].append(_segment(clis[name], grad, ops))
+        qps_off, qps_on = max(qps["off"]), max(qps["on"])
+        overhead_pct = round((qps_off - qps_on) / qps_off * 100.0, 2)
+
+        # -- steady reduce loop, then a SIGKILL-shaped death -------------
+        arr = np.ones(1024, np.float32)
+        stop = threading.Event()
+        victim_stop = threading.Event()
+        counts = [0] * workers
+        stamps = [0.0] * workers
+
+        def _loop(i):
+            s = sessions[i]
+            own_stop = victim_stop if i == workers - 1 else stop
+            try:
+                while not (stop.is_set() or own_stop.is_set()):
+                    s.allreduce("bench_g", arr, timeout=30)
+                    counts[i] += 1
+                    stamps[i] = time.perf_counter()
+            except Exception:
+                pass  # a declared-dead victim's session errors out
+
+        threads = [threading.Thread(target=_loop, args=(i,), daemon=True)
+                   for i in range(workers)]
+        for t in threads:
+            t.start()
+        time.sleep(max(1.0, hb_interval * 5))  # steady state
+        t_kill = time.perf_counter()
+        victim_stop.set()             # stops contributing...
+        sessions[-1]._hb.stop()       # ...and heartbeating: SIGKILL shape
+        kill_counts = list(counts)
+        # recovery = every survivor completed 2 more rounds (the first may
+        # already have held the victim's contribution; the second cannot)
+        deadline = time.perf_counter() + 60
+        recovery = None
+        while time.perf_counter() < deadline:
+            if all(counts[i] >= kill_counts[i] + 2
+                   for i in range(workers - 1)):
+                recovery = max(stamps[:workers - 1]) - t_kill
+                break
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        # -- rejoin: quarantined join → boundary activation --------------
+        joiner = ElasticWorkerSession("127.0.0.1", srv_el.port,
+                                      rank=workers, hb_interval=hb_interval)
+        info = joiner.ensure_joined(wait_for_expected=False)
+        t_join = time.perf_counter()
+        got = {}
+
+        def _wait():
+            got["info"] = joiner.await_activation(timeout=60)
+            got["t"] = time.perf_counter()
+
+        wt = threading.Thread(target=_wait, daemon=True)
+        wt.start()
+        if info.active:  # fleet died down to 0 actives → instant takeover
+            wt.join(timeout=60)
+            rejoin_s = 0.0
+        else:
+            time.sleep(hb_interval)
+            for s in sessions[:-1]:
+                threading.Thread(target=s.epoch_end, args=(0,),
+                                 daemon=True).start()
+            wt.join(timeout=60)
+            rejoin_s = got["t"] - t_join if "t" in got else None
+        for s in sessions[:-1] + [joiner]:
+            s.close()
+        return {
+            "workers": workers,
+            "heartbeat_s": hb_interval,
+            "miss_k": miss_k,
+            "elastic_recovery_s": (round(recovery, 3)
+                                   if recovery is not None else None),
+            "rejoin_to_training_s": (round(rejoin_s, 3)
+                                     if rejoin_s is not None else None),
+            "ps_qps_baseline": round(qps_off, 1),
+            "ps_qps_elastic": round(qps_on, 1),
+            "elastic_overhead_pct": overhead_pct,
+            "threshold_pct": threshold_pct,
+            "ok": (recovery is not None and rejoin_s is not None
+                   and overhead_pct < threshold_pct),
+        }
+    finally:
+        srv_plain.stop()
+        srv_el.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--ops", type=int, default=200)
+    ap.add_argument("--segments", type=int, default=5)
+    ap.add_argument("--heartbeat", type=float, default=0.2)
+    ap.add_argument("--miss-k", type=int, default=3)
+    args = ap.parse_args(argv)
+    res = run_elastic_bench(workers=args.workers, ops=args.ops,
+                            segments=args.segments,
+                            hb_interval=args.heartbeat, miss_k=args.miss_k)
+    print(json.dumps(res, indent=2))
+    return 0 if res["ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

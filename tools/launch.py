@@ -45,15 +45,22 @@ def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _start_ps_server(port: int, num_workers: int):
-    """Prefer the native C++ server; fall back to the python twin."""
+def _start_ps_server(port: int, num_workers: int, elastic: bool = False):
+    """Prefer the native C++ server; fall back to the python twin. Elastic
+    mode needs the python server — the membership/heartbeat opcodes (16-20,
+    kvstore/elastic.py) are not in the C++ twin."""
     native = os.path.join(_repo_root(), "native", "build", "mxtpu_ps_server")
-    if os.path.exists(native):
+    env = dict(os.environ)
+    if os.path.exists(native) and not elastic:
         cmd = [native, "--port", str(port), "--num-workers", str(num_workers)]
     else:
         cmd = [sys.executable, "-m", "mxnet_tpu.kvstore.ps_server",
                "--port", str(port), "--num-workers", str(num_workers)]
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+        # the child must import mxnet_tpu regardless of the caller's cwd
+        # (the serve ProcReplica idiom)
+        env["PYTHONPATH"] = _repo_root() + os.pathsep + env.get(
+            "PYTHONPATH", "")
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True)
     deadline = time.time() + 60
     lines = []
@@ -69,17 +76,24 @@ def _start_ps_server(port: int, num_workers: int):
 
 
 def launch_local(num_workers: int, num_servers: int, command: list,
-                 env_extra=None) -> int:
+                 env_extra=None, elastic: bool = False) -> int:
     """Spawn everything on localhost; returns the first nonzero worker rc."""
     base_env = dict(os.environ)
     base_env.update(env_extra or {})
+    elastic = elastic or base_env.get("MXNET_ELASTIC", "") not in ("", "0")
     base_env["DMLC_NUM_WORKER"] = str(num_workers)
     base_env["DMLC_NUM_SERVER"] = str(num_servers)
+    if elastic:
+        # elastic dist_sync (docs/ROBUSTNESS.md "Elastic training") rides
+        # the PS wire for membership + generation-scoped reductions: a PS
+        # process is required even for sync mode
+        base_env["MXNET_ELASTIC"] = "1"
+        num_servers = max(1, num_servers)
 
     ps_proc = None
     if num_servers > 0:
         ps_port = _free_port()
-        ps_proc = _start_ps_server(ps_port, num_workers)
+        ps_proc = _start_ps_server(ps_port, num_workers, elastic=elastic)
         base_env["MXNET_PS_ADDR"] = "127.0.0.1"
         base_env["MXNET_PS_PORT"] = str(ps_port)
     else:
@@ -118,6 +132,10 @@ def main(argv=None) -> int:
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("-s", "--num-servers", type=int, default=0,
                    help="PS processes (dist_async); 0 = collective dist_sync")
+    p.add_argument("-e", "--elastic", action="store_true",
+                   help="elastic training: PS-backed generation-scoped "
+                   "sync, worker heartbeats, survivable barriers "
+                   "(docs/ROBUSTNESS.md); implies a python PS process")
     p.add_argument("--launcher", default="local",
                    choices=["local", "ssh", "mpi", "yarn", "sge"])
     p.add_argument("command", nargs=argparse.REMAINDER)
@@ -130,7 +148,8 @@ def main(argv=None) -> int:
             "processes from here")
     if not args.command:
         p.error("no command given")
-    return launch_local(args.num_workers, args.num_servers, args.command)
+    return launch_local(args.num_workers, args.num_servers, args.command,
+                        elastic=args.elastic)
 
 
 if __name__ == "__main__":
