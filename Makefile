@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench dossier
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier
 
 # repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
 lint:
@@ -90,3 +90,11 @@ serve:
 # load generator: closed-loop + open-loop p50/p99 vs offered load
 serve-bench:
 	$(PYTHON) tools/serve_bench.py --model mlp --duration 5
+
+# mesh-sharded serving + elastic autoscale suite on the 8-device CPU mesh:
+# tensor-parallel engines, replica groups on mesh slices, quarantine→
+# activate joins, drain-then-leave, autoscaler policy/controller
+# (docs/SERVING.md "Mesh-sharded serving and elastic autoscaling")
+serve_mesh:
+	$(PYTHON) -m pytest tests/ -q -m serve_mesh -p no:cacheprovider
+	$(PYTHON) tools/serve_bench.py --scale --duration 3

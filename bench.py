@@ -500,6 +500,44 @@ def bench_serve(platform):
             "buckets": res.get("buckets")}
 
 
+def bench_serve_scale(platform):
+    """Mesh-sharded serving scaling (docs/SERVING.md "Mesh-sharded serving
+    and elastic autoscaling"): closed-loop serve_qps through dp∈{1,2,4}
+    tensor-parallel replica groups on mesh slices behind one FleetServer
+    front — the ROADMAP item 1 headline: serve throughput must scale with
+    the mesh. On a CPU host the virtual devices share the physical cores,
+    so the report carries ``host_cores`` + a note when the near-linear
+    check cannot bind (compute caps at host_cores×)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    duration = float(os.environ.get("BENCH_SERVE_SCALE_DURATION",
+                                    4 if platform == "tpu" else 3))
+    res = serve_bench.run_scale_bench(
+        model=os.environ.get("BENCH_SERVE_SCALE_MODEL", "mlp"),
+        duration=duration,
+        tp=int(os.environ.get("BENCH_SERVE_SCALE_TP", 2)))
+    return res
+
+
+def bench_serve_ramp(platform):
+    """Autoscale under a load ramp (docs/SERVING.md): open-loop offered
+    qps climbs while the SLO autoscaler grows the sharded fleet; the
+    trajectory metric is scale_out_events with shed==0 — measured
+    elasticity, the serving twin of extra.elastic_recovery_s."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    duration = float(os.environ.get("BENCH_SERVE_RAMP_DURATION", 14))
+    res = serve_bench.run_ramp_bench(
+        model=os.environ.get("BENCH_SERVE_SCALE_MODEL", "mlp"),
+        duration=duration)
+    res.pop("ready_timeline", None)  # keep the artifact compact
+    return res
+
+
 def bench_obs_overhead(platform):
     """Tracing overhead on the serve path (docs/OBSERVABILITY.md): the
     serve bench twice — telemetry off vs on at head-sampling 0.1 — and the
@@ -798,6 +836,20 @@ def main():
             extra["serve"] = bench_serve(platform)
         except Exception as e:
             extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("serve_scale"):
+        try:
+            # serve throughput vs data-parallel replica groups on mesh
+            # slices + measured autoscale-out under a load ramp
+            # (docs/SERVING.md "Mesh-sharded serving") — ROADMAP item 1's
+            # two headline numbers: scaling_dp4 and scale_out_events@shed=0
+            extra["serve_scale"] = bench_serve_scale(platform)
+        except Exception as e:
+            extra["serve_scale_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("serve_ramp"):
+        try:
+            extra["serve_ramp"] = bench_serve_ramp(platform)
+        except Exception as e:
+            extra["serve_ramp_error"] = f"{type(e).__name__}: {e}"[:200]
     if not over_budget("obs_overhead"):
         try:
             # tracing must be cheap enough to stay ON under load — measure
@@ -873,6 +925,8 @@ def main():
         "lm_seq2048": "lm_seq2048_bf16",
         "lm_seq4096": "lm_seq4096_bf16",
         "serve": "serve",
+        "serve_scale": "serve_scale",
+        "serve_ramp": "serve_ramp",
         "obs_overhead": "obs_overhead",
         "health_overhead": "health_overhead",
         "elastic": "elastic",

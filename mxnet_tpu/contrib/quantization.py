@@ -18,7 +18,33 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["quantize_model", "quantize_net", "quantize_graph"]
+__all__ = ["quantize_model", "quantize_net", "quantize_graph",
+           "SUPPORTED_CALIB_MODES"]
+
+# ONE source of truth for calibration modes across every entry point
+# (quantize_net / quantize_model / quantize_graph). 'entropy' (KL threshold
+# search — the reference's *recommended* calibration) is recognized but
+# unimplemented: it raises NotImplementedError naming the gap instead of a
+# generic ValueError, so callers can tell "you typo'd" from "not built yet"
+# (the gap is tracked as ROADMAP item 5).
+SUPPORTED_CALIB_MODES = ("none", "naive")
+
+
+def _check_calib_mode(calib_mode):
+    """Structured calib_mode validation shared by every quantization entry
+    point — quantize_net and quantize_model used to disagree on what an
+    unsupported mode raised and which modes they listed."""
+    if calib_mode in SUPPORTED_CALIB_MODES:
+        return
+    if calib_mode == "entropy":
+        raise NotImplementedError(
+            "calib_mode='entropy' (KL threshold search, the reference's "
+            "recommended calibration) is not implemented yet — tracked as "
+            f"ROADMAP item 5. Supported modes: {SUPPORTED_CALIB_MODES}")
+    raise ValueError(
+        f"calib_mode {calib_mode!r} is not supported; choose one of "
+        f"{SUPPORTED_CALIB_MODES} ('entropy' is recognized but "
+        "unimplemented — ROADMAP item 5)")
 
 
 def _collect_ranges(net, calib_data, num_calib_batches=None):
@@ -175,9 +201,7 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
     return it. ``network._quantized_layers`` lists what was swapped."""
     if quantized_dtype not in ("int8", "auto"):
         raise ValueError(f"quantized_dtype {quantized_dtype!r} not supported")
-    if calib_mode not in ("naive", "none"):
-        raise ValueError(f"calib_mode {calib_mode!r} not supported "
-                         "(naive|none)")
+    _check_calib_mode(calib_mode)
     if calib_mode == "naive":
         if calib_data is None:
             raise ValueError("calib_mode='naive' needs calib_data")
@@ -404,12 +428,7 @@ def quantize_model(sym, arg_params=None, aux_params=None,
     """
     if quantized_dtype not in ("int8", "auto"):
         raise ValueError(f"quantized_dtype {quantized_dtype!r} not supported")
-    if calib_mode == "entropy":
-        raise NotImplementedError(
-            "calib_mode='entropy' (KL threshold search) is not implemented; "
-            "use 'naive' or 'none'")
-    if calib_mode not in ("none", "naive"):
-        raise ValueError(f"calib_mode {calib_mode!r} not supported")
+    _check_calib_mode(calib_mode)
     arg_params = dict(arg_params or {})
     aux_params = dict(aux_params or {})
     excluded = set(excluded_sym_names or ())

@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Sequence
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_BUCKETS", "registry", "counter", "gauge", "histogram",
-           "snapshot", "dump", "reset"]
+           "snapshot", "dump", "reset", "remove"]
 
 # Prometheus-style latency ladder (seconds). Fine enough to separate a
 # sub-ms fused dispatch from a 100ms RPC retry from a multi-second compile.
@@ -265,6 +265,14 @@ class MetricsRegistry:
                              f"{h['max']:>12.6g}")
         return "\n".join(lines) if lines else "(no metrics)"
 
+    def remove(self, name: str) -> None:
+        """Drop one metric by name (no-op when absent). The elastic serve
+        plane uses this on scale-in: a removed replica's per-replica gauges
+        (``fleet.replica<i>.*``) would otherwise sit in the Prometheus
+        exposition forever as frozen last values."""
+        with self._lock:
+            self._metrics.pop(name, None)
+
     def reset(self) -> None:
         """Drop every metric (tests; a fresh run's registry is empty)."""
         with self._lock:
@@ -293,6 +301,10 @@ def snapshot() -> dict:
 
 def dump(fmt: str = "text") -> str:
     return registry.dump(fmt)
+
+
+def remove(name: str) -> None:
+    registry.remove(name)
 
 
 def reset() -> None:

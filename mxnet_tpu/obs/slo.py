@@ -206,6 +206,43 @@ class SLOMonitor:
         return report
 
     # ------------------------------------------------------------------
+    def burn_window(self, prev: Optional[dict], cur: dict) -> dict:
+        """Error-budget burn over the WINDOW between two metrics snapshots
+        (counter deltas) — the autoscaler's signal (serve/autoscale.py).
+        Cumulative burn never cools down after an incident, so a scaler
+        fed :meth:`evaluate` would keep scaling out forever; a window
+        recovers the moment the fleet does. Source priority matches
+        ``evaluate``: the router's per-request histogram when present
+        (hedging duplicates replica-side executions), the replica
+        latency histogram otherwise. ``prev=None`` means "since boot"."""
+        def _c(s, name):
+            return ((s or {}).get("counters") or {}).get(name, 0)
+
+        def _h(s, name):
+            h = ((s or {}).get("histograms") or {}).get(name)
+            return h.get("count", 0) if h else 0
+
+        fleet_n = _h(cur, "fleet.request_latency_seconds")
+        if fleet_n or _h(prev, "fleet.request_latency_seconds"):
+            completed = fleet_n - _h(prev, "fleet.request_latency_seconds")
+            misses = (_c(cur, "fleet.request_deadline_exceeded")
+                      - _c(prev, "fleet.request_deadline_exceeded"))
+        else:
+            completed = (_h(cur, self.latency_metric)
+                         - _h(prev, self.latency_metric))
+            misses = (_c(cur, "serve.shed_deadline")
+                      - _c(prev, "serve.shed_deadline"))
+        completed = max(completed, 0)
+        misses = max(misses, 0)
+        denom = completed + misses
+        attainment = 1.0 - (misses / denom) if denom else 1.0
+        budget = 1.0 - self.deadline_target
+        burn = ((1.0 - attainment) / budget) if budget else 0.0
+        return {"completed": completed, "misses": misses,
+                "attainment": round(attainment, 6),
+                "burn": round(burn, 4)}
+
+    # ------------------------------------------------------------------
     @staticmethod
     def render(report: dict) -> str:
         """The report as a terminal table (tools/fleet_report.py)."""

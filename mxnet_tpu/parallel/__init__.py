@@ -18,7 +18,7 @@ from sharding annotations. This package provides:
   reference lacks — SURVEY.md §5.7).
 """
 from .mesh import (make_mesh, mesh_axes, local_device_count, mesh_scope,  # noqa: F401
-                   current_mesh)
+                   current_mesh, mesh_slices)
 from .sharding import (ShardingRules, param_sharding, batch_sharding,  # noqa: F401
                        replicated)
 from .functional import functionalize  # noqa: F401

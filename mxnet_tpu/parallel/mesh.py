@@ -8,14 +8,14 @@ mesh axes carry the heaviest collectives, so order axes ("pp", "dp", "sp",
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 __all__ = ["make_mesh", "mesh_axes", "local_device_count", "mesh_scope",
-           "current_mesh"]
+           "current_mesh", "mesh_slices"]
 
 AXIS_ORDER = ("pp", "dp", "sp", "tp", "ep")
 
@@ -68,6 +68,34 @@ def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None) -> Mesh:
 
 def mesh_axes(mesh: Mesh) -> Dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def mesh_slices(mesh: Mesh, axis: str = "dp") -> List[Mesh]:
+    """Split ``mesh`` along ``axis`` into independent submeshes — one per
+    index along that axis, each keeping every remaining axis. This is the
+    serve plane's replica-group placement (serve/fleet.py
+    ``ReplicaPool.sharded``): a ``dp4×tp2`` mesh yields four disjoint
+    2-device ``tp`` slices, each hosting one tensor-parallel replica while
+    data parallelism happens *across* slices via the Router.
+
+    A mesh without ``axis`` is a single slice (itself). A pure-``axis``
+    mesh (no other axes) yields 1-device slices carrying a trivial
+    ``("tp",)`` axis so sharding rule tables prune against them unchanged.
+    """
+    if axis not in mesh.axis_names:
+        return [mesh]
+    i = mesh.axis_names.index(axis)
+    names = tuple(a for a in mesh.axis_names if a != axis)
+    out = []
+    for k in range(mesh.devices.shape[i]):
+        # np.take collapses a 1-axis mesh to a bare Device scalar —
+        # re-wrap so both branches hold an ndarray
+        sub = np.asarray(np.take(mesh.devices, k, axis=i))
+        if not names:
+            out.append(Mesh(sub.reshape(1), ("tp",)))
+        else:
+            out.append(Mesh(sub, names))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -79,5 +79,9 @@ def param_sharding(mesh: Mesh, rules: ShardingRules, named_shapes: Dict[str, tup
     return rules.tree_shardings(mesh, named_shapes)
 
 
-def batch_sharding(mesh: Mesh, spec: P = P("dp")) -> NamedSharding:
-    return NamedSharding(mesh, _prune(spec, mesh))
+def batch_sharding(mesh: Mesh, spec: P = P("dp"), shape=None) -> NamedSharding:
+    """Sharding for a batch-leading array. ``shape`` (optional) prunes axes
+    that do not divide the corresponding dim — the serving engine passes
+    each bucket's padded shape so a bucket not divisible by ``dp`` falls
+    back to replicated instead of failing placement."""
+    return NamedSharding(mesh, _prune(spec, mesh, shape))

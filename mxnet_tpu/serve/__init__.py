@@ -19,8 +19,14 @@ Layers
   :class:`~mxnet_tpu.serve.fleet.Router` /
   :class:`~mxnet_tpu.serve.fleet.FleetServer` — supervised replica fleet:
   restart-with-backoff, per-replica circuit breakers, failover + hedging,
-  and fleet-atomic two-phase hot reload (``fleet.py``,
-  docs/ROBUSTNESS.md "Serving fleet").
+  fleet-atomic two-phase hot reload, and elastic membership (quarantine →
+  activate-at-boundary joins, drain-then-leave) with data-parallel replica
+  groups placed on mesh slices (``ReplicaPool.sharded``; ``fleet.py``,
+  docs/ROBUSTNESS.md "Serving fleet");
+- :class:`~mxnet_tpu.serve.autoscale.Autoscaler` /
+  :class:`~mxnet_tpu.serve.autoscale.AutoscalePolicy` — SLO-driven elastic
+  autoscaling: windowed error-budget burn + queue-depth/occupancy signals
+  grow and shrink the pool live (``autoscale.py``, docs/SERVING.md).
 
 Typical session::
 
@@ -61,12 +67,14 @@ from .server import ServeServer
 from .client import ServeClient
 from .fleet import (CircuitBreaker, FleetServer, LocalReplica, ProcReplica,
                     ReplicaPool, Router)
+from .autoscale import Autoscaler, AutoscalePolicy
 
 __all__ = ["load", "load_params", "InferenceEngine", "DynamicBatcher",
            "Future", "ServeServer", "ServeClient", "ServeError",
            "RequestRejected", "DeadlineExceeded", "Draining",
            "default_buckets", "CircuitBreaker", "FleetServer",
-           "LocalReplica", "ProcReplica", "ReplicaPool", "Router"]
+           "LocalReplica", "ProcReplica", "ReplicaPool", "Router",
+           "Autoscaler", "AutoscalePolicy"]
 
 
 def _newest_epoch(path: str) -> int:

@@ -129,6 +129,14 @@ class DynamicBatcher:
         self.submitted = 0
         self.completed = 0
         self.shed = 0
+        # batch-occupancy accounting (unconditional, like the counters
+        # above — the autoscaler reads it through STATS with obs off):
+        # rows-per-dispatched-batch over max_batch_size, EWMA'd so stats()
+        # reports RECENT pressure, not a lifetime average that a quiet
+        # hour would freeze high
+        self.exec_batches = 0
+        self.exec_rows = 0
+        self._occ_ewma = 0.0
         # sheds counted by cause (queue_full / deadline / draining):
         # "the endpoint shed 40 requests" is an alert, "38 deadline-expired
         # vs 2 queue-overflow" is a diagnosis — and the fleet STATS endpoint
@@ -287,6 +295,12 @@ class DynamicBatcher:
     def _execute(self, batch: List[_Request]) -> None:
         t_exec = time.monotonic()
         rows = sum(r.n for r in batch)
+        occ = rows / float(self.max_batch_size)
+        self.exec_batches += 1
+        self.exec_rows += rows
+        self._occ_ewma = occ if self.exec_batches == 1 \
+            else 0.7 * self._occ_ewma + 0.3 * occ
+        obs.set_gauge("serve.batch_occupancy", occ)
         rec = obs.enabled()
         # batch-level spans pin to the first SAMPLED member's trace — a
         # batch serves many traces, and under head sampling the member
@@ -359,6 +373,9 @@ class DynamicBatcher:
         return {"submitted": self.submitted, "completed": self.completed,
                 "shed": self.shed, "shed_by_reason": dict(self.shed_by_reason),
                 "queue_depth": self._qsize,
+                "occupancy": round(self._occ_ewma, 4),
+                "batches_executed": self.exec_batches,
+                "rows_executed": self.exec_rows,
                 "inflight": self._inflight, "lanes": len(self._lanes),
                 "max_batch_size": self.max_batch_size,
                 "max_linger_ms": self.max_linger * 1e3,

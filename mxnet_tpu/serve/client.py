@@ -235,8 +235,12 @@ class ServeClient:
         except ServeError:
             return False, -1
 
-    def stats(self) -> dict:
-        reply = self._check(self._rpc(OP_STATS), "stats failed")
+    def stats(self, include_metrics: bool = True) -> dict:
+        """Server stats json. ``include_metrics=False`` skips the metrics
+        registry snapshot (the fleet supervisor's cheap per-probe poll)."""
+        payload = b"" if include_metrics \
+            else json.dumps({"metrics": False}).encode("utf-8")
+        reply = self._check(self._rpc(OP_STATS, payload), "stats failed")
         return json.loads(bytes(reply).decode("utf-8"))
 
     def telemetry(self, drain: bool = True, fmt: str = "json"):

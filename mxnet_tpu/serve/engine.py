@@ -32,6 +32,7 @@ bucket/compile attribution, ``serve.compile_seconds`` vs
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -63,16 +64,30 @@ class Draining(ServeError):
     """The endpoint is draining for shutdown and refuses new work."""
 
 
-def _to_device(v):
+def _to_device(v, sharding=None):
     """NDArray/numpy → device array (load-time AND reload-time parameter
-    placement share this one helper so they can never diverge)."""
+    placement share this one helper so they can never diverge). With a
+    ``sharding`` the value is committed to the engine's mesh slice —
+    tensor-parallel params land shard-resident per device, never gathered
+    on one."""
     import jax
 
     from ..ndarray import NDArray
 
     if isinstance(v, NDArray) and v._data is not None:
-        return v._data
-    return jax.device_put(np.ascontiguousarray(np.asarray(v)))
+        if sharding is None:
+            return v._data
+        return jax.device_put(v._data, sharding)
+    arr = np.ascontiguousarray(np.asarray(v))
+    return jax.device_put(arr) if sharding is None \
+        else jax.device_put(arr, sharding)
+
+
+def _shape_of(v) -> tuple:
+    s = getattr(v, "shape", None)
+    if s is None:
+        s = np.asarray(v).shape
+    return tuple(int(d) for d in s)
 
 
 def default_buckets(max_batch_size: int) -> List[int]:
@@ -127,6 +142,23 @@ class InferenceEngine:
         Pre-flight ``Symbol.lint`` at load time; "error" refuses to serve a
         graph with error-severity findings (a bad graph should fail at
         deploy, not on the first customer request).
+    mesh : jax.sharding.Mesh, optional
+        Shard the engine over a device mesh (typically one replica group's
+        slice — ``parallel.mesh_slices``): parameters are committed
+        shard-resident per device by the ``rules`` table, every bucket's
+        program compiles over the mesh (XLA inserts the tensor-parallel
+        collectives), and batches shard over a ``dp`` axis when the mesh
+        has one (``data_spec``). The compiled-program bound, the
+        compile_log accounting, atomic hot reload, and the
+        bitwise-vs-``predict`` contract *per shard config* are all
+        unchanged — the mesh only changes where arrays live.
+    rules : parallel.ShardingRules, optional
+        Parameter-name → PartitionSpec table (default: everything
+        replicated). Specs naming axes the mesh lacks, or not dividing a
+        dim, prune to replicated — one table serves every mesh shape.
+    data_spec : PartitionSpec, optional
+        Spec for request batches (default ``P("dp")``, pruned per bucket
+        shape; a pure-``tp`` slice replicates the batch).
     """
 
     def __init__(self, symbol, arg_params, aux_params=None, *,
@@ -134,7 +166,8 @@ class InferenceEngine:
                  max_batch_size: int = 32,
                  buckets: Optional[Sequence[int]] = None,
                  lint: str = "warn",
-                 pad_value: float = 0.0):
+                 pad_value: float = 0.0,
+                 mesh=None, rules=None, data_spec=None):
         import jax
 
         from ..executor import _build_graph_fn
@@ -197,13 +230,36 @@ class InferenceEngine:
                 warnings.warn("serve model-load lint: "
                               + self.lint_report.format(), stacklevel=2)
 
+        # -- mesh sharding (tensor-parallel serving) ----------------------
+        # the mesh-dependent placement is all resolved HERE, once: a dict
+        # name → NamedSharding for params (rules table, pruned per shape),
+        # replicated for aux/free/rng, batch spec per bucket at infer time.
+        # reload goes through the same dict, so a new generation can never
+        # land with a different layout than the programs compiled for.
+        self.mesh = mesh
+        self._param_sh: Dict[str, object] = {}
+        self._replicated_sh = None
+        self._data_spec = data_spec
+        self._data_sh_cache: Dict[tuple, object] = {}
+        if mesh is not None:
+            from ..parallel.sharding import (ShardingRules, replicated)
+
+            rules = rules or ShardingRules()
+            self._rules = rules
+            self._replicated_sh = replicated(mesh)
+            for n in self._param_names:
+                self._param_sh[n] = rules.sharding_for(
+                    n, mesh, _shape_of(arg_params[n]))
+
         # -- device-resident parameters -----------------------------------
         self._lock = threading.Lock()
         self._staged: Optional[_ParamSet] = None  # prepared, not yet serving
         self._params = _ParamSet(
             0,
-            tuple(_to_device(arg_params[n]) for n in self._param_names),
-            tuple(_to_device(aux_params[n]) for n in self._aux_names))
+            tuple(_to_device(arg_params[n], self._param_sh.get(n))
+                  for n in self._param_names),
+            tuple(_to_device(aux_params[n], self._replicated_sh)
+                  for n in self._aux_names))
         self._param_avals = tuple(
             (tuple(v.shape), str(v.dtype)) for v in self._params.arg_vals)
         self._aux_avals = tuple(
@@ -242,6 +298,12 @@ class InferenceEngine:
 
         key = jr.PRNGKey(0)  # eval mode draws nothing; fixed = deterministic
         self._rng_data = jr.key_data(key) if hasattr(jr, "key_data") else key
+        if self._replicated_sh is not None:
+            # every program input must be COMMITTED to the engine's mesh
+            # slice: an uncommitted array defaults to device 0, which may
+            # not even be in this slice
+            self._rng_data = jax.device_put(self._rng_data,
+                                            self._replicated_sh)
 
         # explicit program accounting (the fused-update cache-key idiom):
         # one entry per distinct input signature ever compiled. The
@@ -273,9 +335,19 @@ class InferenceEngine:
     def data_names(self) -> List[str]:
         return list(self._data_names)
 
+    def _mesh_ctx(self):
+        """Trace-time scope: model code (ring attention etc.) discovers the
+        engine's mesh slice via ``parallel.current_mesh()``. No-op when the
+        engine is unsharded."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from ..parallel.mesh import mesh_scope
+
+        return mesh_scope(self.mesh)
+
     def stats(self) -> dict:
         staged = self._staged
-        return {
+        out = {
             "version": self.version,
             "staged_version": staged.version if staged is not None else None,
             "buckets": list(self.buckets),
@@ -284,6 +356,16 @@ class InferenceEngine:
             "programs": {repr(k): v for k, v in self._programs.items()},
             "compiles": len(self.compile_log),
         }
+        if self.mesh is not None:
+            from ..parallel.mesh import mesh_axes
+
+            out["mesh"] = mesh_axes(self.mesh)
+            out["mesh_devices"] = int(self.mesh.devices.size)
+            out["sharded_params"] = sum(
+                1 for sh in self._param_sh.values()
+                if getattr(sh, "spec", None) and any(
+                    ax is not None for ax in sh.spec))
+        return out
 
     # ------------------------------------------------------------------
     # bucketing
@@ -316,10 +398,35 @@ class InferenceEngine:
                         f"{missing}; pass them as arg_params or data_names")
                 vals = tuple(jnp.zeros(inferred[n], jnp.float32)
                              for n in self._free_names)
+                if self._replicated_sh is not None:
+                    import jax
+
+                    vals = tuple(jax.device_put(v, self._replicated_sh)
+                                 for v in vals)
             else:
                 vals = ()
             self._free_cache[key] = vals
         return vals
+
+    def _data_sharding(self, shape):
+        """Batch placement for one (padded) request array: the ``data_spec``
+        pruned against this mesh and shape — sharded over ``dp`` when the
+        bucket divides, replicated otherwise (a pure-``tp`` replica group
+        always replicates the batch; the weights are what is sharded).
+        Cached per shape (the _free_cache idiom): shapes are bounded by
+        the bucket list, and rebuilding the pruned NamedSharding per
+        request would be pure repeated work on the hot path."""
+        sh = self._data_sh_cache.get(shape)
+        if sh is None:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.sharding import batch_sharding
+
+            spec = self._data_spec if self._data_spec is not None \
+                else P("dp")
+            sh = batch_sharding(self.mesh, spec, shape)
+            self._data_sh_cache[shape] = sh
+        return sh
 
     # ------------------------------------------------------------------
     # execution
@@ -376,7 +483,14 @@ class InferenceEngine:
                 [a, np.full((pad,) + a.shape[1:], self._pad_value, a.dtype)],
                 axis=0) for a in arrays]
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
-        free_vals = self._free_vals(bucket, [a.shape for a in arrays])
+        if self.mesh is not None:
+            # commit the padded batch onto the mesh slice (dp-sharded when
+            # the spec and bucket allow, replicated otherwise) — the sig is
+            # taken from the host shapes above, so sharding never changes
+            # the program-accounting key
+            arrays = [jax.device_put(a, self._data_sharding(a.shape))
+                      for a in arrays]
+        free_vals = self._free_vals(bucket, [tuple(a.shape) for a in arrays])
         snapshot = self._params  # atomic: old-or-new, never mixed
 
         if profiler.counting_dispatches():
@@ -403,10 +517,11 @@ class InferenceEngine:
                 # the compile_log entry, the executable into the sig cache
                 # (params stay traced arguments — reload still swaps arrays
                 # without touching the program)
-                compiled, cost = obs.device.capture(
-                    self._jitted,
-                    (self._rng_data, arg_vals, list(snapshot.aux_vals)),
-                    site="serve", label=f"bucket{bucket}")
+                with self._mesh_ctx():
+                    compiled, cost = obs.device.capture(
+                        self._jitted,
+                        (self._rng_data, arg_vals, list(snapshot.aux_vals)),
+                        site="serve", label=f"bucket{bucket}")
                 if compiled is not None:
                     self._aot[sig] = compiled
                 if cost:
@@ -417,8 +532,9 @@ class InferenceEngine:
         with obs.trace.span("serve.execute", bucket=bucket, rows=n_valid,
                             compile=is_compile,
                             version=snapshot.version) as sp:
-            outs, _new_aux = fn(self._rng_data, arg_vals,
-                                list(snapshot.aux_vals))
+            with self._mesh_ctx():
+                outs, _new_aux = fn(self._rng_data, arg_vals,
+                                    list(snapshot.aux_vals))
             cost = self._sig_cost.get(sig) if rec and not is_compile \
                 else None
             if cost:
@@ -484,9 +600,14 @@ class InferenceEngine:
         missing += [n for n in self._aux_names if n not in aux_params]
         if missing:
             raise ServeError(f"reload missing parameters: {missing}")
-        new_args = tuple(_to_device(arg_params[n])
+        # the new generation lands with the SAME shardings the serving set
+        # was placed with (the dict resolved at construction): the compiled
+        # programs' layouts are part of the engine contract, not of any one
+        # parameter generation
+        new_args = tuple(_to_device(arg_params[n], self._param_sh.get(n))
                          for n in self._param_names)
-        new_aux = tuple(_to_device(aux_params[n]) for n in self._aux_names)
+        new_aux = tuple(_to_device(aux_params[n], self._replicated_sh)
+                        for n in self._aux_names)
         for names, vals, avals in (
                 (self._param_names, new_args, self._param_avals),
                 (self._aux_names, new_aux, self._aux_avals)):

@@ -316,12 +316,13 @@ class ProcReplica:
 class _Member:
     __slots__ = ("idx", "handle", "state", "addr", "incarnation", "restarts",
                  "restart_at", "restarting", "version", "rpcs", "errors",
-                 "sheds", "last_error")
+                 "sheds", "last_error", "queue_depth", "occupancy")
 
     def __init__(self, idx: int, handle):
         self.idx = idx
         self.handle = handle
-        self.state = "new"  # new|starting|ready|resync|dead|stopped
+        # new|starting|quarantined|ready|resync|dead|leaving|removed|stopped
+        self.state = "new"
         self.addr: Optional[Tuple[str, int]] = None
         self.incarnation = 0
         self.restarts = 0
@@ -332,12 +333,29 @@ class _Member:
         self.errors = 0
         self.sheds = 0
         self.last_error = ""
+        # pulled from the replica's STATS by the supervisor each cycle and
+        # mirrored into fleet.replica<i>.* gauges — the autoscaler and the
+        # Prometheus exposition read the SAME numbers
+        self.queue_depth = 0
+        self.occupancy = 0.0
 
 
 class ReplicaPool:
     """Supervise N serve replicas: bring-up, liveness probes, restart with
     capped backoff + jitter, and reload-target tracking so restarts rejoin
-    at the committed fleet version (never a stale one)."""
+    at the committed fleet version (never a stale one).
+
+    The pool is **elastic** (the ``kvstore/elastic.py`` membership protocol
+    ported to the serve plane): :meth:`add_replica` brings a newcomer up
+    **quarantined** — started, probed ready, warmed, resynced to the
+    committed ``(artifact, version)`` target — and only then **activates**
+    it at a **generation boundary** (one atomic flip under the pool lock;
+    the Router's candidate set changes between requests, never mid-request).
+    :meth:`remove_replica` is the leave half: deactivate at a boundary
+    (routing stops instantly), drain the replica's queued + in-flight work,
+    then stop it — scale-in sheds nothing. ``generation`` increments on
+    every membership change, so observers can count scale events exactly.
+    """
 
     def __init__(self, replicas: Sequence, *, probe_interval: float = 0.5,
                  backoff_base: float = 0.2, backoff_cap: float = 5.0,
@@ -358,6 +376,13 @@ class ReplicaPool:
         self._resync_seq = 0
         self._stop_evt = threading.Event()
         self._supervisor: Optional[threading.Thread] = None
+        # membership generation: bumped on every activate/leave (the
+        # elastic-plane idiom) — autoscale events are generation deltas
+        self.generation = 0
+        # mesh-slice allocator (ReplicaPool.sharded): slices freed by
+        # scale-in are reused by the next scale-out
+        self._make_server: Optional[Callable] = None
+        self._spare_slices: List = []
 
     @classmethod
     def local(cls, factory: Callable[[], ServeServer], n: int,
@@ -370,6 +395,65 @@ class ReplicaPool:
               **kw) -> "ReplicaPool":
         return cls([ProcReplica(model, args=args, env=env, obs_dir=obs_dir)
                     for _ in range(n)], **kw)
+
+    @classmethod
+    def sharded(cls, make_server: Callable, groups: Optional[int] = None, *,
+                mesh=None, start: Optional[int] = None,
+                **kw) -> "ReplicaPool":
+        """Data-parallel replica groups on mesh slices: split the device
+        mesh along its ``dp`` axis into ``groups`` tensor-parallel
+        submeshes (``parallel.mesh_slices``) and supervise one in-process
+        replica per slice. ``make_server(submesh)`` must return a *started*
+        :class:`~mxnet_tpu.serve.server.ServeServer` whose engine was built
+        with ``mesh=submesh`` (see ``InferenceEngine``).
+
+        ``start`` (default: all ``groups``) brings up only the first
+        ``start`` slices; the rest stay spare for elastic scale-out
+        (:meth:`new_sharded_handle` / ``serve/autoscale.py``). Default mesh:
+        ``make_mesh({"dp": groups, "tp": -1})`` over all local devices —
+        every device serves from the first request."""
+        import functools
+
+        from ..parallel import make_mesh, mesh_slices
+
+        if mesh is None:
+            if not groups:
+                raise ValueError("pass groups= or mesh=")
+            mesh = make_mesh({"dp": int(groups), "tp": -1})
+        slices = mesh_slices(mesh, "dp")
+        if start is None:
+            start = len(slices)
+        start = max(1, min(int(start), len(slices)))
+        replicas = []
+        for sub in slices[:start]:
+            r = LocalReplica(functools.partial(make_server, sub))
+            r.mesh = sub
+            replicas.append(r)
+        pool = cls(replicas, **kw)
+        pool._make_server = make_server
+        pool._spare_slices = list(slices[start:])
+        return pool
+
+    def new_sharded_handle(self) -> LocalReplica:
+        """Allocate a spare mesh slice and return a replica handle bound to
+        it — the autoscaler's scale-out factory for sharded pools. Raises
+        :class:`ServeError` when every slice is in use."""
+        import functools
+
+        if self._make_server is None:
+            raise ServeError("not a sharded pool (use ReplicaPool.sharded)")
+        with self._lock:
+            if not self._spare_slices:
+                raise ServeError("no spare mesh slices (fleet at capacity)")
+            sub = self._spare_slices.pop(0)
+        r = LocalReplica(functools.partial(self._make_server, sub))
+        r.mesh = sub
+        return r
+
+    @property
+    def spare_slices(self) -> int:
+        with self._lock:
+            return len(self._spare_slices)
 
     # -- lifecycle ------------------------------------------------------
     def start(self, wait_ready: bool = True) -> "ReplicaPool":
@@ -436,11 +520,107 @@ class ReplicaPool:
         obs.event("fleet.chaos_kill", replica=idx)
         self._members[idx].handle.kill()
 
+    # -- elastic membership (the kvstore/elastic.py join/leave protocol) -
+    def add_replica(self, handle, *, wait_ready: bool = True) -> int:
+        """Elastic scale-out: register ``handle`` as a new member and drive
+        it through quarantine → resync-to-committed-target → activation at
+        a generation boundary. ``wait_ready=False`` joins in the background
+        (the autoscaler's mode — bring-up includes XLA warmup and must not
+        block the control loop). Returns the member index."""
+        with self._lock:
+            idx = len(self._members)
+            m = _Member(idx, handle)
+            handle.idx = idx
+            self._members.append(m)
+        obs.inc("fleet.scale_out")
+        obs.event("fleet.replica_join", replica=idx)
+        if wait_ready:
+            self._bring_up(m)
+            if m.state != "ready":
+                raise ServeError(
+                    f"replica {idx} failed to join: {m.last_error}")
+        else:
+            threading.Thread(target=self._bring_up, args=(m,),
+                             daemon=True).start()
+        return idx
+
+    def remove_replica(self, idx: int, *, drain_timeout: float = 30.0
+                       ) -> bool:
+        """Elastic scale-in (the leave protocol): deactivate at a
+        generation boundary — ``ready_members()`` stops listing the member
+        the instant the state flips, so the Router routes nothing new to
+        it — then DRAIN its queued + in-flight work and stop the handle.
+        Zero requests are lost: anything racing the flip fails over through
+        the Router. Returns True when the drain finished in time."""
+        m = self._members[idx]
+        with self._lock:
+            if m.state in ("leaving", "removed", "stopped"):
+                return True
+            prev, m.state = m.state, "leaving"
+            self.generation += 1
+            gen = self.generation
+        obs.inc("fleet.scale_in")
+        obs.event("fleet.replica_leave", replica=idx, generation=gen)
+        self._gauge()
+        drained = True
+        if prev == "ready" and m.handle.alive() and m.addr:
+            try:
+                cli = self._client(m, timeout=max(drain_timeout,
+                                                  self.probe_timeout))
+                try:
+                    drained = cli.drain(stop=False)
+                finally:
+                    cli.close()
+            except Exception as e:  # noqa: BLE001 — leave is best-effort
+                m.last_error = f"drain: {type(e).__name__}: {e}"
+                drained = False
+        try:
+            m.handle.stop()
+        except Exception:  # noqa: BLE001 — it may already be dead
+            pass
+        m.state = "removed"
+        # a removed member's exported gauges must not linger in the
+        # Prometheus exposition as frozen last values
+        for g in ("queue_depth", "occupancy", "breaker_state"):
+            obs.metrics.remove(f"fleet.replica{idx}.{g}")
+        # return the mesh slice (sharded pools) for the next scale-out
+        sub = getattr(m.handle, "mesh", None)
+        if sub is not None and self._make_server is not None:
+            with self._lock:
+                self._spare_slices.append(sub)
+        self._gauge()
+        return drained
+
+    def _activate(self, m: _Member) -> bool:
+        """Activate at a generation boundary: ONE atomic flip under the
+        pool lock. Routing (ready_members) sees the member before or after
+        the boundary, never a half-joined state."""
+        with self._lock:
+            if m.state in ("leaving", "removed", "stopped"):
+                return False  # removed while joining: stay out
+            m.state = "ready"
+            self.generation += 1
+            gen = self.generation
+        obs.set_gauge("fleet.generation", gen)
+        obs.event("fleet.replica_activated", replica=m.idx,
+                  generation=gen, version=m.version)
+        return True
+
     def stats(self) -> dict:
+        members = {}
+        for m in self._members:
+            members[str(m.idx)] = {
+                "state": m.state, "version": m.version,
+                "restarts": m.restarts,
+                "queue_depth": m.queue_depth,
+                "occupancy": round(m.occupancy, 4)}
         return {"replicas": len(self._members),
                 "ready": len(self.ready_members()),
+                "generation": self.generation,
+                "spare_slices": self.spare_slices,
                 "target_version": self._target[3] if self._target else None,
-                "restarts": sum(m.restarts for m in self._members)}
+                "restarts": sum(m.restarts for m in self._members),
+                "members": members}
 
     # -- internals ------------------------------------------------------
     def _gauge(self) -> None:
@@ -451,8 +631,21 @@ class ReplicaPool:
         return ServeClient(m.addr[0], m.addr[1],
                            timeout=timeout or self.probe_timeout, retries=1)
 
+    def _transition(self, m: _Member, state: str) -> bool:
+        """Flip a member's state under the pool lock unless it has left
+        (leaving/removed/stopped are terminal for joiners): an unlocked
+        write here could overwrite a concurrent remove_replica's verdict
+        and activate — and route — a replica whose mesh slice was already
+        returned to the spare list."""
+        with self._lock:
+            if m.state in ("leaving", "removed", "stopped"):
+                return False
+            m.state = state
+            return True
+
     def _bring_up(self, m: _Member) -> None:
-        m.state = "starting"
+        if not self._transition(m, "starting"):
+            return  # scaled in while waiting for this bring-up
         try:
             m.addr = m.handle.start()
             m.incarnation += 1
@@ -472,8 +665,14 @@ class ReplicaPool:
             if not ready:
                 raise ServeError(
                     f"replica {m.idx} not ready within {self.ready_timeout}s")
-            self._resync_member(m)  # rejoin at the committed fleet version
-            m.state = "ready"
+            # QUARANTINE: fully up but not routed — the committed-target
+            # resync happens here, so activation can never introduce a
+            # stale generation (the elastic-plane rejoin invariant)
+            if not self._transition(m, "quarantined"):
+                return  # removed mid-bring-up; the leaver stopped the handle
+            self._resync_member(m)
+            if not self._activate(m):
+                return  # removed while quarantined
             obs.event("fleet.replica_ready", replica=m.idx,
                       incarnation=m.incarnation, version=m.version)
         except Exception as e:  # noqa: BLE001 — supervised: schedule retry
@@ -507,7 +706,8 @@ class ReplicaPool:
             cli.close()
 
     def _mark_dead(self, m: _Member) -> None:
-        m.state = "dead"
+        if not self._transition(m, "dead"):
+            return  # already leaving/removed: no death accounting
         obs.inc("fleet.replica_deaths")
         obs.event("fleet.replica_dead", replica=m.idx,
                   incarnation=m.incarnation)
@@ -515,7 +715,8 @@ class ReplicaPool:
         self._gauge()
 
     def _schedule_restart(self, m: _Member) -> None:
-        m.state = "dead"
+        if not self._transition(m, "dead"):
+            return  # a leaver's death needs no resurrection
         delay = capped_backoff(m.restarts, self.backoff_base,
                                self.backoff_cap)
         m.restart_at = time.monotonic() + delay
@@ -559,9 +760,45 @@ class ReplicaPool:
             if m.state == "ready" and not verdicts.get(m.idx, False):
                 self._mark_dead(m)
 
+    def _collect_member_stats(self) -> None:
+        """Pull each ready replica's batcher queue-depth/occupancy (one
+        metrics-free STATS RPC) into the member record AND the registry, so
+        the autoscaler and the Prometheus exposition read the same numbers
+        the operator's dashboard does — pool stats stop being
+        snapshot-on-demand only."""
+        for m in [m for m in self._members if m.state == "ready"]:
+            try:
+                cli = self._client(m)
+                try:
+                    st = cli.stats(include_metrics=False)
+                finally:
+                    cli.close()
+            except Exception:  # noqa: BLE001 — the probe's job, not ours
+                continue
+            b = st.get("batcher") or {}
+            m.queue_depth = int(b.get("queue_depth", 0) or 0)
+            m.occupancy = float(b.get("occupancy", 0.0) or 0.0)
+            # re-check state + set gauges under the pool lock: a
+            # remove_replica that ran during the stats RPC has already
+            # deleted this member's gauges, and an unguarded set here
+            # would resurrect them as permanent frozen values (removal
+            # flips the state under the same lock first)
+            with self._lock:
+                if m.state != "ready":
+                    continue
+                obs.set_gauge(f"fleet.replica{m.idx}.queue_depth",
+                              m.queue_depth)
+                obs.set_gauge(f"fleet.replica{m.idx}.occupancy",
+                              m.occupancy)
+        obs.set_gauge("fleet.replicas_total", sum(
+            1 for m in self._members
+            if m.state not in ("removed", "stopped")))
+        obs.set_gauge("fleet.generation", self.generation)
+
     def _supervise(self) -> None:
         while not self._stop_evt.wait(self.probe_interval):
             self._probe_ready_members()
+            self._collect_member_stats()
             for m in self._members:
                 if self._stop_evt.is_set():
                     return
@@ -916,16 +1153,30 @@ class Router:
         return 0  # routing is synchronous; queues live in the replicas
 
     def stats(self) -> dict:
+        _BR_STATE = {"closed": 0, "half_open": 1, "open": 2}
         replicas = {}
         for m in self._pool.members():
+            br = self._breaker(m).snapshot()
             replicas[str(m.idx)] = {
                 "state": m.state,
                 "addr": f"{m.addr[0]}:{m.addr[1]}" if m.addr else None,
                 "incarnation": m.incarnation, "restarts": m.restarts,
                 "version": m.version, "rpcs": m.rpcs, "errors": m.errors,
                 "sheds": m.sheds, "last_error": m.last_error,
-                "breaker": self._breaker(m).snapshot(),
+                "queue_depth": m.queue_depth,
+                "occupancy": round(m.occupancy, 4),
+                "breaker": br,
             }
+            # numeric breaker state per replica in the exposition
+            # (0 closed / 1 half-open / 2 open) — operators and the
+            # autoscaler read the router's own verdicts, not a copy.
+            # Checked + set under the POOL lock: remove_replica flips the
+            # state under that lock before deleting the member's gauges,
+            # so this can never resurrect a removed replica's gauge
+            with self._pool._lock:
+                if m.state not in ("leaving", "removed", "stopped"):
+                    obs.set_gauge(f"fleet.replica{m.idx}.breaker_state",
+                                  _BR_STATE.get(br["state"], 2))
         open_s = sum(r["breaker"]["open_seconds"]
                      for r in replicas.values())
         # mirrored into the registry so fleet-level SLO math works off the

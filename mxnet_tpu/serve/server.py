@@ -452,7 +452,19 @@ class ServeServer:
             self.abort_reload(token)
             self._reply(conn, OP_ABORT_RELOAD, struct.pack("<B", STATUS_OK))
         elif opcode == OP_STATS:
-            blob = json.dumps(self.stats(), default=str).encode("utf-8")
+            # optional json payload {"metrics": false} skips the registry
+            # snapshot — the fleet supervisor polls replica queue-depth/
+            # occupancy every probe interval and must not pay a full
+            # snapshot per poll (empty payload = legacy full stats)
+            include = True
+            if len(payload):
+                try:
+                    spec = json.loads(bytes(payload).decode("utf-8"))
+                    include = bool(spec.get("metrics", True))
+                except ValueError:
+                    pass
+            blob = json.dumps(self.stats(include_metrics=include),
+                              default=str).encode("utf-8")
             self._reply(conn, OP_STATS, struct.pack("<B", STATUS_OK) + blob)
         elif opcode == OP_TELEMETRY:
             try:
@@ -569,12 +581,22 @@ def main():  # pragma: no cover - CLI shim
     ap.add_argument("--warmup-shape", type=str, default=None,
                     help="comma-separated per-row feature shape to "
                          "pre-compile every bucket for, e.g. 3,224,224")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel shard the engine over the first "
+                         "N local devices (mesh axis 'tp'; sharding specs "
+                         "come from the model's rule table when serving "
+                         "in-process — the CLI path replicates params)")
     args = ap.parse_args()
 
     from . import load
 
+    engine_kw = {}
+    if args.tp:
+        from ..parallel import make_mesh
+
+        engine_kw["mesh"] = make_mesh({"tp": args.tp})
     engine = load(args.model, epoch=args.epoch,
-                  max_batch_size=args.max_batch_size)
+                  max_batch_size=args.max_batch_size, **engine_kw)
     if args.warmup_shape:
         feat = tuple(int(d) for d in args.warmup_shape.split(",") if d)
         engine.warmup(feat)

@@ -51,6 +51,12 @@ def pytest_configure(config):
         "heartbeats, generation-scoped barriers, PS durability, "
         "checkpointed rejoin (docs/ROBUSTNESS.md \"Elastic training\"); "
         "run via `pytest -m elastic` or `make elastic`")
+    config.addinivalue_line(
+        "markers", "serve_mesh: mesh-sharded serving + elastic autoscale "
+        "tests on the 8-virtual-device CPU mesh — tensor-parallel engines, "
+        "replica groups on mesh slices, quarantine→activate joins "
+        "(docs/SERVING.md \"Mesh-sharded serving\"); run via "
+        "`pytest -m serve_mesh` or `make serve_mesh`")
 
 
 @pytest.fixture(autouse=True)

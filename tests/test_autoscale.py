@@ -1,0 +1,303 @@
+"""Autoscaler tests (``pytest -m serve``) — docs/SERVING.md "Mesh-sharded
+serving and elastic autoscaling".
+
+The policy is exercised as a pure function: synthetic SLO windows drive
+``decide(signals, now)`` and the assertions are on the decision stream —
+no servers, no subprocesses, no sleeps. The controller tests drive
+``Autoscaler.tick`` with injected signal windows against a real (tiny)
+sharded pool, so the decision→join/leave wiring is covered end to end at
+tier-1 speed. ``SLOMonitor.burn_window`` (the windowed-burn signal) is
+covered on synthetic snapshots.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.obs.slo import SLOMonitor
+from mxnet_tpu.serve.autoscale import AutoscalePolicy
+
+pytestmark = pytest.mark.serve
+
+
+def _sig(ready=2, burn=0.0, queue_depth=0, occupancy=0.0, joining=0):
+    return {"ready": ready, "burn": burn, "queue_depth": queue_depth,
+            "occupancy": occupancy, "joining": joining}
+
+
+# ---------------------------------------------------------------------------
+# policy: scale-out triggers
+# ---------------------------------------------------------------------------
+
+def test_policy_scale_out_on_budget_burn():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, burn_out=1.0)
+    d = pol.decide(_sig(ready=2, burn=2.5), now=100.0)
+    assert d["action"] == "scale_out"
+    assert "burn" in d["reason"]
+
+
+def test_policy_scale_out_on_queue_depth_and_occupancy():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                          queue_out=8, occupancy_out=0.9, cooldown_s=0.0)
+    d = pol.decide(_sig(queue_depth=20), now=0.0)
+    assert d["action"] == "scale_out" and "queue" in d["reason"]
+    d = pol.decide(_sig(occupancy=0.97), now=10.0)
+    assert d["action"] == "scale_out" and "occupancy" in d["reason"]
+
+
+def test_policy_below_floor_is_immediate_even_in_cooldown():
+    pol = AutoscalePolicy(min_replicas=2, max_replicas=4, cooldown_s=60.0)
+    assert pol.decide(_sig(ready=2, burn=9.0), 0.0)["action"] == "scale_out"
+    # one second later, still in cooldown — but the fleet dropped below
+    # its floor: capacity restoration outranks the damper
+    d = pol.decide(_sig(ready=1, burn=0.0), 1.0)
+    assert d["action"] == "scale_out"
+    assert "floor" in d["reason"]
+    # joining capacity counts as ordered: no double-order
+    d = pol.decide(_sig(ready=1, joining=1), 2.0)
+    assert d["action"] == "hold"
+
+
+def test_policy_scale_out_cooldown_and_max_clamp():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3, cooldown_s=5.0)
+    assert pol.decide(_sig(ready=1, burn=9.0), 0.0)["action"] == "scale_out"
+    # sustained pressure inside the cooldown window: hold, don't flap
+    d = pol.decide(_sig(ready=2, burn=9.0), 2.0)
+    assert d["action"] == "hold" and "cooldown" in d["reason"]
+    # cooldown over: out again
+    assert pol.decide(_sig(ready=2, burn=9.0), 6.0)["action"] == "scale_out"
+    # at max: pressure can never push past the ceiling
+    d = pol.decide(_sig(ready=3, burn=9.0), 20.0)
+    assert d["action"] == "hold" and "max" in d["reason"]
+
+
+# ---------------------------------------------------------------------------
+# policy: scale-in hysteresis
+# ---------------------------------------------------------------------------
+
+def test_policy_scale_in_requires_consecutive_quiet_windows():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, hysteresis=3,
+                          scale_in_cooldown_s=0.0)
+    t = 0.0
+    for i in range(2):
+        d = pol.decide(_sig(ready=3), t + i)
+        assert d["action"] == "hold" and "hysteresis" in d["reason"]
+    assert pol.decide(_sig(ready=3), t + 2)["action"] == "scale_in"
+
+
+def test_policy_quiet_streak_resets_on_any_non_quiet_window():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, hysteresis=2,
+                          occupancy_in=0.3, scale_in_cooldown_s=0.0)
+    assert pol.decide(_sig(ready=3), 0.0)["action"] == "hold"
+    # a mid-band window (neither pressure nor quiet) resets the streak
+    assert pol.decide(_sig(ready=3, occupancy=0.5), 1.0)["action"] == "hold"
+    assert pol.decide(_sig(ready=3), 2.0)["action"] == "hold"  # 1/2 again
+    assert pol.decide(_sig(ready=3), 3.0)["action"] == "scale_in"
+
+
+def test_policy_scale_in_cooldown_and_floor():
+    pol = AutoscalePolicy(min_replicas=2, max_replicas=4, hysteresis=1,
+                          scale_in_cooldown_s=30.0, cooldown_s=0.0)
+    # burn spike at t=0 → out; quiet right after must NOT scale in until
+    # the scale-in cooldown since the last action has passed
+    assert pol.decide(_sig(ready=2, burn=9.0), 0.0)["action"] == "scale_out"
+    d = pol.decide(_sig(ready=4), 10.0)
+    assert d["action"] == "hold" and "cooldown" in d["reason"]
+    assert pol.decide(_sig(ready=4), 31.0)["action"] == "scale_in"
+    # at the floor, quiet forever never goes below min_replicas
+    for i in range(5):
+        d = pol.decide(_sig(ready=2), 100.0 + i)
+        assert d["action"] == "hold" and "floor" in d["reason"]
+
+
+def test_policy_no_flapping_on_oscillating_load():
+    """An oscillating signal (pressure, quiet, pressure, ...) must never
+    produce a scale-in: every non-quiet window resets the hysteresis."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=8, hysteresis=3,
+                          cooldown_s=2.0, scale_in_cooldown_s=5.0)
+    actions = []
+    for i in range(20):
+        s = _sig(ready=4, burn=3.0 if i % 2 == 0 else 0.0)
+        actions.append(pol.decide(s, float(i))["action"])
+    assert "scale_in" not in actions
+    assert actions.count("scale_out") >= 1
+
+
+def test_policy_undo_action_restores_cooldown():
+    """A decision the controller could not execute (factory failure, at
+    floor) must give its cooldown stamp back — pressure keeps firing."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, cooldown_s=5.0)
+    assert pol.decide(_sig(ready=1, burn=9.0), 0.0)["action"] == "scale_out"
+    assert pol.decide(_sig(ready=2, burn=9.0), 6.0)["action"] == "scale_out"
+    pol.undo_action()  # the 6.0 action never happened
+    # without the undo this would be "pressure in cooldown" until t=11
+    assert pol.decide(_sig(ready=2, burn=9.0), 7.0)["action"] == "scale_out"
+
+
+def test_signals_count_nonready_members_as_joining():
+    """A member whose bring-up failed (state 'dead' during restart
+    backoff) is ordered capacity: the controller must not pop another
+    mesh slice for the same pressure window."""
+    from mxnet_tpu.serve.autoscale import Autoscaler
+
+    class FakePool:
+        _make_server = None
+
+        def stats(self):
+            return {"ready": 1, "generation": 3, "members": {
+                "0": {"state": "ready", "queue_depth": 2,
+                      "occupancy": 0.4},
+                "1": {"state": "dead", "queue_depth": 0, "occupancy": 0.0},
+                "2": {"state": "quarantined", "queue_depth": 0,
+                      "occupancy": 0.0},
+                "3": {"state": "removed", "queue_depth": 0,
+                      "occupancy": 0.0}}}
+
+    scaler = Autoscaler(FakePool(), router=None, factory=lambda: None)
+    sig = scaler.signals()
+    assert sig["ready"] == 1
+    assert sig["joining"] == 2  # dead + quarantined; removed is gone
+    assert sig["queue_depth"] == 2
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(hysteresis=0)
+
+
+# ---------------------------------------------------------------------------
+# windowed error-budget burn (the autoscaler's SLO signal)
+# ---------------------------------------------------------------------------
+
+def _snap(completed=0, misses=0, fleet=True):
+    if fleet:
+        return {"counters": {"fleet.request_deadline_exceeded": misses},
+                "histograms": {"fleet.request_latency_seconds":
+                               {"count": completed}}}
+    return {"counters": {"serve.shed_deadline": misses},
+            "histograms": {"serve.latency_seconds": {"count": completed}}}
+
+
+def test_burn_window_is_windowed_not_cumulative():
+    mon = SLOMonitor(deadline_target=0.99)
+    # incident window: 90 completed, 10 missed → attainment 0.9, burn 10x
+    w = mon.burn_window(_snap(0, 0), _snap(90, 10))
+    assert w["completed"] == 90 and w["misses"] == 10
+    assert w["attainment"] == pytest.approx(0.9)
+    assert w["burn"] == pytest.approx(10.0)
+    # the NEXT window is clean — burn must read 0 even though the
+    # cumulative counters still carry the incident
+    w = mon.burn_window(_snap(90, 10), _snap(190, 10))
+    assert w["misses"] == 0 and w["burn"] == 0.0
+    # empty window = healthy (no traffic is not an SLO breach)
+    w = mon.burn_window(_snap(190, 10), _snap(190, 10))
+    assert w["burn"] == 0.0 and w["attainment"] == 1.0
+
+
+def test_burn_window_prefers_router_histogram_and_none_prev():
+    mon = SLOMonitor(deadline_target=0.99)
+    # replica-only snapshot falls back to serve.* counters
+    w = mon.burn_window(None, _snap(50, 50, fleet=False))
+    assert w["completed"] == 50 and w["misses"] == 50
+    # fleet histogram present → serve.* ignored (hedging double-counts)
+    cur = _snap(100, 1)
+    cur["counters"]["serve.shed_deadline"] = 999
+    cur["histograms"]["serve.latency_seconds"] = {"count": 5}
+    w = mon.burn_window(None, cur)
+    assert w["completed"] == 100 and w["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# controller wiring (real pool, injected signals)
+# ---------------------------------------------------------------------------
+
+def _tiny_pool_and_router():
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import serve
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.parallel.sharding import ShardingRules
+    from mxnet_tpu.serve.fleet import ReplicaPool, Router
+
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, no_bias=True, name="fc")
+    arg = {"fc_weight": rng.randn(16, 4).astype(np.float32)}
+    rules = ShardingRules([("fc_weight", P("tp"))])
+
+    def make_server(submesh):
+        eng = serve.InferenceEngine(net, arg, max_batch_size=4, lint="off",
+                                    mesh=submesh, rules=rules)
+        srv = serve.ServeServer(eng, port=0, max_linger_ms=0.0)
+        srv.start()
+        return srv
+
+    pool = ReplicaPool.sharded(make_server, groups=4, start=1,
+                               probe_interval=0.1, backoff_base=0.05)
+    pool.start()
+    return pool, Router(pool)
+
+
+@pytest.mark.serve_mesh
+def test_autoscaler_tick_scales_pool_out_and_in():
+    from mxnet_tpu.serve.autoscale import Autoscaler
+
+    pool, router = _tiny_pool_and_router()
+    try:
+        scaler = Autoscaler(pool, router, policy=AutoscalePolicy(
+            min_replicas=1, max_replicas=4, hysteresis=2,
+            cooldown_s=0.0, scale_in_cooldown_s=0.0), drain_timeout=10.0)
+        # pressure window → join (quarantine → activate at a boundary)
+        d = scaler.tick(signals=_sig(ready=1, burn=5.0))
+        assert d["action"] == "scale_out"
+        deadline = time.monotonic() + 60.0
+        while len(pool.ready_members()) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(pool.ready_members()) == 2
+        assert scaler.events[-1]["action"] == "scale_out"
+
+        # a second pressure window while a join is in flight holds
+        d = scaler.tick(signals=_sig(ready=1, burn=5.0, joining=1))
+        assert d["action"] == "hold" and "join in flight" in d["reason"]
+
+        # quiet windows × hysteresis → leave (drain-then-remove)
+        scaler.tick(signals=_sig(ready=2))
+        d = scaler.tick(signals=_sig(ready=2))
+        assert d["action"] == "scale_in"
+        scaler._leave_thread.join(timeout=30)
+        assert len(pool.ready_members()) == 1
+        assert pool.spare_slices == 3
+        assert [e["action"] for e in scaler.events] == \
+            ["scale_out", "scale_in"]
+    finally:
+        router.close(timeout=5)
+        pool.stop()
+
+
+@pytest.mark.serve_mesh
+def test_autoscaler_live_signals_read_pool_numbers():
+    """``Autoscaler.signals()`` assembles the window from the same member
+    records the supervisor exports — queue depth, occupancy, membership."""
+    from mxnet_tpu.serve.autoscale import Autoscaler
+
+    pool, router = _tiny_pool_and_router()
+    try:
+        scaler = Autoscaler(pool, router)
+        sig = scaler.signals()
+        assert sig["ready"] == 1 and sig["joining"] == 0
+        assert sig["burn"] == 0.0
+        # fake member pressure → the signal window sees it
+        pool._members[0].queue_depth = 42
+        pool._members[0].occupancy = 0.85
+        sig = scaler.signals()
+        assert sig["queue_depth"] == 42
+        assert sig["occupancy"] == pytest.approx(0.85)
+        d = scaler.tick(signals=None)  # live window, quiet burn → hold/out
+        assert d["action"] in ("hold", "scale_out")
+    finally:
+        router.close(timeout=5)
+        pool.stop()

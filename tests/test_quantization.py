@@ -118,8 +118,41 @@ def test_quantize_net_validation():
     net.initialize()
     with pytest.raises(ValueError):
         quantize_net(net, calib_mode="naive")  # no calib data
-    with pytest.raises(ValueError):
-        quantize_net(net, calib_mode="entropy")  # unsupported mode
+    # the recognized-but-unimplemented mode is a structured
+    # NotImplementedError naming the gap, not a generic ValueError
+    with pytest.raises(NotImplementedError, match="ROADMAP item 5"):
+        quantize_net(net, calib_mode="entropy")
+    with pytest.raises(ValueError, match="naive"):
+        quantize_net(net, calib_mode="bogus")
+
+
+def test_calib_mode_error_paths_unified():
+    """quantize_net and quantize_model raise the SAME structured errors:
+    entropy → NotImplementedError naming the supported modes + the tracked
+    gap; anything else → ValueError listing the supported modes (the two
+    entry points used to disagree on both the type and the list)."""
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.contrib.quantization import (SUPPORTED_CALIB_MODES,
+                                                quantize_model, quantize_net)
+
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg = {"fc_weight": np.ones((2, 3), np.float32),
+           "fc_bias": np.zeros(2, np.float32)}
+
+    for entry in (lambda m: quantize_net(net, calib_mode=m),
+                  lambda m: quantize_model(fc, arg, calib_mode=m)):
+        with pytest.raises(NotImplementedError) as ei:
+            entry("entropy")
+        for mode in SUPPORTED_CALIB_MODES:
+            assert mode in str(ei.value)
+        assert "ROADMAP item 5" in str(ei.value)
+        with pytest.raises(ValueError) as ei:
+            entry("minmax2")
+        for mode in SUPPORTED_CALIB_MODES:
+            assert mode in str(ei.value)
 
 
 def test_quantize_net_calib_none_and_checkpoint():

@@ -1,0 +1,395 @@
+"""Mesh-sharded serving + elastic membership tests (``pytest -m
+serve_mesh`` / ``make serve_mesh``) — docs/SERVING.md "Mesh-sharded
+serving and elastic autoscaling".
+
+Covers the tentpole contracts on the 8-virtual-device CPU mesh (conftest):
+
+1. ``parallel.mesh_slices`` — disjoint replica-group slices covering the
+   mesh;
+2. sharded ``InferenceEngine`` equivalence — a 1×1 mesh is *bitwise*
+   identical to the unsharded engine per bucket; tp>1 matches to float
+   tolerance, is bitwise-vs-its-own-``predict`` (the per-shard-config
+   contract), and the compiled-program bound stays TraceLinter-green;
+3. sharded hot reload — the new generation lands with the SAME shardings,
+   aval drift still rejected;
+4. ``ReplicaPool.sharded`` + Router — data-parallel replica groups on mesh
+   slices answer bitwise-identically to each other, and a killed group
+   fails over (graceful degradation is mesh-independent);
+5. elastic membership — quarantine → activate-at-a-generation-boundary
+   joins, drain-then-leave scale-in with ZERO requests lost under
+   concurrent traffic;
+6. fleet stats export — ``ReplicaPool.stats()`` members + per-replica
+   ``fleet.replica<i>.*`` gauges land in the Prometheus exposition, and a
+   removed replica's gauges are dropped.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu import serve
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.analysis.trace import TraceLinter
+from mxnet_tpu.parallel.sharding import ShardingRules
+from mxnet_tpu.serve import ServeClient, ServeError, ServeServer
+from mxnet_tpu.serve.fleet import FleetServer, ReplicaPool, Router
+
+pytestmark = [pytest.mark.serve, pytest.mark.serve_mesh]
+
+
+def _mlp():
+    rng = np.random.RandomState(7)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = sym.softmax(net, name="prob")
+    arg = {"fc1_weight": rng.randn(64, 32).astype(np.float32) * 0.1,
+           "fc1_bias": rng.randn(64).astype(np.float32) * 0.01,
+           "fc2_weight": rng.randn(8, 64).astype(np.float32) * 0.1,
+           "fc2_bias": np.zeros(8, np.float32)}
+    return net, arg
+
+
+def _rules():
+    # fc1 row-parallel (output dim), fc2 column-parallel (input dim) —
+    # the classic Megatron split: one all-reduce at fc2's output
+    return ShardingRules([("fc1_weight|fc1_bias", P("tp")),
+                          ("fc2_weight", P(None, "tp"))])
+
+
+def _sharded_server_factory(net, arg, engines=None):
+    def make_server(submesh):
+        eng = serve.InferenceEngine(net, arg, max_batch_size=8, lint="off",
+                                    mesh=submesh, rules=_rules())
+        eng.warmup((32,))
+        if engines is not None:
+            engines.append(eng)
+        srv = ServeServer(eng, port=0, max_linger_ms=0.0)
+        srv.start()
+        return srv
+    return make_server
+
+
+X = np.random.RandomState(3).rand(3, 32).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# 1. mesh slices
+# ---------------------------------------------------------------------------
+
+def test_mesh_slices_partition_the_mesh():
+    mesh = par.make_mesh({"dp": 4, "tp": 2})
+    slices = par.mesh_slices(mesh, "dp")
+    assert len(slices) == 4
+    assert all(s.axis_names == ("tp",) for s in slices)
+    seen = [d.id for s in slices for d in s.devices.flat]
+    assert sorted(seen) == sorted(d.id for d in mesh.devices.flat)
+    assert len(set(seen)) == 8  # disjoint cover
+
+    # pure-dp mesh → 1-device slices with a trivial tp axis
+    slices = par.mesh_slices(par.make_mesh({"dp": 8}), "dp")
+    assert len(slices) == 8
+    assert all(par.mesh_axes(s) == {"tp": 1} for s in slices)
+
+    # mesh without the axis is one slice: itself
+    tp_mesh = par.make_mesh({"tp": 8})
+    assert par.mesh_slices(tp_mesh, "dp") == [tp_mesh]
+
+
+# ---------------------------------------------------------------------------
+# 2. sharded-engine equivalence
+# ---------------------------------------------------------------------------
+
+def test_sharded_engine_1x1_mesh_bitwise_per_bucket():
+    """On a 1×1 mesh the sharded engine is the unsharded engine: the same
+    traced fn on the same device must produce BITWISE-identical outputs
+    for every bucket."""
+    net, arg = _mlp()
+    plain = serve.InferenceEngine(net, arg, max_batch_size=8, lint="off")
+    mesh1 = par.make_mesh({"tp": 1}, devices=[jax.devices()[0]])
+    sharded = serve.InferenceEngine(net, arg, max_batch_size=8, lint="off",
+                                    mesh=mesh1, rules=_rules())
+    rng = np.random.RandomState(0)
+    for n in (1, 2, 3, 5, 8):  # one request per bucket incl. padded sizes
+        x = rng.rand(n, 32).astype(np.float32)
+        a = plain.predict(x)
+        b = sharded.predict(x)
+        assert (a == b).all(), f"bucket for n={n} not bitwise"
+    assert sharded.num_programs == plain.num_programs
+
+
+def test_sharded_engine_tp_equivalence_and_program_bound():
+    net, arg = _mlp()
+    plain = serve.InferenceEngine(net, arg, max_batch_size=8, lint="off")
+    mesh = par.make_mesh({"tp": 4})
+    eng = serve.InferenceEngine(net, arg, max_batch_size=8, lint="off",
+                                mesh=mesh, rules=_rules())
+    st = eng.stats()
+    assert st["mesh"] == {"tp": 4} and st["mesh_devices"] == 4
+    assert st["sharded_params"] == 3  # fc1_weight, fc1_bias, fc2_weight
+
+    # outputs match the unsharded engine to float tolerance (XLA does not
+    # promise identical ulps across different programs)...
+    rng = np.random.RandomState(1)
+    for n in (1, 4, 7, 8):
+        x = rng.rand(n, 32).astype(np.float32)
+        np.testing.assert_allclose(plain.predict(x), eng.predict(x),
+                                   rtol=1e-5, atol=1e-6)
+    # ...and repeated serving is bitwise-vs-predict PER SHARD CONFIG
+    x = rng.rand(5, 32).astype(np.float32)
+    ref = eng.predict(x)
+    for _ in range(3):
+        assert (eng.predict(x) == ref).all()
+
+    # oversize request chunks through the top bucket, still correct
+    big = rng.rand(19, 32).astype(np.float32)
+    np.testing.assert_allclose(plain.predict(big), eng.predict(big),
+                               rtol=1e-5, atol=1e-6)
+
+    # the compiled-program bound holds under tp>1: one program per bucket,
+    # proven by the TraceLinter serve-retrace-churn rule (empty = proof)
+    assert eng.num_programs <= len(eng.buckets)
+    assert TraceLinter().check_serve_engine(eng) == []
+
+
+def test_sharded_engine_warmup_and_linter_green():
+    net, arg = _mlp()
+    mesh = par.make_mesh({"tp": 2})
+    eng = serve.InferenceEngine(net, arg, max_batch_size=8, lint="off",
+                                mesh=mesh, rules=_rules())
+    compiled = eng.warmup((32,))
+    assert compiled == len(eng.buckets)
+    # warmed buckets never recompile: ragged traffic reuses the programs
+    before = len(eng.compile_log)
+    rng = np.random.RandomState(2)
+    for n in (1, 3, 6, 8, 2, 5):
+        eng.predict(rng.rand(n, 32).astype(np.float32))
+    assert len(eng.compile_log) == before
+    assert TraceLinter().check_serve_engine(eng) == []
+
+
+def test_sharded_engine_reload_keeps_shardings():
+    net, arg = _mlp()
+    mesh = par.make_mesh({"tp": 2})
+    eng = serve.InferenceEngine(net, arg, max_batch_size=8, lint="off",
+                                mesh=mesh, rules=_rules())
+    x = X.copy()
+    out0 = eng.predict(x)
+    compiles0 = len(eng.compile_log)
+
+    arg2 = {k: np.asarray(v) * 2.0 for k, v in arg.items()}
+    staged = eng.prepare_reload(arg2)
+    assert eng.version == 0  # staged, not serving
+    assert eng.commit_reload() == staged == 1
+    out1 = eng.predict(x)
+    assert not np.allclose(out0, out1)
+    # reload is retrace-free even sharded: params are traced args, the
+    # new generation landed with the construction-time shardings
+    assert len(eng.compile_log) == compiles0
+    assert TraceLinter().check_serve_engine(eng) == []
+
+    # aval drift still rejected (would silently recompile every bucket)
+    bad = dict(arg2)
+    bad["fc1_weight"] = np.zeros((32, 64), np.float32)
+    with pytest.raises(ServeError, match="aval mismatch"):
+        eng.prepare_reload(bad)
+
+
+# ---------------------------------------------------------------------------
+# 3. replica groups on mesh slices behind the Router
+# ---------------------------------------------------------------------------
+
+def test_sharded_pool_replica_groups_bitwise_and_failover():
+    net, arg = _mlp()
+    engines = []
+    pool = ReplicaPool.sharded(_sharded_server_factory(net, arg, engines),
+                               groups=2, probe_interval=0.1,
+                               backoff_base=0.05, backoff_cap=0.5)
+    pool.start()
+    try:
+        assert len(pool.ready_members()) == 2
+        assert pool.spare_slices == 0
+        # each group's engine sits on its own disjoint 4-device slice
+        ids = [sorted(d.id for d in e.mesh.devices.flat) for e in engines]
+        assert not (set(ids[0]) & set(ids[1]))
+        assert len(ids[0]) == len(ids[1]) == 4
+
+        router = Router(pool)
+        front = FleetServer(router, port=0)
+        front.start()
+        cli = ServeClient("127.0.0.1", front.port)
+        try:
+            ref = engines[0].predict(X)  # per-shard-config oracle
+            outs = [np.asarray(cli.infer(X)) for _ in range(6)]
+            # round-robin hits both groups; same shard config ⇒ bitwise
+            assert all((o == ref).all() for o in outs)
+
+            # kill one replica group: traffic fails over, answers stay
+            # bitwise — graceful degradation is mesh-independent
+            pool.kill(0)
+            for _ in range(4):
+                assert (np.asarray(cli.infer(X, deadline_ms=5000)) ==
+                        ref).all()
+        finally:
+            cli.close()
+            front.stop()
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# 4. elastic membership: quarantine→activate joins, drain-then-leave
+# ---------------------------------------------------------------------------
+
+def test_elastic_join_activates_at_generation_boundary():
+    net, arg = _mlp()
+    pool = ReplicaPool.sharded(_sharded_server_factory(net, arg),
+                               groups=4, start=1, probe_interval=0.1)
+    pool.start()
+    try:
+        assert len(pool.ready_members()) == 1
+        assert pool.spare_slices == 3
+        gen0 = pool.generation
+        idx = pool.add_replica(pool.new_sharded_handle(), wait_ready=True)
+        assert pool._members[idx].state == "ready"
+        assert len(pool.ready_members()) == 2
+        assert pool.generation == gen0 + 1  # exactly one boundary
+        assert pool.spare_slices == 2
+        st = pool.stats()
+        assert st["members"][str(idx)]["state"] == "ready"
+        assert st["generation"] == pool.generation
+    finally:
+        pool.stop()
+
+
+def test_elastic_scale_in_drains_with_zero_lost():
+    """Scale-in under concurrent traffic: deactivation at the boundary
+    stops new routing, the drain finishes queued + in-flight work, and
+    every client request still gets a correct answer — zero lost."""
+    net, arg = _mlp()
+    pool = ReplicaPool.sharded(_sharded_server_factory(net, arg),
+                               groups=2, probe_interval=0.1)
+    pool.start()
+    router = Router(pool)
+    ref = None
+    errors = []
+    results = []
+    stop = threading.Event()
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                outs, _v = router.infer([X], deadline_ms=5000)
+                results.append(outs[0])
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+
+    try:
+        ref = np.asarray(router.infer([X])[0][0])
+        threads = [threading.Thread(target=traffic) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        victim = max(pool.ready_members(), key=lambda m: m.idx)
+        assert pool.remove_replica(victim.idx, drain_timeout=10.0)
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, f"lost {len(errors)} requests: {errors[:3]}"
+        assert len(pool.ready_members()) == 1
+        assert pool._members[victim.idx].state == "removed"
+        assert pool.spare_slices == 1  # the slice came back
+        assert all((np.asarray(r) == ref).all() for r in results)
+        # the freed slice is reusable: join again onto it
+        idx = pool.add_replica(pool.new_sharded_handle(), wait_ready=True)
+        assert len(pool.ready_members()) == 2
+        assert pool._members[idx].state == "ready"
+    finally:
+        stop.set()
+        router.close(timeout=5)
+        pool.stop()
+
+
+def test_remove_replica_idempotent_and_supervisor_leaves_leavers_alone():
+    net, arg = _mlp()
+    pool = ReplicaPool.sharded(_sharded_server_factory(net, arg),
+                               groups=2, probe_interval=0.05)
+    pool.start()
+    try:
+        assert pool.remove_replica(1, drain_timeout=5.0)
+        assert pool.remove_replica(1, drain_timeout=5.0)  # idempotent
+        gen = pool.generation
+        # the supervisor must NOT resurrect the leaver
+        time.sleep(0.4)
+        assert pool._members[1].state == "removed"
+        assert pool.generation == gen
+        assert len(pool.ready_members()) == 1
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. fleet stats → Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_fleet_stats_exported_to_prometheus():
+    from mxnet_tpu import obs
+    from mxnet_tpu.obs.export import to_prometheus
+
+    net, arg = _mlp()
+    obs.enable()
+    try:
+        pool = ReplicaPool.sharded(_sharded_server_factory(net, arg),
+                                   groups=2, probe_interval=0.1)
+        pool.start()
+        router = Router(pool)
+        try:
+            # traffic so the batcher has occupancy to report
+            for _ in range(4):
+                router.infer([X])
+            deadline = time.monotonic() + 5.0
+            snap = {}
+            while time.monotonic() < deadline:
+                snap = obs.metrics.snapshot()["gauges"]
+                if "fleet.replica0.queue_depth" in snap \
+                        and "fleet.replica1.queue_depth" in snap:
+                    break
+                time.sleep(0.1)
+            assert "fleet.replica0.queue_depth" in snap
+            assert "fleet.replica1.occupancy" in snap
+            assert snap.get("fleet.replicas_total") == 2
+            assert "fleet.generation" in snap
+            router.stats()  # mirrors per-replica breaker state
+            snap = obs.metrics.snapshot()["gauges"]
+            assert snap.get("fleet.replica0.breaker_state") == 0  # closed
+
+            # the SAME numbers ride the pool's stats dict (autoscaler view)
+            pst = pool.stats()
+            assert set(pst["members"]) == {"0", "1"}
+            for v in pst["members"].values():
+                assert {"state", "queue_depth", "occupancy"} <= set(v)
+
+            # and render in the text exposition
+            text = to_prometheus(obs.metrics.snapshot())
+            assert "mxnet_fleet_replica0_queue_depth" in text
+            assert "mxnet_fleet_generation" in text
+
+            # scale-in drops the removed replica's gauges
+            pool.remove_replica(1, drain_timeout=5.0)
+            gone = obs.metrics.snapshot()["gauges"]
+            assert "fleet.replica1.queue_depth" not in gone
+            assert "fleet.replica1.breaker_state" not in gone
+        finally:
+            router.close(timeout=5)
+            pool.stop()
+    finally:
+        obs.disable()
+        obs.reset()

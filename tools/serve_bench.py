@@ -18,6 +18,18 @@ rate, and the engine's compiled-program count (the bucketing bound), as a
 table and one JSON line (``--json``). ``bench.py`` imports ``run_bench``
 for the ``serve_qps`` / ``serve_p99_ms`` headline gains.
 
+**Scale mode** (``--scale``): closed-loop qps through dp∈{1,2,4}
+tensor-parallel replica groups on mesh slices (one FleetServer front over
+``ReplicaPool.sharded``) — the ROADMAP item 1 near-linear-scaling number,
+reported as ``scaling_dp4``.
+
+**Ramp mode** (``--ramp``): open-loop offered load climbs ``--qps-lo`` →
+``--qps-hi`` while the SLO Autoscaler (``serve/autoscale.py``) watches
+windowed error-budget burn + queue depth + occupancy and grows the fleet
+from one replica group toward ``--groups``. Reports every scale event with
+its timestamp and reason, shed/error counts, and per-third latency
+windows — measured autoscale-out, not a claim.
+
 **Chaos mode** (``--chaos``, ``make chaos-serve``): the same open-loop
 Poisson load is driven through a supervised replica fleet
 (``serve/fleet.py``: pool + failover router + one socket front), one
@@ -208,6 +220,281 @@ def run_bench(model="mlp", mode="closed", duration=5.0, clients=4, qps=200.0,
     if srv is not None:
         srv.stop()
     return out
+
+
+def _serve_rules(model):
+    """Tensor-parallel sharding specs for the bench models: the mlp gets
+    the classic Megatron split (fc1 row-parallel, fc2 column-parallel —
+    one all-reduce at the output); zoo models serve replicated-params
+    (still mesh-placed, still correct — TP specs are a model property)."""
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel.sharding import ShardingRules
+
+    if model == "mlp":
+        return ShardingRules([("fc1_weight|fc1_bias", P("tp")),
+                              ("fc2_weight", P(None, "tp"))])
+    return ShardingRules()
+
+
+def _sharded_fleet(model, mesh, *, start=None, max_batch_size=8,
+                   max_linger_ms=1.0, probe_interval=0.15):
+    """(pool, router, front, feat): data-parallel replica groups on the
+    mesh's dp slices, each serving a tensor-parallel engine, behind one
+    FleetServer front."""
+    from mxnet_tpu import serve
+    from mxnet_tpu.serve.fleet import FleetServer, ReplicaPool, Router
+
+    net, arg, aux, feat = _build_model(model)
+    rules = _serve_rules(model)
+
+    def make_server(submesh):
+        engine = serve.InferenceEngine(net, arg, aux,
+                                       max_batch_size=max_batch_size,
+                                       lint="off", mesh=submesh, rules=rules)
+        engine.warmup(feat)
+        srv = serve.ServeServer(engine, port=0,
+                                max_linger_ms=max_linger_ms)
+        srv.start()
+        return srv
+
+    pool = ReplicaPool.sharded(make_server, mesh=mesh, start=start,
+                               probe_interval=probe_interval,
+                               backoff_base=0.1, backoff_cap=1.0)
+    pool.start()
+    router = Router(pool)
+    front = FleetServer(router, port=0)
+    front.start()
+    return pool, router, front, feat
+
+
+def _closed_drive(addr, payload, clients, duration, deadline_ms=None):
+    """Closed-loop drive against an already-running endpoint; returns
+    (sorted latencies, shed, errors, wall_seconds)."""
+    from mxnet_tpu import serve
+
+    lock = threading.Lock()
+    lats: list = []
+    shed = [0]
+    errors = [0]
+    t_start = time.perf_counter()
+    stop_at = t_start + duration
+
+    def worker():
+        cli = serve.ServeClient(*addr)
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                cli.infer(payload, deadline_ms=deadline_ms)
+            except (serve.RequestRejected, serve.DeadlineExceeded):
+                with lock:
+                    shed[0] += 1
+                continue
+            except serve.ServeError:
+                with lock:
+                    errors[0] += 1
+                continue
+            with lock:
+                lats.append(time.perf_counter() - t0)
+        cli.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return sorted(lats), shed[0], errors[0], time.perf_counter() - t_start
+
+
+def run_scale_bench(model="mlp", groups_list=(1, 2, 4), tp=2, duration=4.0,
+                    clients=16, max_batch_size=8, request_rows=4,
+                    max_linger_ms=1.0):
+    """serve_qps vs data-parallel replica-group count on the local device
+    mesh — the ROADMAP item 1 headline: serve throughput must scale with
+    the mesh, not with hand-tuning. For each ``groups`` a ``dp×tp`` mesh
+    is sliced into tensor-parallel replica groups (one engine per slice,
+    params shard-resident), closed-loop load runs through one FleetServer
+    front, and the report carries qps per group count plus the
+    ``scaling_dp4`` ratio (dp4 qps over single-group qps)."""
+    import jax
+
+    from mxnet_tpu import parallel as par
+
+    rng = np.random.RandomState(1)
+    results = {}
+    feat = None
+    ndev = par.local_device_count()
+    for groups in groups_list:
+        need = int(groups) * int(tp)
+        if need > ndev:
+            results[str(groups)] = {"skipped": f"needs {need} devices, "
+                                               f"have {ndev}"}
+            continue
+        mesh = par.make_mesh({"dp": int(groups), "tp": int(tp)},
+                             devices=jax.devices()[:need])
+        pool, router, front, feat = _sharded_fleet(
+            model, mesh, max_batch_size=max_batch_size,
+            max_linger_ms=max_linger_ms)
+        try:
+            payload = rng.rand(request_rows, *feat).astype(np.float32)
+            lat, shed, errors, wall = _closed_drive(
+                ("127.0.0.1", front.port), payload, clients, duration)
+            results[str(groups)] = {
+                "qps": round(len(lat) * request_rows / wall, 2),
+                "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3)
+                if lat else None,
+                "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3)
+                if lat else None,
+                "completed": len(lat), "shed": shed, "errors": errors,
+                "ready_replicas": len(pool.ready_members()),
+            }
+        finally:
+            front.stop()
+            pool.stop()
+    host_cores = len(os.sched_getaffinity(0)) \
+        if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1)
+    out = {"mode": "scale", "model": model, "tp": tp, "clients": clients,
+           "duration_s": duration, "request_rows": request_rows,
+           "max_batch_size": max_batch_size, "host_cores": host_cores,
+           "groups": results}
+    base = results.get(str(groups_list[0]), {}).get("qps")
+    for g in groups_list[1:]:
+        q = results.get(str(g), {}).get("qps")
+        if base and q:
+            out[f"scaling_dp{g}"] = round(q / base, 2)
+    max_g = max(int(g) for g in groups_list)
+    if host_cores < max_g:
+        # virtual CPU devices SHARE the host's cores: a single replica's
+        # XLA matmuls already use them all, so compute-bound scaling is
+        # capped at host_cores× regardless of replica groups — the
+        # near-linear check needs >= groups physical cores (or real chips)
+        out["note"] = (f"host has {host_cores} cores for {max_g} replica "
+                       f"groups; compute-bound scaling caps at "
+                       f"~{host_cores}x — run on >= {max_g} cores or real "
+                       "devices for the near-linear check")
+    return out
+
+
+def run_ramp_bench(model="mlp", duration=14.0, qps_lo=30.0, qps_hi=450.0,
+                   groups=4, tp=2, start_replicas=1, max_batch_size=8,
+                   max_linger_ms=4.0, deadline_ms=2000.0, interval=0.4,
+                   request_rows=4):
+    """Open-loop load RAMP against an autoscaled sharded fleet: offered
+    qps climbs linearly lo→hi over the run while the Autoscaler watches
+    windowed burn + queue depth + occupancy and grows the pool from
+    ``start_replicas`` toward ``groups``. The report is the measured
+    proof the ISSUE asks for: scale-out events (with timestamps and
+    reasons), shed/error counts, and per-third latency windows —
+    autoscaling under a ramp must shed nothing."""
+    import jax
+
+    from mxnet_tpu import parallel as par, serve
+    from mxnet_tpu.serve.autoscale import Autoscaler, AutoscalePolicy
+
+    need = int(groups) * int(tp)
+    mesh = par.make_mesh({"dp": int(groups), "tp": int(tp)},
+                         devices=jax.devices()[:need])
+    pool, router, front, feat = _sharded_fleet(
+        model, mesh, start=start_replicas, max_batch_size=max_batch_size,
+        max_linger_ms=max_linger_ms)
+    policy = AutoscalePolicy(min_replicas=start_replicas,
+                             max_replicas=groups,
+                             queue_out=max(2.0, max_batch_size / 2),
+                             occupancy_out=0.85, burn_out=1.0,
+                             hysteresis=4, cooldown_s=2.0,
+                             scale_in_cooldown_s=10.0)
+    scaler = Autoscaler(pool, router, policy=policy,
+                        interval=interval).start()
+
+    rng = np.random.RandomState(1)
+    payload = rng.rand(request_rows, *feat).astype(np.float32)
+    addr = ("127.0.0.1", front.port)
+    lock = threading.Lock()
+    records: list = []  # (t_sent, outcome, latency)
+    pool_clients = [serve.ServeClient(*addr) for _ in range(8)]
+    free = list(range(len(pool_clients)))
+
+    def fire(idx, t_sent):
+        t0 = time.perf_counter()
+        try:
+            pool_clients[idx].infer(payload, deadline_ms=deadline_ms)
+            outcome = "ok"
+        except (serve.RequestRejected, serve.Draining):
+            outcome = "shed"
+        except serve.DeadlineExceeded:
+            outcome = "deadline"
+        except serve.ServeError:
+            outcome = "error"
+        with lock:
+            records.append((t_sent, outcome, time.perf_counter() - t0))
+            free.append(idx)
+
+    t_mono0 = time.monotonic()  # scaler events are monotonic-stamped
+    t_start = time.perf_counter()
+    inflight = []
+    ready_timeline = [(0.0, len(pool.ready_members()))]
+    while time.perf_counter() < t_start + duration:
+        t = time.perf_counter() - t_start
+        qps = qps_lo + (qps_hi - qps_lo) * min(t / duration, 1.0)
+        time.sleep(rng.exponential(1.0 / qps))
+        r = len(pool.ready_members())
+        if r != ready_timeline[-1][1]:
+            ready_timeline.append((round(t, 2), r))
+        with lock:
+            if free:
+                idx = free.pop()
+            else:
+                pool_clients.append(serve.ServeClient(*addr))
+                idx = len(pool_clients) - 1
+        th = threading.Thread(target=fire,
+                              args=(idx, time.perf_counter() - t_start))
+        th.start()
+        inflight.append(th)
+    for th in inflight:
+        th.join(timeout=30)
+    scaler.stop()
+    events = [{"t_s": round(e["t"] - t_mono0, 2), "action": e["action"],
+               "reason": e["reason"], "ready": e["ready"]}
+              for e in scaler.events]
+    fleet_stats = router.stats()
+    front.stop()
+    pool.stop()
+    for cli in pool_clients:
+        cli.close()
+
+    def window(name, lo, hi):
+        rows = [r for r in records if lo <= r[0] < hi]
+        lat = sorted(r[2] for r in rows if r[1] == "ok")
+        return {"window": name, "sent": len(rows), "ok": len(lat),
+                "shed": sum(1 for r in rows if r[1] in ("shed", "deadline")),
+                "errors": sum(1 for r in rows if r[1] == "error"),
+                "p50_ms": round(_percentile(lat, 0.5) * 1e3, 2)
+                if lat else None,
+                "p99_ms": round(_percentile(lat, 0.99) * 1e3, 2)
+                if lat else None}
+
+    third = duration / 3.0
+    shed_total = sum(1 for r in records if r[1] in ("shed", "deadline"))
+    return {
+        "mode": "ramp", "model": model, "tp": tp, "groups": groups,
+        "start_replicas": start_replicas, "duration_s": duration,
+        "qps_lo": qps_lo, "qps_hi": qps_hi, "deadline_ms": deadline_ms,
+        "sent": len(records),
+        "ok": sum(1 for r in records if r[1] == "ok"),
+        "shed": shed_total,
+        "errors": sum(1 for r in records if r[1] == "error"),
+        "scale_out_events": sum(1 for e in events
+                                if e["action"] == "scale_out"),
+        "scale_in_events": sum(1 for e in events
+                               if e["action"] == "scale_in"),
+        "events": events,
+        "ready_timeline": ready_timeline,
+        "final_generation": pool.generation,
+        "failovers": fleet_stats["failovers"],
+        "windows": [window("ramp_lo", 0.0, third),
+                    window("ramp_mid", third, 2 * third),
+                    window("ramp_hi", 2 * third, duration + 1e9)],
+    }
 
 
 def run_obs_overhead(model="mlp", duration=4.0, sample=0.1, clients=4,
@@ -425,6 +712,22 @@ def main(argv=None):
                          "JSON; warns when over the 5%% budget)")
     ap.add_argument("--sample", type=float, default=0.1,
                     help="head-sampling rate for --obs-overhead")
+    ap.add_argument("--scale", action="store_true",
+                    help="mesh-scaling bench: closed-loop qps through "
+                         "tensor-parallel replica groups on dp 1/2/4 mesh "
+                         "slices (always prints JSON)")
+    ap.add_argument("--ramp", action="store_true",
+                    help="open-loop load ramp against an SLO-autoscaled "
+                         "sharded fleet: offered qps climbs --qps-lo → "
+                         "--qps-hi over --duration; reports scale-out "
+                         "events + shed count (always prints JSON)")
+    ap.add_argument("--tp", type=int, default=2,
+                    help="devices per tensor-parallel replica group for "
+                         "--scale/--ramp")
+    ap.add_argument("--groups", type=int, default=4,
+                    help="max data-parallel replica groups for --ramp")
+    ap.add_argument("--qps-lo", type=float, default=30.0)
+    ap.add_argument("--qps-hi", type=float, default=450.0)
     args = ap.parse_args(argv)
 
     if not args.connect:
@@ -450,6 +753,24 @@ def main(argv=None):
             print(f"WARNING: obs_overhead_pct={res['obs_overhead_pct']} "
                   f"exceeds the {res['threshold_pct']}% budget at "
                   f"sample={args.sample}", file=sys.stderr)
+        return 0
+
+    if args.scale:
+        res = run_scale_bench(model=args.model, tp=args.tp,
+                              duration=args.duration,
+                              clients=max(args.clients, 16),
+                              max_batch_size=args.max_batch_size)
+        print(json.dumps(res, indent=1))
+        return 0
+
+    if args.ramp:
+        res = run_ramp_bench(model=args.model,
+                             duration=max(args.duration, 10.0),
+                             qps_lo=args.qps_lo, qps_hi=args.qps_hi,
+                             groups=args.groups, tp=args.tp,
+                             max_batch_size=args.max_batch_size,
+                             deadline_ms=args.deadline_ms or 2000.0)
+        print(json.dumps(res, indent=1))
         return 0
 
     if args.chaos:
