@@ -5,11 +5,23 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan
 
-# repo self-lint: framework invariants over mxnet_tpu/ source (fails on findings)
+# repo self-lint: framework invariants + the concurrency-correctness pass
+# (lock-order cycles, blocking-under-lock, CV/thread discipline, wire
+# protocol registry checks) over mxnet_tpu/ source — fails on any
+# unwaived finding (docs/ANALYSIS.md "Concurrency lint")
 lint:
 	$(PYTHON) tools/lint_repo.py mxnet_tpu
+
+# runtime concurrency sanitizer (docs/ANALYSIS.md "Concurrency lint"):
+# re-run the serve-fleet SIGKILL and elastic-rejoin chaos suites with the
+# instrumented locks on and the deadlock watchdog armed — every chaos run
+# doubles as a lock-order sanitizer run — then report sanitizer overhead
+tsan:
+	MXNET_TSAN=1 MXNET_TSAN_STALL_S=30 $(PYTHON) -m pytest tests/test_tsan.py tests/test_fleet.py -q -p no:cacheprovider
+	MXNET_TSAN=1 MXNET_TSAN_STALL_S=30 $(PYTHON) -m pytest tests/test_elastic.py -q -p no:cacheprovider
+	$(PYTHON) tools/tsan_bench.py
 
 # the static-analysis test subset (graph/trace/sharding/repo lint)
 lint-tests:

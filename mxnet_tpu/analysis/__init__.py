@@ -13,11 +13,16 @@ package is the TPU-native counterpart, catching both late crashes and
 - :class:`ShardingLinter` — PartitionSpec rule tables vs the mesh
   (unknown axes, indivisible dims, accidentally replicated large params);
 - repo self-lint (``tools/lint_repo.py``) — framework invariants over the
-  source tree itself.
+  source tree itself;
+- concurrency lint (``python -m mxnet_tpu.analysis concurrency``) —
+  lock-order cycles, blocking-under-lock, CV/thread discipline, and the
+  wire-protocol registry checks over the threaded serve/PS planes (the
+  runtime twin is ``mxnet_tpu.tsan``).
 
 User surfaces: ``Symbol.lint(...)``, ``bind(..., lint="warn"|"error")``,
 ``python -m mxnet_tpu.analysis graph.json``. See docs/ANALYSIS.md.
 """
+from . import concurrency  # noqa: F401  (the lock/protocol linter)
 from .findings import Finding, GraphAnalysisError, Report, Severity  # noqa: F401
 from .graph import GraphView, NodeInfo  # noqa: F401
 from .graph_passes import GraphLinter, LintContext, graph_pass, list_passes  # noqa: F401
@@ -28,5 +33,5 @@ __all__ = [
     "Finding", "GraphAnalysisError", "Report", "Severity",
     "GraphView", "NodeInfo",
     "GraphLinter", "LintContext", "graph_pass", "list_passes",
-    "ShardingLinter", "TraceLinter",
+    "ShardingLinter", "TraceLinter", "concurrency",
 ]

@@ -5,6 +5,8 @@ without binding or compiling it::
 
     python -m mxnet_tpu.analysis model-symbol.json --shape data=1,3,224,224
     python -m mxnet_tpu.analysis --self-lint            # repo invariants
+    python -m mxnet_tpu.analysis concurrency            # lock/protocol lint
+    python -m mxnet_tpu.analysis concurrency --list-rules
     python -m mxnet_tpu.analysis --list-rules
 
 Exit status: 0 clean, 1 findings at/above --fail-on (default: error).
@@ -30,6 +32,14 @@ def _parse_shapes(items):
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommand dispatch: `concurrency` is the lock/protocol linter
+    # (its own flags; see concurrency.main)
+    if argv and argv[0] == "concurrency":
+        from .concurrency import main as concurrency_main
+
+        return concurrency_main(list(argv[1:]))
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.analysis",
         description="Pre-flight lint for Symbol graphs (no compilation).")

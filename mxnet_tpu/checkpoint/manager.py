@@ -177,6 +177,10 @@ class CheckpointManager:
             self._queue.put(None)
             self._queue.join()
             self._writer.join(timeout=10)
+            if self._writer.is_alive():
+                obs.inc("checkpoint.writer_thread_leaked")
+                obs.event("checkpoint.writer_thread_leaked",
+                          join_timeout_s=10)
             self._writer = None
         self.restore_signal_handlers()
 

@@ -51,8 +51,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from .. import obs
+from .. import obs, tsan
 from ..base import MXNetError, get_env
+from ..wire import PS_WIRE
 
 __all__ = ["ElasticState", "ElasticWorkerSession", "Heartbeater", "JoinInfo",
            "ElasticError", "StaleMemberError", "elastic_enabled",
@@ -60,11 +61,14 @@ __all__ = ["ElasticState", "ElasticWorkerSession", "Heartbeater", "JoinInfo",
            "install_server_state", "ELASTIC_OP_NAMES"]
 
 # Opcodes 16-20: the elastic-training range on the PS wire (0-9 = kvstore,
-# 32-42 = serve — same length-prefixed framing, see ps_server.py docstring).
-OP_HB, OP_JOIN, OP_REDUCE, OP_EPOCH, OP_LEAVE = 16, 17, 18, 19, 20
+# 32-42 = serve — same framing). Codes come from the declarative registry
+# (mxnet_tpu/wire.py), where collisions are impossible by construction.
+OP_HB, OP_JOIN, OP_REDUCE, OP_EPOCH, OP_LEAVE = PS_WIRE.codes(
+    "heartbeat", "join", "reduce", "epoch", "leave")
 
-ELASTIC_OP_NAMES = {OP_HB: "heartbeat", OP_JOIN: "join", OP_REDUCE: "reduce",
-                    OP_EPOCH: "epoch", OP_LEAVE: "leave"}
+ELASTIC_OP_NAMES = {code: name for code, name in PS_WIRE.names().items()
+                    if code in (OP_HB, OP_JOIN, OP_REDUCE, OP_EPOCH,
+                                OP_LEAVE)}
 
 # OP_EPOCH payload carrying this epoch value means "block until my
 # quarantined membership is activated" (the rejoin wait).
@@ -138,7 +142,7 @@ class ElasticState:
 
     def __init__(self, hb_interval: Optional[float] = None,
                  miss_k: Optional[int] = None, on_change=None):
-        self.cv = threading.Condition()
+        self.cv = tsan.condition("elastic.state.cv")
         self.members: Dict[int, _Member] = {}
         self.generation = 0
         self.epoch = 0  # the epoch currently in progress fleet-wide
@@ -256,6 +260,10 @@ class ElasticState:
         self._stop.set()
         with self.cv:
             self.cv.notify_all()
+        if self._monitor is not None:
+            self._monitor.join(timeout=2)
+            if self._monitor.is_alive():
+                obs.inc("elastic.monitor_thread_leaked")
 
     def _liveness_loop(self):
         window = self.hb_interval * self.miss_k
@@ -561,15 +569,13 @@ def install_server_state(server, state) -> None:
     the seq-dedup table (so replayed pushes from before the crash still
     dedupe — exactly-once survives the restart), and the membership
     generation (monotonic across incarnations)."""
-    import threading as _threading
-
     from ..checkpoint.state import _unflatten_opt_state, restore_optimizer
 
     for name, arr in state.arrays.items():
         if name.startswith("w:"):
             key = name[2:]
             server._weights[key] = np.array(arr)
-            server._locks[key] = _threading.Lock()
+            server._locks[key] = tsan.lock("ps.key")
     with server._seq_lock:
         for cid, key, seq in state.meta.get("seq", []):
             server._record_seq(int(cid), key, int(seq))
@@ -631,7 +637,7 @@ class PushWAL:
         import os
 
         self._dir = directory
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("elastic.wal")
         self._file = None
         self._fsync = bool(get_env("MXNET_PS_WAL_FSYNC", True, bool))
         self._os = os
@@ -829,6 +835,10 @@ class Heartbeater:
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # the socket may be mid-backoff against a dead server; the
+            # daemon thread dies with the process, but the leak is counted
+            obs.inc("elastic.heartbeat_thread_leaked")
 
 
 class ElasticWorkerSession:

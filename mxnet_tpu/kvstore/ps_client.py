@@ -108,8 +108,14 @@ class PSClient:
                               self._retry_max_interval)
 
     def _rpc(self, opcode, key="", payload=b"", timeout=None, retries=None):
+        # the connection lock spans the send->recv roundtrip on purpose:
+        # the PS wire is strictly serial per socket, and push() must pair
+        # seq allocation with its send atomically; socket timeouts bound
+        # every hold (hence the blocking-under-lock waivers here and at
+        # the other _rpc_locked call sites)
         with self._lock:
-            return self._rpc_locked(opcode, key, payload, timeout, retries)
+            return self._rpc_locked(opcode, key, payload,  # lint: disable=blocking-call-under-lock
+                                    timeout, retries)
 
     def _rpc_locked(self, opcode, key="", payload=b"", timeout=None,
                     retries=None):
@@ -193,7 +199,7 @@ class PSClient:
         with self._lock:
             self._push_seq += 1
             seq = self._push_seq
-            _, _, reply = self._rpc_locked(
+            _, _, reply = self._rpc_locked(  # lint: disable=blocking-call-under-lock
                 OP_PUSH_SEQ, key,
                 struct.pack("<QQ", self._client_id, seq) + payload)
         if bytes(reply[:1]) != b"\x00":
@@ -213,7 +219,7 @@ class PSClient:
         with self._lock:
             self._push_seq += 1
             seq = self._push_seq
-            _, _, payload = self._rpc_locked(
+            _, _, payload = self._rpc_locked(  # lint: disable=blocking-call-under-lock
                 OP_PUSH_SPARSE_SEQ, key,
                 struct.pack("<QQ", self._client_id, seq)
                 + _pack_sparse(indices, rows))
@@ -262,7 +268,7 @@ class PSClient:
             epoch = self._barrier_epoch
             self._barrier_epoch += 1
             payload = struct.pack("<QQ", self._client_id, epoch)
-            _, _, reply = self._rpc_locked(OP_BARRIER, payload=payload,
+            _, _, reply = self._rpc_locked(OP_BARRIER, payload=payload,  # lint: disable=blocking-call-under-lock
                                            timeout=timeout)
         if bytes(reply[:1]) == b"\x01":
             # the server names exactly which ranks are missing (and their

@@ -44,23 +44,26 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from .. import obs
+from .. import obs, tsan
 from ..obs import context as obs_context
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE, get_env
+from ..wire import PS_WIRE
 from . import elastic as elastic_mod
 from .elastic import (ELASTIC_OP_NAMES, OP_EPOCH, OP_HB, OP_JOIN, OP_LEAVE,
                       OP_REDUCE, ST_ERROR, ST_OK, ST_QUARANTINED, ST_STALE)
 
+# opcode constants come from the declarative registry (mxnet_tpu/wire.py):
+# codes, names, and exactly-once metadata live in ONE table that the
+# protocol linter cross-checks against this module's dispatch
 (OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
- OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ, OP_PUSH_SPARSE_SEQ) = range(10)
+ OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ, OP_PUSH_SPARSE_SEQ) = \
+    PS_WIRE.codes("init", "push", "pull", "set_opt", "barrier", "shutdown",
+                  "push_sparse", "pull_sparse", "push_seq",
+                  "push_sparse_seq")
 
-# opcode → canonical name (telemetry labels; mxnet_tpu.chaos.rpc mirrors it)
-OP_NAMES = {OP_INIT: "init", OP_PUSH: "push", OP_PULL: "pull",
-            OP_SET_OPT: "set_opt", OP_BARRIER: "barrier",
-            OP_SHUTDOWN: "shutdown", OP_PUSH_SPARSE: "push_sparse",
-            OP_PULL_SPARSE: "pull_sparse", OP_PUSH_SEQ: "push_seq",
-            OP_PUSH_SPARSE_SEQ: "push_sparse_seq"}
-OP_NAMES.update(ELASTIC_OP_NAMES)
+# opcode → canonical name (telemetry labels; mxnet_tpu.chaos.rpc mirrors
+# it) — includes the elastic range, which this server also dispatches
+OP_NAMES = dict(PS_WIRE.names())
 
 # one rule table fault-injects both planes (the serve/server.py idiom)
 from ..chaos import rpc as _chaos_rpc  # noqa: E402
@@ -178,7 +181,7 @@ class PSServer:
         self._updater = None
         self._optimizer = None
         self._opt_spec: Optional[str] = None
-        self._global_lock = threading.Lock()
+        self._global_lock = tsan.lock("ps.global")
         from collections import OrderedDict
 
         self._num_workers = num_workers
@@ -187,7 +190,7 @@ class PSServer:
         # thread. The config is captured now for the lazy construction.
         self._elastic: Optional[elastic_mod.ElasticState] = None
         self._elastic_cfg = (hb_interval, miss_k)
-        self._elastic_lock = threading.Lock()
+        self._elastic_lock = tsan.lock("ps.elastic")
         # durable-state plane (docs/ROBUSTNESS.md "Elastic training"):
         # periodic snapshots through checkpoint/'s atomic+CRC manager, warm
         # restart from the newest valid one
@@ -199,7 +202,7 @@ class PSServer:
         self._snap_mgr = None
         self._snap_step = 0
         self._snap_thread: Optional[threading.Thread] = None
-        self._snap_lock = threading.Lock()
+        self._snap_lock = tsan.lock("ps.snapshot")
         self._wal: Optional[elastic_mod.PushWAL] = None
         # (client_id, key) -> last applied seq; LRU-bounded so client churn
         # (each process draws a fresh id) cannot grow the map forever.
@@ -210,7 +213,7 @@ class PSServer:
         # snapshot can copy ONE key's entries under that key's lock
         # instead of rescanning the 64k-entry LRU per key
         self._seq_by_key: Dict[str, Dict[int, int]] = {}
-        self._seq_lock = threading.Lock()
+        self._seq_lock = tsan.lock("ps.seq")
         self._barrier_timeout = barrier_timeout  # straggler window (seconds)
         self._barrier_count = 0
         self._barrier_gen = 0
@@ -220,7 +223,7 @@ class PSServer:
         # retransmit that arrives after the round completed.
         self._barrier_arrived: Dict = {}
         self._barrier_released: "OrderedDict" = OrderedDict()
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = tsan.condition("ps.barrier")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -229,6 +232,7 @@ class PSServer:
         self._stop = threading.Event()
         self._threads = []
         self._conns = []
+        self._warm_thread: Optional[threading.Thread] = None
         if self._snapshot_dir:
             self._init_durability()
 
@@ -331,7 +335,7 @@ class PSServer:
             with self._global_lock:
                 if key not in self._weights:
                     self._weights[key] = _unpack_array(memoryview(payload))
-                    self._locks[key] = threading.Lock()
+                    self._locks[key] = tsan.lock("ps.key")
             return
         if kind == 3:  # optimizer spec (OP_SET_OPT), in order vs pushes
             spec = bytes(payload).decode("ascii", errors="replace")
@@ -402,6 +406,24 @@ class PSServer:
                 c.close()
             except OSError:
                 pass
+        # reap worker threads: handlers exit once their sockets are severed,
+        # the snapshot loop and warm thread see _stop / finish their bounded
+        # work. Leaks are counted, not waited out — stop() must be prompt.
+        me = threading.current_thread()  # OP_SHUTDOWN stops from a handler
+        reap = [t for t in self._threads if t is not me]
+        if self._snap_thread is not None and self._snap_thread is not me:
+            reap.append(self._snap_thread)
+        if self._warm_thread is not None:
+            reap.append(self._warm_thread)
+        deadline = time.monotonic() + 1.0  # ONE budget for the whole reap
+        leaked = 0
+        for t in reap:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                leaked += 1
+        if leaked:
+            obs.inc("kvstore.server.threads_leaked", leaked)
+            obs.event("kvstore.server.threads_leaked", count=leaked)
 
     # ------------------------------------------------------------------
     def _handle(self, conn: socket.socket):
@@ -462,7 +484,7 @@ class PSServer:
                 created = key not in self._weights
                 if created:
                     self._weights[key] = arr
-                    self._locks[key] = threading.Lock()
+                    self._locks[key] = tsan.lock("ps.key")
             if created and self._wal is not None:
                 # key birth rides the WAL (kind 2, one small fsynced
                 # append) so a warm restart never sees a push for a key it
@@ -855,7 +877,11 @@ class PSServer:
             except Exception:
                 pass  # warmup is best-effort
 
-        threading.Thread(target=_warm, daemon=True).start()
+        # tracked (not fire-and-forget): stop() joins it with a bounded
+        # timeout so a mid-compile warmup can't outlive the server silently
+        self._warm_thread = threading.Thread(target=_warm, daemon=True,
+                                             name="mxtpu-ps-warm")
+        self._warm_thread.start()
 
     def _apply(self, key, grad, weight_np):
         """Run the fused optimizer update on host numpy via the framework ops

@@ -336,6 +336,15 @@ class Autoscaler:
         self._stop_evt.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                obs.inc("autoscale.thread_leaked")
+                obs.event("autoscale.thread_leaked", which="control")
             self._thread = None
         if self._leave_thread is not None:
             self._leave_thread.join(timeout=self.drain_timeout + 5)
+            if self._leave_thread.is_alive():
+                # the drain outlived its budget: the replica will still be
+                # stopped by remove_replica's own timeout, but the leak is
+                # an operator signal
+                obs.inc("autoscale.thread_leaked")
+                obs.event("autoscale.thread_leaked", which="leave")

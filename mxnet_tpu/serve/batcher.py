@@ -34,7 +34,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .. import obs
+from .. import obs, tsan
 from ..obs import context as obs_context
 from .engine import DeadlineExceeded, Draining, RequestRejected, ServeError
 
@@ -122,7 +122,7 @@ class DynamicBatcher:
         self.max_queue = int(max_queue)
         self._lanes: List[List[_Request]] = [[] for _ in range(lanes)]
         self._qsize = 0
-        self._cv = threading.Condition()
+        self._cv = tsan.condition("serve.batcher.cv")
         self._running = True
         self._draining = False
         self._inflight = 0
@@ -142,6 +142,10 @@ class DynamicBatcher:
         # vs 2 queue-overflow" is a diagnosis — and the fleet STATS endpoint
         # surfaces this per replica
         self.shed_by_reason = {"queue_full": 0, "deadline": 0, "draining": 0}
+        # None until close(); then True iff the worker thread exited within
+        # the join budget (a leaked batcher thread pins the engine and its
+        # device buffers — the fleet's stop accounting reads this)
+        self.stopped_clean: Optional[bool] = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="mxnet-tpu-serve-batcher")
         self._thread.start()
@@ -372,6 +376,7 @@ class DynamicBatcher:
     def stats(self) -> dict:
         return {"submitted": self.submitted, "completed": self.completed,
                 "shed": self.shed, "shed_by_reason": dict(self.shed_by_reason),
+                "stopped_clean": self.stopped_clean,
                 "queue_depth": self._qsize,
                 "occupancy": round(self._occ_ewma, 4),
                 "batches_executed": self.exec_batches,
@@ -401,3 +406,11 @@ class DynamicBatcher:
             self._running = False
             self._cv.notify_all()
         self._thread.join(timeout=5)
+        # a timed-out join silently LEAKS the worker (join returns None
+        # either way): surface it as a structured warning + flag instead
+        # of pretending the shutdown was clean
+        self.stopped_clean = not self._thread.is_alive()
+        if not self.stopped_clean:
+            obs.inc("serve.batcher_thread_leaked")
+            obs.event("serve.batcher_thread_leaked", join_timeout_s=5,
+                      inflight=self._inflight, queue_depth=self._qsize)

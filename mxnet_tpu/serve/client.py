@@ -105,6 +105,11 @@ class ServeClient:
         retries = self._retries if retries is None else max(1, int(retries))
         last_err = None
         opname = SERVE_OP_NAMES.get(opcode, str(opcode))
+        # the wire is strictly serial per socket: the connection lock MUST
+        # span the whole send->recv roundtrip (and the backoff between
+        # attempts — a peer RPC could not use the half-open socket anyway);
+        # socket timeouts bound every hold. Hence the blocking-under-lock
+        # waivers below.
         with self._lock:
             for attempt in range(retries):
                 if deadline is not None and time.monotonic() >= deadline:
@@ -128,12 +133,12 @@ class ServeClient:
                         key = obs_context.inject_key(
                             "", obs_context.current())
                         dup = chaos_rpc.on_send(opcode, "")
-                        _send_msg(self._sock, opcode, key, payload)
+                        _send_msg(self._sock, opcode, key, payload)  # lint: disable=blocking-call-under-lock
                         if dup == "dup":
-                            _send_msg(self._sock, opcode, key, payload)
-                        reply = _recv_msg(self._sock)
+                            _send_msg(self._sock, opcode, key, payload)  # lint: disable=blocking-call-under-lock
+                        reply = _recv_msg(self._sock)  # lint: disable=blocking-call-under-lock
                         if dup == "dup":
-                            reply = _recv_msg(self._sock)
+                            reply = _recv_msg(self._sock)  # lint: disable=blocking-call-under-lock
                         chaos_rpc.on_reply(opcode, "")
                     if rec:
                         obs.observe(f"serve.client.{opname}_seconds",
@@ -155,7 +160,7 @@ class ServeClient:
                         obs.observe("serve.client.backoff_seconds", delay)
                         obs.trace.event("serve.client.retry", op=opname,
                                         attempt=attempt, error=str(e))
-                    time.sleep(delay)
+                    time.sleep(delay)  # lint: disable=blocking-call-under-lock
         obs.inc("serve.client.failures")
         raise ServeError(
             f"serve rpc {opname} failed after {retries} attempts: "

@@ -79,7 +79,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import obs
+from .. import obs, tsan
 from ..base import capped_backoff
 from ..chaos.proc import kill_point
 from .batcher import Future
@@ -116,7 +116,7 @@ class CircuitBreaker:
         # was being turned away from this replica
         self.open_seconds = 0.0
         self._not_closed_since: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("serve.breaker")
 
     @property
     def state(self) -> str:
@@ -371,7 +371,7 @@ class ReplicaPool:
         self.ready_timeout = float(ready_timeout)
         self.probe_timeout = float(probe_timeout)
         self._target: Optional[Tuple[str, Optional[int], str, int]] = None
-        self._lock = threading.RLock()
+        self._lock = tsan.rlock("serve.pool")
         self._pool_id = int.from_bytes(os.urandom(8), "little")
         self._resync_seq = 0
         self._stop_evt = threading.Event()
@@ -463,6 +463,12 @@ class ReplicaPool:
             t.start()
         for t in threads:
             t.join(timeout=self.ready_timeout)
+        if any(t.is_alive() for t in threads):
+            # a wedged bring-up is not fatal (the member stays un-ready and
+            # the supervisor owns it) but must not pass silently
+            obs.inc("fleet.bringup_threads_stuck")
+            obs.event("fleet.bringup_stuck",
+                      stuck=sum(t.is_alive() for t in threads))
         self._stop_evt.clear()
         self._supervisor = threading.Thread(target=self._supervise,
                                             daemon=True,
@@ -478,6 +484,9 @@ class ReplicaPool:
         self._stop_evt.set()
         if self._supervisor is not None:
             self._supervisor.join(timeout=5)
+            if self._supervisor.is_alive():
+                obs.inc("fleet.supervisor_thread_leaked")
+                obs.event("fleet.supervisor_thread_leaked", join_timeout_s=5)
         for m in self._members:
             try:
                 m.handle.stop()
@@ -540,8 +549,11 @@ class ReplicaPool:
                 raise ServeError(
                     f"replica {idx} failed to join: {m.last_error}")
         else:
+            # supervised fire-and-forget: the member's state machine (the
+            # pool lock + leaving/removed terminal states) owns this
+            # bring-up; remove_replica reaps a member whose thread wedged
             threading.Thread(target=self._bring_up, args=(m,),
-                             daemon=True).start()
+                             daemon=True).start()  # lint: disable=thread-fire-and-forget
         return idx
 
     def remove_replica(self, idx: int, *, drain_timeout: float = 30.0
@@ -755,6 +767,12 @@ class ReplicaPool:
             t.start()
         for t in threads:
             t.join(timeout=self.probe_timeout + 1.0)
+        if any(t.is_alive() for t in threads):
+            # a probe thread still stuck past its socket timeout means a
+            # wedged replica: its member gets no verdict below and is
+            # marked dead — count the stuck probe so a watchdog dump has
+            # a metric to correlate with
+            obs.inc("fleet.probe_threads_stuck")
         for m in ready:
             # no verdict (probe thread still stuck) = not answering = dead
             if m.state == "ready" and not verdicts.get(m.idx, False):
@@ -812,8 +830,11 @@ class ReplicaPool:
                 elif (m.state == "dead" and not m.restarting
                         and time.monotonic() >= m.restart_at):
                     m.restarting = True
+                    # supervised: m.restarting gates re-spawn and _restart
+                    # clears it in a finally — the supervisor loop is the
+                    # join point for this state machine
                     threading.Thread(target=self._restart, args=(m,),
-                                     daemon=True).start()
+                                     daemon=True).start()  # lint: disable=thread-fire-and-forget
             self._gauge()
 
 
@@ -829,7 +850,7 @@ class _ConnPool:
         self._addr = addr
         self._timeout = timeout
         self._free: List[ServeClient] = []
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("serve.connpool")
 
     def acquire(self) -> ServeClient:
         with self._lock:
@@ -869,16 +890,16 @@ class Router:
                                                 breaker_cooldown)
                           for m in pool.members()}
         self._pools: dict = {}
-        self._lock = threading.Lock()
+        self._lock = tsan.lock("serve.router")
         self._rr = 0
         # intake gate: cleared only for the phase-two flip window
         self._gate = threading.Event()
         self._gate.set()
-        self._cv = threading.Condition()
+        self._cv = tsan.condition("serve.router.inflight")
         self._inflight = 0
         tgt = pool.target
         self._fleet_version = tgt[3] if tgt else 0
-        self._reload_lock = threading.Lock()
+        self._reload_lock = tsan.lock("serve.router.reload")
         self._controller_id = int.from_bytes(os.urandom(8), "little")
         self._reload_epoch = 0
         self._commit_hook: Optional[Callable] = None  # test injection point
@@ -992,7 +1013,10 @@ class Router:
                 q.put((member,
                        self._attempt(member, arrays, deadline, priority)))
 
-        threading.Thread(target=run, args=(primary,), daemon=True).start()
+        # deliberately unjoined racer: the reply comes back over q and
+        # INFER is read-only — the losing attempt is wasted capacity, not
+        # an orphaned mutation; a wedged racer dies with its socket timeout
+        threading.Thread(target=run, args=(primary,), daemon=True).start()  # lint: disable=thread-fire-and-forget
         try:
             member, (ok, val) = q.get(timeout=self.hedge_ms / 1e3)
             if ok:
@@ -1008,7 +1032,7 @@ class Router:
         obs.inc("fleet.hedges")
         obs.event("fleet.hedge", primary=primary.idx,
                   secondary=secondary.idx)
-        threading.Thread(target=run, args=(secondary,), daemon=True).start()
+        threading.Thread(target=run, args=(secondary,), daemon=True).start()  # lint: disable=thread-fire-and-forget
         budget = self._client_timeout if deadline is None \
             else max(deadline - time.monotonic(), 0.0)
         end = time.monotonic() + budget + 0.5
@@ -1253,8 +1277,11 @@ class Router:
                 self._prepare_all(members, token, path, epoch, prefix,
                                   new_version)
                 kill_point("fleet:post_prepare")
+                # holding _reload_lock across the flip drain is the POINT
+                # (reloads are serialized fleet-wide) and the drain is
+                # bounded by flip_timeout
                 self._commit_all(members, token, path, epoch, prefix,
-                                 new_version)
+                                 new_version)  # lint: disable=blocking-call-under-lock
             obs.inc("fleet.reloads")
             obs.event("fleet.reload", version=new_version)
             return new_version

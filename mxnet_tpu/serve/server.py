@@ -70,7 +70,7 @@ from typing import Optional
 
 import numpy as np
 
-from .. import obs
+from .. import obs, tsan
 from ..obs import context as obs_context
 from ..chaos import rpc as _chaos_rpc
 from ..chaos.proc import kill_point
@@ -87,19 +87,20 @@ __all__ = ["ServeServer", "OP_INFER", "OP_HEALTH", "OP_READY", "OP_RELOAD",
            "STATUS_DEADLINE", "STATUS_BAD_REQUEST", "STATUS_DRAINING",
            "STATUS_INTERNAL", "STATUS_NOT_READY"]
 
-# serve opcode range: disjoint from the kvstore PS opcodes (0–9), so the
-# chaos rule table (chaos/rpc.py OP_NAMES) can address both planes
+# serve opcode range: disjoint from the kvstore PS opcodes by
+# construction — both planes declare their rows in mxnet_tpu/wire.py and
+# the registry raises on any collision at import; the protocol linter
+# cross-checks this module's dispatch against the same table
+from ..wire import SERVE_WIRE
+
 (OP_INFER, OP_HEALTH, OP_READY, OP_RELOAD, OP_STATS, OP_DRAIN,
  OP_SHUTDOWN, OP_PREPARE_RELOAD, OP_COMMIT_RELOAD,
- OP_ABORT_RELOAD, OP_TELEMETRY) = range(32, 43)
+ OP_ABORT_RELOAD, OP_TELEMETRY) = SERVE_WIRE.codes(
+    "infer", "health", "ready", "reload", "stats", "drain",
+    "serve_shutdown", "prepare_reload", "commit_reload", "abort_reload",
+    "telemetry")
 
-SERVE_OP_NAMES = {OP_INFER: "infer", OP_HEALTH: "health", OP_READY: "ready",
-                  OP_RELOAD: "reload", OP_STATS: "stats", OP_DRAIN: "drain",
-                  OP_SHUTDOWN: "serve_shutdown",
-                  OP_PREPARE_RELOAD: "prepare_reload",
-                  OP_COMMIT_RELOAD: "commit_reload",
-                  OP_ABORT_RELOAD: "abort_reload",
-                  OP_TELEMETRY: "telemetry"}
+SERVE_OP_NAMES = dict(SERVE_WIRE.names())
 
 # single source of truth for chaos rule names: MXNET_CHAOS_RPC rules match
 # these ops the moment the serving plane is imported (the client imports
@@ -146,7 +147,7 @@ class ServeServer:
         # two-phase reload bookkeeping: staged token + committed-token LRU
         # (the kvstore exactly-once idiom — a retried COMMIT re-acks, never
         # re-flips); one lock serializes prepare/commit/abort
-        self._reload_lock = threading.Lock()
+        self._reload_lock = tsan.lock("serve.server.reload")
         self._staged_token = None
         from collections import OrderedDict
         self._committed_tokens: "OrderedDict" = OrderedDict()
@@ -156,7 +157,7 @@ class ServeServer:
         # of draining again (the kvstore (client_id, seq) idiom; without
         # this, every retry would silently lose the first drain's spans)
         self._telemetry_tokens: "OrderedDict" = OrderedDict()
-        self._telemetry_lock = threading.Lock()
+        self._telemetry_lock = tsan.lock("serve.server.telemetry")
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -205,6 +206,18 @@ class ServeServer:
                 c.close()
             except OSError:
                 pass
+        # reap handler threads (they exit once their sockets are severed);
+        # OP_SHUTDOWN stops from inside a handler — never join yourself
+        me = threading.current_thread()
+        deadline = time.monotonic() + 1.0  # ONE budget for the whole reap
+        leaked = 0
+        for t in [t for t in self._threads if t is not me]:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                leaked += 1
+        if leaked:
+            obs.inc("serve.handler_threads_leaked", leaked)
+            obs.event("serve.handler_threads_leaked", count=leaked)
         if self._batcher is not None:
             self._batcher.close(timeout=5)
 

@@ -1,0 +1,467 @@
+"""ConcurrencyLinter (mxnet_tpu/analysis/concurrency.py): every rule fires
+on a minimal fixture and stays quiet on the fixed idiom, the wire-protocol
+pass cross-checks the declarative registries against the handler ASTs, and
+the repo's own serve/PS planes lint clean (no unwaived findings)."""
+import os
+
+import pytest
+
+from mxnet_tpu.analysis.concurrency import (RULES, check_handlers,
+                                            check_registry, lint_paths,
+                                            lint_source, unwaived)
+from mxnet_tpu.wire import (OpSpec, PS_WIRE, SERVE_WIRE, WireRegistry,
+                            check_disjoint)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule_id for f in findings if not f.details.get("waived")}
+
+
+# ---------------------------------------------------------------------------
+# lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_direct():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.a = threading.Lock()\n"
+        "        self.b = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self.a:\n"
+        "            with self.b:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self.b:\n"
+        "            with self.a:\n"
+        "                pass\n")
+    found = [f for f in lint_source(src)
+             if f.rule_id == "lock-order-cycle"]
+    assert len(found) == 1
+    assert set(found[0].details["locks"]) == {"S.a", "S.b"}
+
+
+def test_lock_order_cycle_interprocedural():
+    # f holds a and reaches b only through a helper call — the seeded
+    # inversion the static half must catch without runtime help
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.a = threading.Lock()\n"
+        "        self.b = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self.a:\n"
+        "            self._h()\n"
+        "    def _h(self):\n"
+        "        with self.b:\n"
+        "            pass\n"
+        "    def g(self):\n"
+        "        with self.b:\n"
+        "            with self.a:\n"
+        "                pass\n")
+    assert "lock-order-cycle" in _rules(lint_source(src))
+
+
+def test_consistent_order_is_clean():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.a = threading.Lock()\n"
+        "        self.b = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self.a:\n"
+        "            with self.b:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self.a:\n"
+        "            with self.b:\n"
+        "                pass\n")
+    assert not _rules(lint_source(src))
+
+
+def test_tsan_factories_are_recognized():
+    src = (
+        "from mxnet_tpu import tsan\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.a = tsan.lock('a')\n"
+        "        self.b = tsan.lock('b')\n"
+        "    def f(self):\n"
+        "        with self.a:\n"
+        "            with self.b:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self.b:\n"
+        "            with self.a:\n"
+        "                pass\n")
+    assert "lock-order-cycle" in _rules(lint_source(src))
+
+
+# ---------------------------------------------------------------------------
+# blocking under a held lock
+# ---------------------------------------------------------------------------
+
+def test_blocked_socket_read_under_lock():
+    # the seeded blocked-under-lock socket read (acceptance fixture)
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lk = threading.Lock()\n"
+        "    def f(self, sock):\n"
+        "        with self.lk:\n"
+        "            return sock.recv(1024)\n")
+    found = [f for f in lint_source(src)
+             if f.rule_id == "blocking-call-under-lock"]
+    assert len(found) == 1 and "S.lk" in found[0].details["held"]
+
+
+def test_blocking_variants_under_lock():
+    src = (
+        "import threading, time, os\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lk = threading.Lock()\n"
+        "    def f(self, fd, arr):\n"
+        "        with self.lk:\n"
+        "            time.sleep(0.1)\n"
+        "            os.fsync(fd)\n"
+        "            arr.block_until_ready()\n"
+        "    def ok(self, fd):\n"
+        "        time.sleep(0.1)\n"
+        "        os.fsync(fd)\n")
+    found = [f for f in lint_source(src)
+             if f.rule_id == "blocking-call-under-lock"]
+    assert len(found) == 3
+
+
+def test_blocking_propagates_through_same_class_call():
+    src = (
+        "import threading, time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lk = threading.Lock()\n"
+        "    def slow(self):\n"
+        "        time.sleep(1)\n"
+        "    def f(self):\n"
+        "        with self.lk:\n"
+        "            self.slow()\n")
+    found = [f for f in lint_source(src)
+             if f.rule_id == "blocking-call-under-lock"]
+    assert len(found) == 1 and found[0].details.get("via") == "slow"
+
+
+def test_wait_on_foreign_lock_flagged_own_cv_exempt():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.cv = threading.Condition()\n"
+        "        self.other = threading.Lock()\n"
+        "    def ok(self):\n"
+        "        with self.cv:\n"
+        "            while True:\n"
+        "                self.cv.wait(1.0)\n"
+        "    def bad(self):\n"
+        "        with self.other:\n"
+        "            with self.cv:\n"
+        "                while True:\n"
+        "                    self.cv.wait(1.0)\n")
+    found = [f for f in lint_source(src)
+             if f.rule_id == "blocking-call-under-lock"]
+    # only the wait holding S.other across it fires
+    assert len(found) == 1 and "S.other" in found[0].details["held"]
+
+
+# ---------------------------------------------------------------------------
+# CV / thread discipline
+# ---------------------------------------------------------------------------
+
+def test_cv_wait_without_recheck_loop():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.cv = threading.Condition()\n"
+        "    def bad(self):\n"
+        "        with self.cv:\n"
+        "            if True:\n"
+        "                self.cv.wait(1.0)\n"
+        "    def good(self):\n"
+        "        with self.cv:\n"
+        "            while True:\n"
+        "                self.cv.wait(1.0)\n")
+    found = [f for f in lint_source(src)
+             if f.rule_id == "cv-wait-no-recheck"]
+    assert len(found) == 1 and ":8" in found[0].location
+
+
+def test_unbounded_waits():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.cv = threading.Condition()\n"
+        "        self.evt = threading.Event()\n"
+        "        self.t = threading.Thread(target=print)\n"
+        "    def f(self):\n"
+        "        with self.cv:\n"
+        "            while True:\n"
+        "                self.cv.wait()\n"
+        "    def g(self):\n"
+        "        self.evt.wait()\n"
+        "    def h(self):\n"
+        "        self.t.join()\n")
+    found = [f for f in lint_source(src) if f.rule_id == "unbounded-wait"]
+    assert len(found) == 3
+
+
+def test_join_timeout_unchecked_and_checked():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.t = threading.Thread(target=print)\n"
+        "    def bad(self):\n"
+        "        self.t.join(timeout=5)\n"
+        "    def good(self):\n"
+        "        self.t.join(timeout=5)\n"
+        "        if self.t.is_alive():\n"
+        "            pass\n"
+        "    def strings(self):\n"
+        "        import os\n"
+        "        return ','.join(['a']) + os.path.join('a', 'b')\n")
+    found = [f for f in lint_source(src)
+             if f.rule_id == "join-timeout-unchecked"]
+    assert len(found) == 1 and ":6" in found[0].location
+
+
+def test_join_rules_cover_append_built_thread_lists():
+    # the common collection shape: threads appended one by one, joined in
+    # a loop — the join rules must resolve the loop var as thread-ish
+    src = (
+        "import threading\n"
+        "def bad():\n"
+        "    ts = []\n"
+        "    for i in range(3):\n"
+        "        w = threading.Thread(target=print)\n"
+        "        w.start()\n"
+        "        ts.append(w)\n"
+        "    for th in ts:\n"
+        "        th.join(timeout=5)\n")
+    assert "join-timeout-unchecked" in _rules(lint_source(src))
+    checked = src + "    assert not any(th.is_alive() for th in ts)\n"
+    assert "join-timeout-unchecked" not in _rules(lint_source(checked))
+
+
+def test_thread_fire_and_forget():
+    src = (
+        "import threading\n"
+        "def fire():\n"
+        "    threading.Thread(target=print, daemon=True).start()\n"
+        "def kept():\n"
+        "    t = threading.Thread(target=print)\n"
+        "    t.start()\n"
+        "    t.join(timeout=1)\n"
+        "    assert not t.is_alive()\n")
+    assert _rules(lint_source(src)) == {"thread-fire-and-forget"}
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+def test_waiver_downgrades_to_info():
+    src = (
+        "import threading, time\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self.lk = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self.lk:\n"
+        "            time.sleep(0.1)  # lint: disable=blocking-call-under-lock\n")
+    findings = lint_source(src)
+    assert not _rules(findings)  # nothing unwaived
+    waived = [f for f in findings if f.details.get("waived")]
+    assert len(waived) == 1 and waived[0].severity == "info"
+
+
+# ---------------------------------------------------------------------------
+# wire-protocol registry + handler checks
+# ---------------------------------------------------------------------------
+
+def test_registry_collision_impossible():
+    with pytest.raises(ValueError, match="collision"):
+        WireRegistry("x", ("m.py", "loop", "dispatch"),
+                     [OpSpec("a", 1, "x"), OpSpec("b", 1, "x")])
+    with pytest.raises(ValueError, match="collision"):
+        check_disjoint(
+            WireRegistry("x", ("m.py", "l", "d"), [OpSpec("a", 7, "x")]),
+            WireRegistry("y", ("n.py", "l", "d"), [OpSpec("b", 7, "y")]))
+
+
+def test_registry_mutating_needs_dedup():
+    reg = WireRegistry("x", ("m.py", "loop", "dispatch"),
+                       [OpSpec("evil", 1, "x", mutating=True)])
+    assert _rules(check_registry(reg)) == {"mutating-op-no-dedup"}
+    ok = WireRegistry("x", ("m.py", "loop", "dispatch"),
+                      [OpSpec("fine", 1, "x", mutating=True,
+                              dedup="idempotent")])
+    assert not check_registry(ok)
+
+
+_HANDLER_SRC = (
+    "class H:\n"
+    "    def _loop(self, conn):\n"
+    "        opcode, key, payload = recv(conn)\n"
+    "        key, wctx = obs_context.extract_key(key)\n"
+    "        self._dispatch(conn, opcode, key, payload)\n"
+    "    def _dispatch(self, conn, opcode, key, payload):\n"
+    "        if opcode == OP_PING:\n"
+    "            send(conn, OP_PING, b'')\n"
+    "        elif opcode == OP_APPLY:\n"
+    "            if self._applied_seq.get(key):\n"
+    "                return\n"
+    "            self._wal.append(payload)\n"
+    "            send(conn, OP_APPLY, b'')\n")
+
+
+def _reg(ops):
+    return WireRegistry("x", ("synthetic.py", "_loop", "_dispatch"), ops)
+
+
+def test_protocol_clean_handler():
+    reg = _reg([OpSpec("ping", 1, "x"),
+                OpSpec("apply", 2, "x", mutating=True, dedup="seq",
+                       wal=True)])
+    assert not _rules(check_handlers(reg, _HANDLER_SRC, "synthetic.py"))
+
+
+def test_protocol_missing_and_unknown_handler():
+    reg = _reg([OpSpec("ping", 1, "x"), OpSpec("orphan", 3, "x")])
+    rules = _rules(check_handlers(reg, _HANDLER_SRC, "synthetic.py"))
+    # orphan has no branch; OP_APPLY's branch is not registered
+    assert rules == {"opcode-missing-handler", "opcode-unknown-handler"}
+
+
+def test_protocol_duplicate_handler():
+    src = _HANDLER_SRC + (
+        "        elif opcode == OP_PING:\n"
+        "            send(conn, OP_PING, b'')\n")
+    reg = _reg([OpSpec("ping", 1, "x"),
+                OpSpec("apply", 2, "x", mutating=True, dedup="seq",
+                       wal=True)])
+    assert "opcode-duplicate-handler" in _rules(
+        check_handlers(reg, src, "synthetic.py"))
+
+
+def test_protocol_dedup_machinery_missing():
+    # apply declares seq+wal but this handler never touches either
+    src = (
+        "class H:\n"
+        "    def _loop(self, conn):\n"
+        "        opcode, key, payload = recv(conn)\n"
+        "        key, wctx = obs_context.extract_key(key)\n"
+        "        self._dispatch(conn, opcode, key, payload)\n"
+        "    def _dispatch(self, conn, opcode, key, payload):\n"
+        "        if opcode == OP_PING:\n"
+        "            send(conn, OP_PING, b'')\n"
+        "        elif opcode == OP_APPLY:\n"
+        "            send(conn, OP_APPLY, b'')\n")
+    reg = _reg([OpSpec("ping", 1, "x"),
+                OpSpec("apply", 2, "x", mutating=True, dedup="seq",
+                       wal=True)])
+    assert "dedup-machinery-missing" in _rules(
+        check_handlers(reg, src, "synthetic.py"))
+
+
+def test_protocol_trace_propagation_missing():
+    src = _HANDLER_SRC.replace(
+        "        key, wctx = obs_context.extract_key(key)\n", "")
+    reg = _reg([OpSpec("ping", 1, "x")])
+    assert "trace-propagation-missing" in _rules(
+        check_handlers(reg, src, "synthetic.py"))
+
+
+def test_real_registries_and_handlers_clean():
+    # the live serve + PS planes satisfy their own declared protocol
+    for reg, rel in ((PS_WIRE, PS_WIRE.handler_path),
+                     (SERVE_WIRE, SERVE_WIRE.handler_path)):
+        path = os.path.join(REPO, rel)
+        with open(path, encoding="utf-8") as fh:
+            findings = check_handlers(reg, fh.read(), path)
+        assert not _rules(findings), [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# repo-wide
+# ---------------------------------------------------------------------------
+
+def test_rule_catalog_has_at_least_six_kinds():
+    assert len(RULES) >= 6
+
+
+def test_fixture_coverage_spans_six_rule_kinds():
+    # the unit fixtures above exercise ≥6 distinct rule kinds end to end
+    fired = set()
+    for src in (
+            "import threading\nclass S:\n"
+            "    def __init__(self):\n"
+            "        self.a = threading.Lock()\n"
+            "        self.b = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self.a:\n"
+            "            with self.b: pass\n"
+            "    def g(self):\n"
+            "        with self.b:\n"
+            "            with self.a: pass\n",
+            "import threading, time\nclass S:\n"
+            "    def __init__(self):\n"
+            "        self.lk = threading.Lock()\n"
+            "    def f(self):\n"
+            "        with self.lk:\n"
+            "            time.sleep(1)\n",
+            "import threading\nclass S:\n"
+            "    def __init__(self):\n"
+            "        self.cv = threading.Condition()\n"
+            "    def f(self):\n"
+            "        with self.cv:\n"
+            "            self.cv.wait()\n",
+            "import threading\nclass S:\n"
+            "    def __init__(self):\n"
+            "        self.t = threading.Thread(target=print)\n"
+            "    def f(self):\n"
+            "        self.t.join(timeout=1)\n",
+            "import threading\n"
+            "def f():\n"
+            "    threading.Thread(target=print).start()\n"):
+        fired |= _rules(lint_source(src))
+    reg = WireRegistry("x", ("m.py", "loop", "dispatch"),
+                       [OpSpec("evil", 1, "x", mutating=True)])
+    fired |= _rules(check_registry(reg))
+    assert len(fired) >= 6, fired
+
+
+def test_repo_serve_and_ps_planes_lint_clean():
+    report = lint_paths([os.path.join(REPO, "mxnet_tpu")])
+    bad = unwaived(report)
+    assert not bad, "\n".join(f.format() for f in bad)
+    # the documented waivers are visible (reported, not hidden)
+    assert any(f.details.get("waived") for f in report)
+
+
+def test_cli_subcommand(capsys):
+    from mxnet_tpu.analysis.cli import main
+
+    assert main(["concurrency", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-order-cycle" in out and "opcode-missing-handler" in out
+
+    assert main(["concurrency", os.path.join(REPO, "mxnet_tpu")]) == 0

@@ -1,19 +1,51 @@
 #!/usr/bin/env python
 """Repo self-lint: framework invariants over mxnet_tpu/ source.
 
-Thin launcher for ``mxnet_tpu.analysis.repo_lint`` (rules: every registered
-op declares ndarray_inputs, no host calls on tensor inputs in op bodies, no
-bare ``except:``). Exit status 1 on findings::
+Runs BOTH source-level linters and merges their reports:
+
+- ``mxnet_tpu.analysis.repo_lint`` — op purity invariants (ndarray_inputs
+  declared, no host calls on tensor inputs, no bare ``except:``);
+- ``mxnet_tpu.analysis.concurrency`` — lock-order cycles, blocking calls
+  under locks, CV/thread discipline, wire-protocol registry checks
+  (docs/ANALYSIS.md "Concurrency lint").
+
+Exit status 1 on any unwaived finding (waived concurrency findings are
+reported at info severity but never fail)::
 
     python tools/lint_repo.py               # lint mxnet_tpu/
     python tools/lint_repo.py path/to/file.py --json
 """
+import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from mxnet_tpu.analysis.repo_lint import main  # noqa: E402
+from mxnet_tpu.analysis import concurrency, repo_lint  # noqa: E402
+from mxnet_tpu.analysis.findings import Report  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="mxnet_tpu repo self-lint (framework + concurrency "
+                    "invariants)")
+    ap.add_argument("paths", nargs="*", default=["mxnet_tpu"],
+                    help="files or directories to lint (default: mxnet_tpu)")
+    ap.add_argument("--exclude", action="append", default=[],
+                    help="path substring to skip")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    args = ap.parse_args(argv)
+    paths = args.paths or ["mxnet_tpu"]
+    report = Report()
+    report.extend(repo_lint.lint_paths(paths, exclude=args.exclude))
+    report.extend(concurrency.lint_paths(paths, exclude=args.exclude))
+    print(report.to_json() if args.json else report.format())
+    bad = concurrency.unwaived(report)
+    if len(bad) != len(report):
+        print(f"{len(bad)} unwaived finding(s), "
+              f"{len(report) - len(bad)} waived")
+    return 1 if bad else 0
+
 
 if __name__ == "__main__":
     sys.exit(main())
