@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof
 
 # repo self-lint: framework invariants + the concurrency-correctness pass
 # (lock-order cycles, blocking-under-lock, CV/thread discipline, wire
@@ -73,6 +73,15 @@ perf:
 obs:
 	$(PYTHON) -m pytest tests/ -q -m obs -p no:cacheprovider
 	$(PYTHON) tools/serve_bench.py --obs-overhead --duration 4
+
+# black-box plane (docs/OBSERVABILITY.md "Tail sampling" / "Continuous
+# profiling" / "Flight recorder"): tail-based retention policy units +
+# cross-process verdict plumbing, the sampling profiler, crash flight
+# recorder + DUMP opcode, torn-tail tolerance; then the measured cost of
+# leaving tail buffering + 67 Hz profiling on (<5% gated in bench.py)
+prof:
+	$(PYTHON) -m pytest tests/ -q -m blackbox -p no:cacheprovider
+	$(PYTHON) tools/serve_bench.py --prof-overhead --duration 4
 
 # perf-regression dossier (docs/PERFORMANCE.md "Perf-regression dossier"):
 # the device-plane perf gates (memory steady state, regression

@@ -561,6 +561,32 @@ def bench_obs_overhead(platform):
     return res
 
 
+def bench_prof_overhead(platform):
+    """Black-box-plane overhead (docs/OBSERVABILITY.md "Tail sampling" /
+    "Continuous profiling"): interleaved off/on serve segments against
+    one endpoint (best of each side, the elastic-bench methodology) —
+    everything off vs tail-mode trace buffering (every request records
+    pending, verdict at root close) + the 67 Hz continuous profiler —
+    and the qps delta as ``prof_overhead_pct``, asserted under the 5%
+    budget. The number that justifies recording EVERY request and
+    keeping only the interesting."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    model = os.environ.get("BENCH_SERVE_MODEL",
+                           "resnet18_v1" if platform == "tpu" else "mlp")
+    duration = float(os.environ.get("BENCH_PROF_DURATION",
+                                    6 if platform == "tpu" else 5))
+    res = serve_bench.run_prof_overhead(model=model, duration=duration)
+    assert res["ok"], (
+        f"prof_overhead_pct={res['prof_overhead_pct']} >= "
+        f"{res['threshold_pct']}% at {res['profiler_hz']} Hz — the "
+        f"black-box plane is too expensive to leave on "
+        f"(qps {res['qps_plain']} -> {res['qps_on']})")
+    return res
+
+
 def bench_health_overhead(platform):
     """Cost of the training-health plane (docs/OBSERVABILITY.md "Training
     health"): the same train-step loop with the divergence sentinel off vs
@@ -858,6 +884,14 @@ def main():
             extra["obs_overhead"] = bench_obs_overhead(platform)
         except Exception as e:
             extra["obs_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("prof_overhead"):
+        try:
+            # the black-box plane (tail retention + continuous profiler)
+            # must be cheap enough to stay always-on: same serve path,
+            # everything off vs tail buffering + 67 Hz sampling, <5% gated
+            extra["prof_overhead"] = bench_prof_overhead(platform)
+        except Exception as e:
+            extra["prof_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
     if not over_budget("health_overhead"):
         try:
             # the divergence sentinel must be cheap enough to leave ON for
@@ -928,6 +962,7 @@ def main():
         "serve_scale": "serve_scale",
         "serve_ramp": "serve_ramp",
         "obs_overhead": "obs_overhead",
+        "prof_overhead": "prof_overhead",
         "health_overhead": "health_overhead",
         "elastic": "elastic",
     }

@@ -39,13 +39,17 @@ from . import context, metrics, trace
 # dotted path: `from mxnet_tpu.obs.export import to_prometheus` (python
 # resolves that through sys.modules, not the shadowed attribute)
 from . import export as export_mod
+from . import tail  # tail-based trace retention (verdict at root close)
+from . import profile  # continuous sampling profiler
+from . import blackbox  # crash flight recorder
 from . import slo  # SLO monitor over merged telemetry
 from . import device  # device plane: XLA cost/memory accounting, MFU
 from . import health  # training-health plane: numerics sentinel + rollback
 
-__all__ = ["trace", "metrics", "context", "export_mod", "slo", "device",
-           "health", "enable", "disable", "enabled", "span", "event", "inc",
-           "observe", "set_gauge", "export", "reset", "telemetry_part"]
+__all__ = ["trace", "metrics", "context", "export_mod", "tail", "profile",
+           "blackbox", "slo", "device", "health", "enable", "disable",
+           "enabled", "span", "event", "inc", "observe", "set_gauge",
+           "export", "reset", "telemetry_part"]
 
 # re-exported hot-path helpers (obs.span is obs.trace.span)
 span = trace.span
@@ -81,10 +85,12 @@ def disable() -> None:
 
 def reset() -> None:
     """Clear the span ring buffer, drop every metric, and empty the
-    device-plane cost registry / leak-monitor state."""
+    device-plane cost registry / leak-monitor state (plus the tail
+    plane's pending buffer + exemplars when tail mode is on)."""
     trace.reset()
     metrics.reset()
     device.reset()
+    tail.reset()
 
 
 # -- self-gating convenience helpers for instrumentation call sites --------
@@ -123,10 +129,17 @@ def telemetry_part(drain: bool = True, role: Optional[str] = None) -> dict:
         spans = trace.tracer.drain()
     else:
         spans = [trace.tracer._event_dict(r) for r in trace.tracer.events()]
-    return {"pid": os.getpid(), "role": role,
+    part = {"pid": os.getpid(), "role": role,
             "wall_epoch": trace.tracer.wall_epoch,
             "sample_rate": context.sample_rate(),
             "spans": spans, "metrics": metrics.snapshot()}
+    if tail.enabled():
+        # bucket→trace_id exemplars + buffer state ride the part, so one
+        # collection carries the exposition's exemplar links and the
+        # fleet report can show pending/retained/dropped per member
+        part["exemplars"] = tail.exemplars_snapshot()
+        part["tail"] = tail.stats()
+    return part
 
 
 # environment switches: MXNET_OBS=1 enables at import, MXNET_OBS_JSONL
@@ -135,3 +148,17 @@ _env = os.environ.get("MXNET_OBS", "").lower()
 _jsonl = os.environ.get("MXNET_OBS_JSONL")
 if _jsonl or _env not in ("", "0", "false", "no", "off"):
     enable(jsonl=_jsonl)
+
+# the black-box plane's switches (docs/OBSERVABILITY.md): tail retention,
+# continuous profiler, flight recorder — each independent, all inherited
+# by ProcReplica children so a fleet observes (and crash-records) as one
+if os.environ.get("MXNET_OBS_TAIL", "").lower() not in (
+        "", "0", "false", "no", "off"):
+    tail.enable()
+if os.environ.get("MXNET_OBS_PROF", "").lower() not in (
+        "", "0", "false", "no", "off"):
+    profile.start()
+if os.environ.get("MXNET_OBS_BLACKBOX", "").lower() not in (
+        "", "0", "false", "no", "off") \
+        or os.environ.get("MXNET_OBS_BLACKBOX_DIR"):
+    blackbox.enable()

@@ -1,0 +1,22 @@
+"""Shared env-knob parsing for the obs package — fail-soft by design: a
+garbled value falls back to the default instead of taking telemetry (and
+the process it watches) down at import. The knobs themselves are
+documented in the runtime env registry (``mxnet_tpu.runtime.env_list``).
+"""
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if not v:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    return int(env_float(name, default))

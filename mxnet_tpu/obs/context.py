@@ -46,7 +46,8 @@ from typing import Optional, Tuple
 
 __all__ = ["TraceContext", "current", "use", "new_root", "new_span_id",
            "new_trace_id", "from_header", "inject_key", "extract_key",
-           "sample_rate", "set_sample_rate", "CTX_SEP"]
+           "sample_rate", "set_sample_rate", "set_tail_mode", "tail_mode",
+           "set_force_retain", "get_force_retain", "CTX_SEP"]
 
 # ASCII unit separator: cannot appear in a sane parameter name, invisible
 # to old parsers (they see one longer key only if a NEW client talks to an
@@ -68,8 +69,32 @@ if _v:
 _WIRE = os.environ.get("MXNET_OBS_WIRE", "1").lower() not in (
     "0", "false", "no", "off")
 
+# tail mode (obs/tail.py): new roots carry the TAIL flag — spans record
+# into the pending buffer and the keep-or-drop decision moves to root
+# close. Flipped by tail.enable()/disable(); this module only owns the bit
+_tail_mode = False
+
 _local = threading.local()
 _rng = random.Random(int.from_bytes(os.urandom(8), "little"))
+
+
+def set_tail_mode(on: bool) -> None:
+    global _tail_mode
+    _tail_mode = bool(on)
+
+
+def tail_mode() -> bool:
+    return _tail_mode
+
+
+def set_force_retain(on: bool) -> None:
+    """Thread-local force-retain: roots born while set carry the FORCE
+    flag (recorded durably on every hop, bypassing the tail policy)."""
+    _local.force = bool(on)
+
+
+def get_force_retain() -> bool:
+    return getattr(_local, "force", False)
 
 
 def sample_rate() -> float:
@@ -82,43 +107,76 @@ def set_sample_rate(rate: float) -> None:
     _sample_rate = min(max(float(rate), 0.0), 1.0)
 
 
+def _id_rng() -> random.Random:
+    # one PRNG per thread, OS-seeded once: a urandom SYSCALL per id was
+    # the single hottest instruction on the span path (36% of it), and
+    # under tail mode every request mints a root — tolerable at
+    # head-sample 0.1, not at record-everything. 128 bits of OS entropy
+    # seed each thread's stream; ids only need uniqueness, not secrecy.
+    r = getattr(_local, "idrng", None)
+    if r is None:
+        r = _local.idrng = random.Random(
+            int.from_bytes(os.urandom(16), "little"))
+    return r
+
+
 def new_trace_id() -> str:
-    return os.urandom(16).hex()
+    return f"{_id_rng().getrandbits(128):032x}"
 
 
 def new_span_id() -> str:
-    return os.urandom(8).hex()
+    return f"{_id_rng().getrandbits(64):016x}"
 
 
 class TraceContext:
-    """An immutable (trace_id, span_id, sampled) triple. ``span_id`` is
+    """An immutable (trace_id, span_id, flags) triple. ``span_id`` is
     the *current parent*: a span opened under this context records it as
-    its parent and substitutes its own id for the duration."""
+    its parent and substitutes its own id for the duration.
 
-    __slots__ = ("trace_id", "span_id", "sampled")
+    Flags (the wire header's 2-hex byte): bit 0 ``sampled`` (head-based —
+    record durably on every hop), bit 1 ``tail`` (tail-pending: record
+    into the pending buffer, verdict at root close — obs/tail.py), bit 2
+    ``force`` (force-retain: record durably AND log a retain verdict)."""
 
-    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+    __slots__ = ("trace_id", "span_id", "sampled", "tail", "force")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True,
+                 tail: bool = False, force: bool = False):
         self.trace_id = trace_id
         self.span_id = span_id
         self.sampled = bool(sampled)
+        self.tail = bool(tail)
+        self.force = bool(force)
 
     def child(self) -> "TraceContext":
         """Same trace, fresh span id, inherited sampling decision."""
-        return TraceContext(self.trace_id, new_span_id(), self.sampled)
+        return TraceContext(self.trace_id, new_span_id(), self.sampled,
+                            tail=self.tail, force=self.force)
+
+    @property
+    def records(self) -> bool:
+        """Does a span under this context record at all (durably or
+        pending)? The span-site gate: NOT records → shared no-op."""
+        return self.sampled or self.tail or self.force
 
     def to_header(self) -> str:
-        return (f"00-{self.trace_id}-{self.span_id}-"
-                f"{'01' if self.sampled else '00'}")
+        flags = ((0x01 if self.sampled else 0)
+                 | (0x02 if self.tail else 0)
+                 | (0x04 if self.force else 0))
+        return f"00-{self.trace_id}-{self.span_id}-{flags:02x}"
 
     def __repr__(self):
         return (f"TraceContext({self.trace_id[:8]}…/{self.span_id}, "
-                f"sampled={self.sampled})")
+                f"sampled={self.sampled}, tail={self.tail}, "
+                f"force={self.force})")
 
     def __eq__(self, other):
         return (isinstance(other, TraceContext)
                 and self.trace_id == other.trace_id
                 and self.span_id == other.span_id
-                and self.sampled == other.sampled)
+                and self.sampled == other.sampled
+                and self.tail == other.tail
+                and self.force == other.force)
 
 
 def from_header(header: str) -> Optional[TraceContext]:
@@ -133,15 +191,24 @@ def from_header(header: str) -> Optional[TraceContext]:
     if trace_id == "0" * 32 or span_id == "0" * 16:
         return None  # the spec's all-zero ids are invalid
     try:
-        sampled = bool(int(flags, 16) & 0x01)
+        bits = int(flags, 16)
     except ValueError:
         return None
-    return TraceContext(trace_id, span_id, sampled)
+    return TraceContext(trace_id, span_id, bool(bits & 0x01),
+                        tail=bool(bits & 0x02), force=bool(bits & 0x04))
 
 
 def new_root(sampled: Optional[bool] = None) -> TraceContext:
     """Start a new trace. The head-based sampling decision happens HERE
-    and only here — every downstream hop inherits the flag."""
+    and only here — every downstream hop inherits the flag. Under tail
+    mode (obs/tail.py) the decision MOVES to root close instead: the root
+    carries the tail-pending bit, spans record into the pending buffer,
+    and the retention policy rules when the root span closes. A
+    force-retain block (``tail.forced()``) records durably at once."""
+    if get_force_retain():
+        return TraceContext(new_trace_id(), new_span_id(), True, force=True)
+    if _tail_mode:
+        return TraceContext(new_trace_id(), new_span_id(), False, tail=True)
     if sampled is None:
         rate = _sample_rate
         sampled = rate >= 1.0 or (rate > 0.0 and _rng.random() < rate)

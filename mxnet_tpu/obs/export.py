@@ -7,11 +7,21 @@ produced by ``obs.telemetry_part()`` and pulled over ``OP_TELEMETRY``):
 - :func:`to_prometheus` / :func:`render_prometheus` — the metrics registry
   snapshot as Prometheus text exposition (version 0.0.4): counters get a
   ``_total`` suffix, histograms unroll into cumulative ``_bucket{le=...}``
-  series plus ``_sum``/``_count``. Labels (``pid``/``role``) distinguish
+  series plus ``_sum``/``_count``. Every described family (the
+  ``obs.metrics.describe``/``description`` registry) ships a ``# HELP``
+  line before its ``# TYPE``. Labels (``pid``/``role``) distinguish
   fleet members, so one scrape of the FleetServer front covers every
   replica. HTTP-free by design: the text rides the existing STATS/
   TELEMETRY wire opcodes or lands in a file — point a node_exporter
   textfile collector or a pushgateway at it, no web server in-process.
+  Latency-histogram buckets additionally carry **OpenMetrics exemplars**
+  when the tail-retention plane supplies them (``obs/tail.py``): the
+  trace_id of the most recent *retained* trace that landed in a bucket
+  rides as ``# {trace_id="..."} value ts`` — a p99 bucket links straight
+  to a kept tail trace. Exemplars are OpenMetrics-only syntax (a mid-line
+  ``#`` is a whole-scrape parse error to a strict 0.0.4 parser): pass
+  ``openmetrics=False`` for exemplar-free 0.0.4 output when the file
+  feeds a node_exporter textfile collector or a pushgateway.
 - :func:`merge_chrome_parts` — N parts (client, router front, replicas,
   plus JSONL evidence files of SIGKILLed processes) onto ONE chrome trace
   with a lane per pid. Each tracer's timestamps are relative to its own
@@ -67,36 +77,111 @@ def _fmt(v) -> str:
     return str(v)
 
 
-def render_prometheus(labeled_snapshots: Sequence[Tuple[Optional[dict],
-                                                        dict]],
-                      prefix: str = "mxnet") -> str:
-    """Render N ``(labels, registry_snapshot)`` pairs as one exposition.
-    ``# TYPE`` headers are emitted once per metric family even when many
-    fleet members report the same names (the format forbids repeats)."""
-    # family → (type, [(labels, payload), ...]); insertion-ordered so the
-    # output is stable across collections (diffs stay readable)
-    families: Dict[str, Tuple[str, list]] = {}
+def _help_escape(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
-    def add(name, mtype, labels, payload):
+
+def _exemplar_suffix(ex: Optional[dict]) -> str:
+    """OpenMetrics exemplar: ``# {trace_id="..."} value ts`` appended to a
+    bucket line. Empty string when no exemplar landed in the bucket."""
+    if not ex or not ex.get("trace_id"):
+        return ""
+    out = f' # {{trace_id="{ex["trace_id"]}"}} {_fmt(float(ex.get("value", 0.0)))}'
+    if ex.get("ts") is not None:
+        out += f" {_fmt(round(float(ex['ts']), 3))}"
+    return out
+
+
+def _rebucket_exemplars(ex: Optional[dict], bounds: Sequence[float]) -> dict:
+    """Re-key exemplars onto the RENDERED bucket ladder. A histogram
+    snapshot omits empty buckets, and the exemplar's stamped bucket is
+    often exactly such a bucket (a shed/deadline verdict retains the
+    trace without observing its latency into the histogram) — so an
+    exemplar keyed to an unrendered bound attaches to the first rendered
+    bucket that still contains its value (``value <= le``, which is all
+    OpenMetrics requires). Values past every rendered bound land on
+    ``+Inf``; ties within a bucket keep the most recent stamp."""
+    if not ex:
+        return {}
+    entries = []
+    for e in ex.values():
+        try:
+            entries.append((float(e.get("value", 0.0)), e))
+        except (TypeError, ValueError):
+            continue
+    entries.sort(key=lambda t: t[0])
+    out: dict = {}
+    idx = 0
+    for b in bounds:
+        best = None
+        while idx < len(entries) and entries[idx][0] <= b:
+            cand = entries[idx][1]
+            if best is None or (cand.get("ts") or 0) >= (best.get("ts") or 0):
+                best = cand
+            idx += 1
+        if best is not None:
+            out[repr(b)] = best
+    best = None
+    for _, e in entries[idx:]:
+        if best is None or (e.get("ts") or 0) >= (best.get("ts") or 0):
+            best = e
+    if best is not None:
+        out["+Inf"] = best
+    return out
+
+
+def render_prometheus(labeled_snapshots: Sequence[tuple],
+                      prefix: str = "mxnet",
+                      openmetrics: bool = True) -> str:
+    """Render N ``(labels, registry_snapshot[, exemplars])`` tuples as one
+    exposition. ``# TYPE`` (and, for described families, ``# HELP``)
+    headers are emitted once per metric family even when many fleet
+    members report the same names (the format forbids repeats).
+    ``exemplars`` is the ``obs.tail.exemplars_snapshot()`` schema —
+    ``{histogram_name: {le_repr: {"trace_id", "value", "ts"}}}``.
+
+    ``openmetrics=True`` (default) emits OpenMetrics: exemplar suffixes
+    on bucket lines plus the required ``# EOF`` terminator. Exemplars are
+    a mid-line ``#``, which classic text format 0.0.4 rejects as a parse
+    error for the WHOLE scrape — pass ``openmetrics=False`` for strict
+    0.0.4 output (no exemplars, no EOF) when the file feeds a
+    node_exporter textfile collector or a pushgateway."""
+    # family → (type, orig_name, [(labels, payload, exemplars), ...]);
+    # insertion-ordered so the output is stable across collections
+    families: Dict[str, tuple] = {}
+
+    def add(name, mtype, labels, payload, ex=None):
         fam = _metric_name(name, prefix)
         ent = families.get(fam)
         if ent is None:
-            ent = families[fam] = (mtype, [])
-        ent[1].append((labels, payload))
+            ent = families[fam] = (mtype, name, [])
+        ent[2].append((labels, payload, ex))
 
-    for labels, snap in labeled_snapshots:
+    for entry in labeled_snapshots:
+        labels, snap = entry[0], entry[1]
+        exemplars = entry[2] if len(entry) > 2 else None
         for name, v in (snap.get("counters") or {}).items():
             add(name, "counter", labels, v)
         for name, v in (snap.get("gauges") or {}).items():
             add(name, "gauge", labels, v)
         for name, h in (snap.get("histograms") or {}).items():
-            add(name, "histogram", labels, h)
+            add(name, "histogram", labels, h,
+                (exemplars or {}).get(name) if openmetrics else None)
+
+    try:
+        from .metrics import description as _description
+    except ImportError:  # pragma: no cover — parser-only environments
+        def _description(_name):
+            return None
 
     lines: List[str] = []
     for fam in sorted(families):
-        mtype, series = families[fam]
+        mtype, orig_name, series = families[fam]
+        help_text = _description(orig_name)
+        if help_text:
+            lines.append(f"# HELP {fam} {_help_escape(help_text)}")
         lines.append(f"# TYPE {fam} {mtype}")
-        for labels, payload in series:
+        for labels, payload, ex in series:
             if mtype == "counter":
                 lines.append(f"{fam}_total{_labels_str(labels)} "
                              f"{_fmt(payload)}")
@@ -106,32 +191,44 @@ def render_prometheus(labeled_snapshots: Sequence[Tuple[Optional[dict],
                 buckets = payload.get("buckets") or {}
                 bounds = sorted(
                     (float(k) for k in buckets if k != "+Inf"))
+                ex_by_le = _rebucket_exemplars(ex, bounds)
                 running = 0
                 for b in bounds:
                     running += buckets.get(repr(b), buckets.get(str(b), 0))
                     lines.append(
                         f"{fam}_bucket{_labels_str(labels, {'le': _fmt(b)})}"
-                        f" {running}")
+                        f" {running}{_exemplar_suffix(ex_by_le.get(repr(b)))}")
                 lines.append(
                     f"{fam}_bucket{_labels_str(labels, {'le': '+Inf'})}"
-                    f" {payload.get('count', running)}")
+                    f" {payload.get('count', running)}"
+                    f"{_exemplar_suffix(ex_by_le.get('+Inf'))}")
                 lines.append(f"{fam}_sum{_labels_str(labels)} "
                              f"{_fmt(float(payload.get('sum', 0.0)))}")
                 lines.append(f"{fam}_count{_labels_str(labels)} "
                              f"{payload.get('count', 0)}")
+    if lines and openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + ("\n" if lines else "")
 
 
 def to_prometheus(snapshot: dict, labels: Optional[dict] = None,
-                  prefix: str = "mxnet") -> str:
+                  prefix: str = "mxnet",
+                  exemplars: Optional[dict] = None,
+                  openmetrics: bool = True) -> str:
     """One registry snapshot (``obs.metrics.snapshot()``) as Prometheus
-    text exposition."""
-    return render_prometheus([(labels, snapshot)], prefix=prefix)
+    text exposition. ``exemplars`` (``obs.tail.exemplars_snapshot()``)
+    pins retained-trace ids to latency buckets (OpenMetrics only — see
+    :func:`render_prometheus`)."""
+    return render_prometheus([(labels, snapshot, exemplars)], prefix=prefix,
+                             openmetrics=openmetrics)
 
 
-def parts_to_prometheus(parts: Sequence[dict], prefix: str = "mxnet") -> str:
+def parts_to_prometheus(parts: Sequence[dict], prefix: str = "mxnet",
+                        openmetrics: bool = True) -> str:
     """Telemetry parts (``obs.telemetry_part()`` schema) → one exposition,
-    each part labeled by pid (+role when present)."""
+    each part labeled by pid (+role when present). A part's ``exemplars``
+    (tail mode) ride onto its histogram bucket lines (OpenMetrics only —
+    see :func:`render_prometheus`)."""
     labeled = []
     seen = set()
     for p in parts:
@@ -142,8 +239,8 @@ def parts_to_prometheus(parts: Sequence[dict], prefix: str = "mxnet") -> str:
         labels = {"pid": str(pid)}
         if p.get("role"):
             labels["role"] = str(p["role"])
-        labeled.append((labels, p.get("metrics") or {}))
-    return render_prometheus(labeled, prefix=prefix)
+        labeled.append((labels, p.get("metrics") or {}, p.get("exemplars")))
+    return render_prometheus(labeled, prefix=prefix, openmetrics=openmetrics)
 
 
 # ---------------------------------------------------------------------------
@@ -176,6 +273,8 @@ def merge_metrics(snapshots: Sequence[dict]) -> dict:
     last-write semantics would silently drop all but one member)."""
     out = {"counters": {}, "gauges": {}, "histograms": {}}
     for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue  # a torn JSONL tail can surface as a non-dict record
         for name, v in (snap.get("counters") or {}).items():
             out["counters"][name] = out["counters"].get(name, 0) + v
         for name, v in (snap.get("gauges") or {}).items():
@@ -217,12 +316,16 @@ def merge_chrome_parts(parts: Sequence[dict],
     Parts with no anchor (a pre-context JSONL, say) sit at the shared
     origin and the caller should surface the clock-skew caveat."""
     anchors = [p["wall_epoch"] for p in parts
-               if p.get("wall_epoch") is not None]
+               if isinstance(p, dict) and p.get("wall_epoch") is not None]
     base = min(anchors) if anchors else 0.0
     trace_events: List[dict] = []
     merged_metrics = []
     metric_pids = set()
+    skipped = 0  # torn/garbled records (a SIGKILL'd stream's final line)
     for p in parts:
+        if not isinstance(p, dict):
+            skipped += 1
+            continue
         pid = p.get("pid", 0)
         off = ((p["wall_epoch"] - base)
                if p.get("wall_epoch") is not None else 0.0)
@@ -231,6 +334,9 @@ def merge_chrome_parts(parts: Sequence[dict],
                              "tid": 0, "args": {"name": str(name)}})
         tids = {}
         for ev in p.get("spans") or ():
+            if not isinstance(ev, dict):
+                skipped += 1  # torn final record — skip, never raise
+                continue
             ph = ev.get("ph", "X")
             if ph not in ("X", "i", "C"):
                 continue  # clock/metrics metadata records
@@ -258,8 +364,11 @@ def merge_chrome_parts(parts: Sequence[dict],
             merged_metrics.append(p["metrics"])
     other = {"merged_from": [
         {"pid": p.get("pid"), "role": p.get("role"),
-         "wall_epoch": p.get("wall_epoch")} for p in parts]}
+         "wall_epoch": p.get("wall_epoch")}
+        for p in parts if isinstance(p, dict)]}
     other["metrics"] = metrics if metrics is not None \
         else merge_metrics(merged_metrics)
+    if skipped:
+        other["skipped_records"] = skipped
     return {"traceEvents": trace_events, "displayTimeUnit": "ms",
             "otherData": other}

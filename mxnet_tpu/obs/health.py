@@ -419,6 +419,16 @@ class HealthMonitor:
             self.logger.warning(
                 "health breach at step %s: %s (action=%s)", global_step,
                 "; ".join(b["detail"] for b in breaches), action)
+            try:  # snapshot the run's last seconds (spans, metrics,
+                # profiler stacks) while the breach evidence is still in
+                # the ring — throttled, no-op unless the recorder is armed
+                from . import blackbox
+
+                blackbox.trigger(
+                    "health_breach:" + ",".join(b["rule"]
+                                                for b in breaches))
+            except Exception:  # noqa: BLE001 — never fail the train loop
+                pass
             for fn in self._callbacks:
                 try:
                     fn(report, breaches)

@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Sequence
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "DEFAULT_BUCKETS", "registry", "counter", "gauge", "histogram",
-           "snapshot", "dump", "reset", "remove"]
+           "snapshot", "dump", "reset", "remove", "describe", "description"]
 
 # Prometheus-style latency ladder (seconds). Fine enough to separate a
 # sub-ms fused dispatch from a 100ms RPC retry from a multi-second compile.
@@ -277,6 +277,131 @@ class MetricsRegistry:
         """Drop every metric (tests; a fresh run's registry is empty)."""
         with self._lock:
             self._metrics.clear()
+
+
+# ---------------------------------------------------------------------------
+# metric descriptions — the `# HELP` text of the Prometheus exposition
+# ---------------------------------------------------------------------------
+#
+# Exact names first; dynamic families (``kvstore.rpc.<op>_seconds``) match
+# by longest prefix. ``obs/export.py`` looks descriptions up per family
+# when rendering, so a described metric ships its HELP line with every
+# exposition and an undescribed one renders exactly as before.
+
+_DESCRIPTIONS: Dict[str, str] = {
+    # serve plane
+    "serve.latency_seconds": "end-to-end INFER latency per executed request",
+    "serve.queue_depth": "dynamic-batcher queue depth at last submit",
+    "serve.batch_occupancy": "rows filled / bucket capacity of the last batch",
+    "serve.requests": "INFER requests accepted by the batcher",
+    "serve.shed_queue_full": "requests shed at the queue watermark",
+    "serve.shed_deadline": "requests shed because their deadline passed",
+    "serve.shed_draining": "requests shed during draining shutdown",
+    "serve.reloads": "hot reloads committed by the serving engine",
+    "serve.telemetry_errors": "OP_TELEMETRY handler failures",
+    "serve.dump_errors": "OP_DUMP flight-recorder handler failures",
+    "serve.batcher_thread_leaked": "batcher threads alive past close()",
+    "serve.handler_threads_leaked": "connection handlers alive past stop()",
+    # fleet plane
+    "fleet.request_latency_seconds":
+        "per-REQUEST latency at the router (hedges collapse to one)",
+    "fleet.request_deadline_exceeded":
+        "requests whose deadline passed before a reply",
+    "fleet.requests": "requests routed by the fleet router",
+    "fleet.failovers": "requests retried on another replica after a failure",
+    "fleet.hedges": "tail-latency hedge duplicates launched",
+    "fleet.hedge_wins": "hedged duplicates that answered first",
+    "fleet.breaker_trips": "circuit-breaker open transitions",
+    "fleet.breaker_open_seconds":
+        "cumulative seconds any replica breaker spent not closed",
+    "fleet.replicas_ready": "replicas passing readiness at last probe",
+    "fleet.replicas_total": "replicas supervised by the pool",
+    "fleet.generation": "fleet membership generation (bumps on every change)",
+    "fleet.stale_version_rejected":
+        "replies rejected for a stale engine version mid-reload",
+    # kvstore / PS plane
+    "kvstore.rpc.retries": "PS client RPC attempts after the first",
+    "kvstore.rpc.failures": "PS client RPCs that exhausted the retry budget",
+    "kvstore.bytes_pushed": "client payload bytes pushed to the PS",
+    "kvstore.bytes_pulled": "client payload bytes pulled from the PS",
+    "kvstore.server.bytes_received": "PS-server inbound payload bytes",
+    "kvstore.server.threads_leaked": "PS handler threads alive past stop()",
+    "kvstore.barrier_timeout": "barriers that timed out naming absent ranks",
+    # health plane
+    "health.loss": "sampled training loss (also a chrome counter track)",
+    "health.loss_ewma": "EWMA of the sampled training loss",
+    "health.grad_norm": "global gradient norm at the last sampled step",
+    "health.update_ratio_max":
+        "worst update-to-weight ratio across parameters",
+    "health.nonfinite_grads":
+        "non-finite gradient elements at the last sample",
+    "health.nonfinite_total": "cumulative non-finite gradient elements",
+    "health.scaler.skip_streak":
+        "consecutive AMP-scaler skipped steps (the silent skip-loop signal)",
+    "health.samples": "sentinel evaluations run",
+    "health.rollbacks": "automatic checkpoint rollbacks taken",
+    "health.lr_backoffs": "automatic learning-rate backoffs taken",
+    "health.nan_provenance": "NaN blame passes run",
+    # tail retention / profiler / flight recorder (the black-box plane)
+    "tail.resolved":
+        "pending traces promoted by a telemetry-plane verdict list",
+    "tail.overflow": "pending traces evicted at the buffer cap",
+    "blackbox.dumps": "flight-recorder bundles written",
+    "blackbox.throttled": "automatic dumps suppressed by the cooldown",
+}
+
+# (prefix, help) families for dynamically named metrics — longest prefix
+# wins so `kvstore.server.rpc.` beats `kvstore.rpc.` beats `kvstore.`
+_FAMILY_DESCRIPTIONS = (
+    ("kvstore.server.rpc.", "PS server-side service time per opcode"),
+    ("kvstore.rpc.backoff", "per-retry backoff sleeps"),
+    ("kvstore.rpc.", "PS client-side RPC latency per opcode"),
+    ("serve.rpc.", "serve server-side service time per opcode"),
+    ("serve.client.rpc", "serve client-side RPC latency"),
+    ("serve.shed_", "requests shed, by reason"),
+    ("fleet.replica", "per-replica supervisor view (queue depth, occupancy,"
+                      " breaker state)"),
+    ("health.breach.", "sentinel breaches per rule"),
+    ("health.monitor.", "Monitor scalar stats routed through the health"
+                        " plane"),
+    ("tail.retained.", "tail-mode traces retained, by policy reason"),
+    ("tail.dropped.", "tail-mode traces dropped, by policy reason"),
+    ("dispatch.", "compiled-program executions, eager dispatches, and"
+                  " host-device transfers"),
+    ("device.", "XLA cost/memory accounting (docs/OBSERVABILITY.md"
+                " 'Device plane')"),
+    ("update.", "fused update engine compile/execute accounting"),
+    ("io.prefetch.", "prefetching iterator queue telemetry"),
+    ("checkpoint.", "checkpoint writer durations and backlog"),
+    ("chaos.", "injected faults"),
+    ("autoscale.", "SLO-driven autoscaler decisions"),
+    ("tsan.", "runtime lock-order sanitizer findings"),
+)
+
+
+def describe(name: str, help_text: str, family: bool = False) -> None:
+    """Register ``# HELP`` text for a metric name (or, with
+    ``family=True``, a name prefix). Later registrations win."""
+    global _FAMILY_DESCRIPTIONS
+    if family:
+        _FAMILY_DESCRIPTIONS = ((name, help_text),) + tuple(
+            f for f in _FAMILY_DESCRIPTIONS if f[0] != name)
+    else:
+        _DESCRIPTIONS[name] = help_text
+
+
+def description(name: str) -> Optional[str]:
+    """The HELP text for a metric name: exact match first, then the
+    longest matching family prefix; None when undescribed."""
+    d = _DESCRIPTIONS.get(name)
+    if d is not None:
+        return d
+    best = None
+    for prefix, text in _FAMILY_DESCRIPTIONS:
+        if name.startswith(prefix) and (best is None
+                                        or len(prefix) > len(best[0])):
+            best = (prefix, text)
+    return best[1] if best else None
 
 
 # the process-global default registry — module-level helpers delegate here

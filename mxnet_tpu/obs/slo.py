@@ -198,6 +198,15 @@ class SLOMonitor:
         self.last_report = report
 
         if breaches:
+            try:  # an SLO breach is a flight-recorder moment: capture the
+                # fleet's last seconds while they are still in the ring
+                # (throttled; no-op unless the recorder is armed)
+                from . import blackbox
+
+                blackbox.trigger(
+                    "slo_breach:" + ",".join(b["rule"] for b in breaches))
+            except Exception:  # noqa: BLE001 — telemetry must not fail SLO
+                pass
             for fn in self._callbacks:
                 try:
                     fn(report, breaches)

@@ -55,6 +55,17 @@ __all__ = ["Tracer", "span", "event", "counter", "complete", "events",
 # hot path, no function call.
 _ENABLED = False
 
+# routing sinks, installed by their owners (None = inactive):
+# - _TAIL_SINK(trace_id, rec): obs/tail.py — spans of a tail-pending trace
+#   go to the per-trace pending buffer instead of the durable ring; the
+#   retention verdict at root close promotes them back through _record.
+# - _BLACKBOX_SINK(rec, tracer): obs/blackbox.py — the flight recorder's
+#   always-on ring sees EVERY event exactly once at creation time
+#   (including tail-held ones that may later be dropped — the crash
+#   bundle wants "what was this process doing", retained or not).
+_TAIL_SINK = None
+_BLACKBOX_SINK = None
+
 
 def _trace_epoch() -> float:
     return time.monotonic()
@@ -110,6 +121,8 @@ class _Span:
                 stack.pop()
             if stack:
                 stack.pop()
+        if not stack:
+            self._tracer._release_stack(stack)
         attrs = self.attrs
         if self._ctx is not None:
             _context._set(self._parent)
@@ -117,9 +130,9 @@ class _Span:
             attrs["trace_id"] = self._ctx.trace_id
             attrs["span_id"] = self._ctx.span_id
             attrs["parent_id"] = self._parent.span_id
-        self._tracer._record(
+        self._tracer._route(
             ("X", self.name, self.t0, t1 - self.t0,
-             threading.get_ident(), len(stack), attrs))
+             threading.get_ident(), len(stack), attrs), self._ctx)
         return False
 
 
@@ -137,6 +150,12 @@ class Tracer:
         self.capacity = int(capacity)
         self._events: deque = deque(maxlen=self.capacity)
         self._local = threading.local()
+        # tid -> the thread's live span stack (the same list object the
+        # thread-local holds) — how the sampling profiler (obs/profile.py)
+        # tags another thread's samples with its active span phase; a
+        # cross-thread read of the last element is GIL-atomic (worst case
+        # one sample period stale)
+        self._thread_stacks: dict = {}
         # the two epochs are taken at the same instant: an event's unix
         # time is wall_epoch + ts — how multi-process traces merge onto
         # one timeline (obs/export.py, tools/trace_report.py)
@@ -163,7 +182,58 @@ class Tracer:
         st = getattr(self._local, "stack", None)
         if st is None:
             st = self._local.stack = []
+            self._thread_stacks[threading.get_ident()] = st
         return st
+
+    def _depth(self) -> int:
+        """Current span depth WITHOUT registering a stack — instant
+        events outside any span must not re-grow ``_thread_stacks``."""
+        st = getattr(self._local, "stack", None)
+        return len(st) if st else 0
+
+    def _release_stack(self, stack: list) -> None:
+        """Root closed on this thread: drop its ``_thread_stacks``
+        registration. A serve plane spawning one handler thread per
+        connection would otherwise grow the dict (and keep every dead
+        thread's list alive) without bound; the next span on this thread
+        re-registers a fresh list via ``_stack()``."""
+        if getattr(self._local, "stack", None) is stack:
+            self._local.stack = None
+        self._thread_stacks.pop(threading.get_ident(), None)
+
+    def thread_phases(self) -> dict:
+        """``{tid: innermost active span name}`` across threads — the
+        profiler's phase-attribution source."""
+        out = {}
+        for tid, st in list(self._thread_stacks.items()):
+            try:
+                out[tid] = st[-1].name
+            except IndexError:
+                pass  # the owner popped its last span mid-read
+        return out
+
+    def _route(self, rec: tuple, ctx) -> None:
+        """One emit point for every completed event: feed the flight
+        recorder (exactly once, at creation), then either hold the record
+        in the tail-pending buffer (tail-flagged trace, verdict later) or
+        record it durably. Promotion re-enters through ``_record`` so the
+        blackbox never sees a promoted record twice."""
+        bb = _BLACKBOX_SINK
+        if bb is not None:
+            bb(rec, self)
+        if (ctx is not None and ctx.tail and not ctx.force
+                and not ctx.sampled):
+            sink = _TAIL_SINK
+            if sink is not None:
+                sink(ctx.trace_id, rec)
+            # else drop: the tail bit arrived over the wire but THIS
+            # process never enabled tail mode — it has no pending buffer
+            # to hold the span and no verdict will ever promote it.
+            # Recording durably here would bypass this process's own
+            # head-sampling rate (a tail-mode client must not turn a
+            # sample-0.05 replica into record-everything)
+        else:
+            self._record(rec)
 
     def _record(self, rec: tuple) -> None:
         self._events.append(rec)  # deque.append is atomic under the GIL
@@ -182,7 +252,7 @@ class Tracer:
         if not _ENABLED:
             return _NOOP
         ctx = _context.current()
-        if ctx is not None and not ctx.sampled:
+        if ctx is not None and not ctx.records:
             return _NOOP  # head-based sampling: whole trace or nothing
         return _Span(self, name, attrs or None, parent=ctx)
 
@@ -194,14 +264,14 @@ class Tracer:
             return
         ctx = _context.current()
         if ctx is not None:
-            if not ctx.sampled:
+            if not ctx.records:
                 return
             attrs = dict(attrs)
             attrs["trace_id"] = ctx.trace_id
             attrs["parent_id"] = ctx.span_id
-        self._record(("i", name, time.monotonic(), None,
-                      threading.get_ident(), len(self._stack()),
-                      attrs or None))
+        self._route(("i", name, time.monotonic(), None,
+                     threading.get_ident(), self._depth(),
+                     attrs or None), ctx)
 
     def counter(self, name: str, value: float) -> None:
         """Record one sample of a counter track (a Perfetto counter lane —
@@ -209,8 +279,9 @@ class Tracer:
         ``"C"`` event; ``tools/trace_report.py`` renders the series."""
         if not _ENABLED:
             return
-        self._record(("C", name, time.monotonic(), None,
-                      threading.get_ident(), 0, {"value": float(value)}))
+        self._route(("C", name, time.monotonic(), None,
+                     threading.get_ident(), 0, {"value": float(value)}),
+                    None)
 
     def complete(self, name: str, t_start: float, duration: float,
                  ctx=None, **attrs) -> None:
@@ -225,15 +296,15 @@ class Tracer:
         if ctx is None:
             ctx = _context.current()
         if ctx is not None:
-            if not ctx.sampled:
+            if not ctx.records:
                 return
             attrs = dict(attrs)
             attrs["trace_id"] = ctx.trace_id
             attrs["span_id"] = _context.new_span_id()
             attrs["parent_id"] = ctx.span_id
-        self._record(("X", name, t_start, max(duration, 0.0),
-                      threading.get_ident(), len(self._stack()),
-                      attrs or None))
+        self._route(("X", name, t_start, max(duration, 0.0),
+                     threading.get_ident(), self._depth(),
+                     attrs or None), ctx)
 
     # -- introspection / export -------------------------------------------
     def events(self) -> List[tuple]:
@@ -370,12 +441,12 @@ tracer = Tracer(capacity=int(os.environ.get("MXNET_OBS_BUFFER", "65536")))
 
 def span(name: str, **attrs):
     """``with obs.trace.span("forward", epoch=3): ...`` — no-op singleton
-    when tracing is disabled OR when the active trace context is not
-    sampled (head-based sampling, obs/context.py)."""
+    when tracing is disabled OR when the active trace context neither
+    samples (head-based) nor tail-pends (obs/tail.py)."""
     if not _ENABLED:
         return _NOOP
     ctx = _context.current()
-    if ctx is not None and not ctx.sampled:
+    if ctx is not None and not ctx.records:
         return _NOOP
     return _Span(tracer, name, attrs or None, parent=ctx)
 

@@ -124,6 +124,62 @@ _ENV_REGISTRY = {
                         "counted forward occurrences, e.g. 'data@5' "
                         "(chaos/nan.py — tests the breach/provenance/"
                         "rollback chain deterministically)."),
+    # black-box plane (obs/tail.py, obs/profile.py, obs/blackbox.py —
+    # docs/OBSERVABILITY.md "Tail sampling" / "Continuous profiling" /
+    # "Flight recorder")
+    "MXNET_OBS_TAIL": (None, "1 = tail-based trace retention: every "
+                       "request's spans record into a pending buffer and "
+                       "the keep-or-drop decision moves to root-span "
+                       "close (latency/outcome/budget policy) instead of "
+                       "the head-sampling coin flip."),
+    "MXNET_OBS_TAIL_SLOW_MS": ("250", "Root latency at or above this is "
+                               "'interesting' — retained while the "
+                               "token-bucket budget has tokens."),
+    "MXNET_OBS_TAIL_BUDGET": ("20", "Token-bucket refill rate: "
+                              "interesting-trace retentions per second "
+                              "(burst = 2x)."),
+    "MXNET_OBS_TAIL_BASELINE": ("0.01", "Uniform keep probability applied "
+                                "regardless of policy — budget exhaustion "
+                                "degrades to baseline sampling, never to "
+                                "zero."),
+    "MXNET_OBS_TAIL_TRACES": ("512", "Max traces pending a verdict "
+                              "(oldest evicted past it)."),
+    "MXNET_OBS_TAIL_SPANS": ("256", "Max held spans per pending trace."),
+    "MXNET_OBS_TAIL_HOLD_S": ("20", "Replica-side hold window: pending "
+                              "spans past it expire if no verdict "
+                              "arrived over the telemetry plane."),
+    "MXNET_OBS_PROF": (None, "1 = start the continuous sampling profiler "
+                       "at import (sys._current_frames stack samples, "
+                       "phase-tagged, collapsed-stack + chrome-trace "
+                       "exports)."),
+    "MXNET_OBS_PROF_HZ": ("67", "Profiler sampling rate (Hz). Deliberately "
+                          "off the 10ms-timer beat so periodic work "
+                          "cannot hide between ticks."),
+    "MXNET_OBS_PROF_DEPTH": ("48", "Max folded-stack depth (innermost "
+                             "frames win)."),
+    "MXNET_OBS_PROF_BUFFER": ("65536", "Raw sample ring capacity (the "
+                              "flight recorder's profiler slice)."),
+    "MXNET_OBS_BLACKBOX": (None, "1 = arm the crash flight recorder: an "
+                           "always-on ring of recent spans/metrics/"
+                           "profiler stacks dumped as a bundle on fatal "
+                           "signals, deadlock watchdog, SLO/health "
+                           "breaches, or OP_DUMP."),
+    "MXNET_OBS_BLACKBOX_DIR": (None, "Bundle directory (setting it also "
+                               "arms the recorder); the periodic "
+                               "blackbox-<pid>-last.json flush lands "
+                               "here — the SIGKILL artifact."),
+    "MXNET_OBS_BLACKBOX_EVENTS": ("4096", "Flight-recorder ring capacity "
+                                  "(most recent telemetry events)."),
+    "MXNET_OBS_BLACKBOX_FLUSH_S": ("2", "Periodic last-bundle rewrite "
+                                   "interval; a SIGKILL leaves a bundle "
+                                   "at most this stale."),
+    "MXNET_OBS_BLACKBOX_COOLDOWN_S": ("30", "Min seconds between automatic "
+                                      "dumps (a breach storm must not "
+                                      "turn the recorder into the "
+                                      "outage)."),
+    "MXNET_OBS_BLACKBOX_PROF_S": ("10", "Seconds of profiler samples a "
+                                  "bundle embeds (a bounded slice of the "
+                                  "ring, not all ~16 min of it)."),
     # distributed (DMLC_* names kept for launcher compat)
     "DMLC_ROLE": (None, "worker|server|scheduler — set by tools/launch.py."),
     "DMLC_PS_ROOT_URI": (None, "Coordinator/PS host (reference ps-lite env)."),

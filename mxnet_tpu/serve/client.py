@@ -41,12 +41,12 @@ from ..chaos import rpc as chaos_rpc
 from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
                                  _unpack_arrays)
 from .engine import (DeadlineExceeded, Draining, RequestRejected, ServeError)
-from .server import (OP_ABORT_RELOAD, OP_COMMIT_RELOAD, OP_DRAIN, OP_HEALTH,
-                     OP_INFER, OP_PREPARE_RELOAD, OP_READY, OP_RELOAD,
-                     OP_SHUTDOWN, OP_STATS, OP_TELEMETRY, SERVE_OP_NAMES,
-                     STATUS_BAD_REQUEST, STATUS_DEADLINE, STATUS_DRAINING,
-                     STATUS_INTERNAL, STATUS_NOT_READY, STATUS_OK,
-                     STATUS_REJECTED, _INFER_HDR)
+from .server import (OP_ABORT_RELOAD, OP_COMMIT_RELOAD, OP_DRAIN, OP_DUMP,
+                     OP_HEALTH, OP_INFER, OP_PREPARE_RELOAD, OP_READY,
+                     OP_RELOAD, OP_SHUTDOWN, OP_STATS, OP_TELEMETRY,
+                     SERVE_OP_NAMES, STATUS_BAD_REQUEST, STATUS_DEADLINE,
+                     STATUS_DRAINING, STATUS_INTERNAL, STATUS_NOT_READY,
+                     STATUS_OK, STATUS_REJECTED, _INFER_HDR)
 
 __all__ = ["ServeClient"]
 
@@ -195,16 +195,37 @@ class ServeClient:
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms else None)
         # the trace is born here (unless the caller already carries one):
-        # the head-based sampling decision this root takes rides the wire
-        # to the router and every replica this request touches
+        # the head-based sampling decision this root takes — or, under
+        # tail mode, the tail-pending bit — rides the wire to the router
+        # and every replica this request touches
         ctx = None
+        root_here = False
         if obs.enabled():
-            ctx = obs_context.current() or obs_context.new_root()
-        with obs_context.use(ctx):
-            reply = self._check(self._rpc(OP_INFER, payload,
-                                          deadline=deadline,
-                                          timeout=rpc_timeout),
-                                "inference failed")
+            ctx = obs_context.current()
+            if ctx is None:
+                ctx = obs_context.new_root()
+                root_here = True
+        t0 = time.monotonic()
+        try:
+            with obs_context.use(ctx):
+                reply = self._check(self._rpc(OP_INFER, payload,
+                                              deadline=deadline,
+                                              timeout=rpc_timeout),
+                                    "inference failed")
+        except BaseException as e:
+            # tail retention: the server's verdict on this request rode
+            # the existing reply path as the status byte — _check raised
+            # it as a typed error, which becomes the root-close outcome
+            if root_here:
+                outcome = "deadline" if isinstance(e, DeadlineExceeded) \
+                    else "shed" if isinstance(e, (RequestRejected,
+                                                  Draining)) \
+                    else "error"
+                obs.tail.finish_root(ctx, time.monotonic() - t0,
+                                     outcome=outcome)
+            raise
+        if root_here:
+            obs.tail.finish_root(ctx, time.monotonic() - t0)
         (version,) = struct.unpack_from("<I", reply, 0)
         outs, _ = _unpack_arrays(reply[4:])
         result = outs[0] if len(outs) == 1 else outs
@@ -248,21 +269,53 @@ class ServeClient:
         reply = self._check(self._rpc(OP_STATS, payload), "stats failed")
         return json.loads(bytes(reply).decode("utf-8"))
 
-    def telemetry(self, drain: bool = True, fmt: str = "json"):
+    def dump(self, reason: str = "wire", write: bool = False) -> dict:
+        """Pull the server's flight-recorder bundle (``OP_DUMP``,
+        obs/blackbox.py): the always-on ring of recent spans, a metrics
+        snapshot, profiler samples, and per-thread stacks — a remote
+        "what is this replica doing right now" snapshot. ``write=True``
+        additionally persists the bundle server-side (when the recorder
+        is armed with a directory) and returns its path in ``"path"``.
+        Read-only: nothing drains, retries are harmless."""
+        payload = json.dumps({"reason": reason,
+                              "write": bool(write)}).encode("utf-8")
+        reply = self._check(self._rpc(OP_DUMP, payload), "dump failed")
+        return json.loads(bytes(reply).decode("utf-8"))
+
+    def telemetry(self, drain: bool = True, fmt: str = "json",
+                  retained: Optional[list] = None,
+                  openmetrics: bool = True):
         """Pull the server's telemetry (``OP_TELEMETRY``): ``fmt="json"``
         returns ``{"parts": [...]}`` — one part per process behind the
         endpoint (a FleetServer appends every live replica's), each with
         its drained span ring, metrics snapshot, and clock anchor.
-        ``fmt="prometheus"`` returns the text exposition instead.
+        ``fmt="prometheus"`` returns the text exposition instead
+        (OpenMetrics with tail exemplars by default; pass
+        ``openmetrics=False`` for strict 0.0.4 output — a mid-line
+        exemplar ``#`` is a whole-scrape parse error to classic parsers,
+        so a reply feeding a node_exporter textfile collector or a
+        pushgateway needs the strict form).
         ``drain=False`` peeks without consuming the rings.
 
         Exactly-once under retries: draining is destructive, so the
         request carries a fresh collection token — a retried frame whose
         reply was lost re-serves the server's cached reply instead of
-        draining (and losing) a second batch."""
-        payload = json.dumps({"drain": bool(drain), "format": fmt,
-                              "token": os.urandom(8).hex()
-                              }).encode("utf-8")
+        draining (and losing) a second batch.
+
+        Tail retention (obs/tail.py): the collection carries this
+        process's retained-trace verdict log (plus any ``retained`` ids
+        the caller adds), so a downstream hop's pending spans promote
+        with the very collection that fetches them."""
+        spec = {"drain": bool(drain), "format": fmt,
+                "token": os.urandom(8).hex()}
+        if fmt == "prometheus" and not openmetrics:
+            spec["openmetrics"] = False
+        ids = list(retained or ())
+        if obs.tail.enabled():
+            ids.extend(obs.tail.retained_ids())
+        if ids:
+            spec["retained"] = sorted(set(ids))
+        payload = json.dumps(spec).encode("utf-8")
         reply = self._check(self._rpc(OP_TELEMETRY, payload),
                             "telemetry failed")
         if fmt == "prometheus":

@@ -269,6 +269,17 @@ class ProcReplica:
             env.setdefault("MXNET_OBS", "1")
             env.setdefault("MXNET_OBS_SAMPLE",
                            repr(obs.context.sample_rate()))
+            # the black-box plane inherits too: tail mode (replica-side
+            # pending buffers), the continuous profiler, and the flight
+            # recorder — whose bundle dir defaults to the same evidence
+            # directory as the JSONL stream, so a SIGKILL'd replica
+            # leaves BOTH its flushed spans and its last-seconds bundle
+            if obs.tail.enabled():
+                env.setdefault("MXNET_OBS_TAIL", "1")
+            if obs.profile.enabled():
+                env.setdefault("MXNET_OBS_PROF", "1")
+            if self._obs_dir:
+                env.setdefault("MXNET_OBS_BLACKBOX_DIR", self._obs_dir)
         if self._obs_dir and env.get("MXNET_OBS") \
                 and "MXNET_OBS_JSONL" not in self._env:
             os.makedirs(self._obs_dir, exist_ok=True)
@@ -985,6 +996,12 @@ class Router:
             if br.failure():
                 obs.inc("fleet.breaker_trips")
                 obs.event("fleet.breaker_trip", replica=m.idx)
+                # tail retention: a request that crossed a TRIPPING
+                # breaker is interesting even if a failover later
+                # succeeds (a lone failure that fails over cleanly is
+                # not — "breaker" must mean a trip, or the retention
+                # counters operators alert on lie)
+                obs.tail.note(breaker=True)
             m.errors += 1
             m.last_error = f"{type(e).__name__}: {e}"
             return False, e
@@ -1010,15 +1027,28 @@ class Router:
 
         def run(member):
             with obs.context.use(ctx):
-                q.put((member,
-                       self._attempt(member, arrays, deadline, priority)))
+                res = self._attempt(member, arrays, deadline, priority)
+                # tail notes are thread-local too: a breaker trip noted
+                # inside _attempt lands in THIS racer's TLS, which no
+                # finish_root ever reads — ship the notes back with the
+                # result so the request thread re-applies them to the
+                # root's retention verdict
+                q.put((member, res, obs.tail.take_notes()))
+
+        def renote(notes):
+            outcome, flags = notes
+            if outcome:
+                obs.tail.note(outcome=outcome)
+            for f in flags:
+                obs.tail.note(**{f: True})
 
         # deliberately unjoined racer: the reply comes back over q and
         # INFER is read-only — the losing attempt is wasted capacity, not
         # an orphaned mutation; a wedged racer dies with its socket timeout
         threading.Thread(target=run, args=(primary,), daemon=True).start()  # lint: disable=thread-fire-and-forget
         try:
-            member, (ok, val) = q.get(timeout=self.hedge_ms / 1e3)
+            member, (ok, val), notes = q.get(timeout=self.hedge_ms / 1e3)
+            renote(notes)
             if ok:
                 return True, val
             # primary failed FAST (conn refused, shed): that is plain
@@ -1032,6 +1062,9 @@ class Router:
         obs.inc("fleet.hedges")
         obs.event("fleet.hedge", primary=primary.idx,
                   secondary=secondary.idx)
+        # a hedged request is a tail-retention signal: the primary was
+        # slow enough to duplicate, whoever wins
+        obs.tail.note(hedged=True)
         threading.Thread(target=run, args=(secondary,), daemon=True).start()  # lint: disable=thread-fire-and-forget
         budget = self._client_timeout if deadline is None \
             else max(deadline - time.monotonic(), 0.0)
@@ -1039,10 +1072,11 @@ class Router:
         last = None
         for _ in range(2):
             try:
-                member, (ok, val) = q.get(
+                member, (ok, val), notes = q.get(
                     timeout=max(end - time.monotonic(), 0.01))
             except queue.Empty:
                 break
+            renote(notes)
             if ok:
                 if member is secondary:
                     self.hedge_wins += 1
@@ -1087,6 +1121,7 @@ class Router:
                     self._inflight += 1
                     break
         t0 = time.monotonic()
+        outcome = "ok"
         try:
             with obs.context.use(rctx):
                 result = self._infer_routed(arrays, deadline, priority)
@@ -1099,8 +1134,23 @@ class Router:
             return result
         except DeadlineExceeded:
             obs.inc("fleet.request_deadline_exceeded")
+            outcome = "deadline"
+            raise
+        except (RequestRejected, Draining):
+            outcome = "shed"
+            raise
+        except BaseException:
+            outcome = "error"
             raise
         finally:
+            # tail retention for a directly-driven Router (rctx is the
+            # root): verdict here. Behind a FleetServer front the wire
+            # handler owns the root — and this thread's hedge/breaker
+            # notes, which finish_root must NOT consume (rctx None skips
+            # the call entirely; the front's finish reads them)
+            if rctx is not None:
+                obs.tail.finish_root(rctx, time.monotonic() - t0,
+                                     outcome=outcome)
             with self._cv:
                 self._inflight -= 1
                 self._cv.notify_all()
@@ -1219,17 +1269,23 @@ class Router:
                 "hedge_ms": self.hedge_ms,
                 "replicas": replicas}
 
-    def collect_telemetry(self, drain: bool = True) -> list:
+    def collect_telemetry(self, drain: bool = True,
+                          retain: Optional[list] = None) -> list:
         """Pull every ready replica's telemetry part over ``OP_TELEMETRY``
         (drained rings: repeated collections are increments). A replica
         that fails mid-pull is skipped and counted — the fleet's timeline
         must assemble from whoever is alive; the dead leave their JSONL
-        evidence instead."""
+        evidence instead.
+
+        ``retain`` fans the tail-retention verdict list out to every
+        replica: a replica's briefly-held pending spans for a retained
+        trace promote into the very part this collection returns — the
+        fleet keeps or drops a trace as a unit."""
         parts = []
         for m in self._pool.ready_members():
             try:
                 with self._conn(m) as cli:
-                    tel = cli.telemetry(drain=drain)
+                    tel = cli.telemetry(drain=drain, retained=retain)
                 for p in tel.get("parts", []):
                     p["role"] = f"replica{m.idx}"
                     parts.append(p)
@@ -1374,7 +1430,8 @@ class FleetServer(ServeServer):
                prefix: str = "ckpt") -> int:
         return self._router.reload(path, epoch=epoch, prefix=prefix)
 
-    def telemetry(self, drain: bool = True) -> dict:
+    def telemetry(self, drain: bool = True,
+                  retained: Optional[list] = None) -> dict:
         """The fleet collection plane: one ``OP_TELEMETRY`` against the
         front returns the front's own part (client rpc + fleet.route
         spans, router metrics, breaker state) PLUS one part per live
@@ -1382,10 +1439,20 @@ class FleetServer(ServeServer):
         the single merged timeline, and ``parts_to_prometheus`` for the
         pid/role-labeled exposition.
 
+        Tail retention: the caller's verdict list (client-rooted traces)
+        resolves this process's pending buffer, then the union of those
+        ids and the front's OWN recent verdicts fans out with the replica
+        pulls — one collection settles the whole fleet's held spans for
+        every retained trace.
+
         Parts are deduped by pid: an in-process LocalReplica fleet shares
         ONE tracer ring and registry with the front, so its replica parts
         would be copies (peek) or already-claimed spans (drain) — only a
         real subprocess fleet contributes distinct lanes."""
+        if retained:
+            obs.tail.resolve(retained)
+        fan_out = sorted(set(list(retained or ())
+                             + obs.tail.retained_ids()))
         # stats FIRST: Router.stats() refreshes the breaker-open-time
         # gauge, which must land in the snapshot the part takes — the
         # other order would export the gauge one collection stale
@@ -1393,7 +1460,8 @@ class FleetServer(ServeServer):
         front = obs.telemetry_part(drain=drain, role="fleet")
         front["stats"] = st
         parts, seen = [front], {front["pid"]}
-        for p in self._router.collect_telemetry(drain=drain):
+        for p in self._router.collect_telemetry(drain=drain,
+                                                retain=fan_out or None):
             if p.get("pid") in seen:
                 continue
             seen.add(p.get("pid"))

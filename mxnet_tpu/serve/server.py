@@ -20,7 +20,10 @@ Wire protocol (little-endian, see ``kvstore/ps_server.py`` for framing):
   DRAIN  request : u8 stop_after (0/1)
   DRAIN  reply   : u8 0 once queued + in-flight work finished
   TELEMETRY request : utf-8 json {"drain": bool (default true),
-                   "format": "json"|"prometheus"} (empty = defaults).
+                   "format": "json"|"prometheus",
+                   "openmetrics": bool (default true; false = strict
+                   text format 0.0.4, no exemplars/EOF — for textfile
+                   collectors)} (empty = defaults).
   TELEMETRY reply: u8 status | utf-8 blob — json: {"parts": [telemetry
                    part, ...]} (obs.telemetry_part schema: pid, role,
                    wall_epoch clock anchor, drained span ring, metrics
@@ -82,7 +85,7 @@ from .engine import (DeadlineExceeded, Draining, InferenceEngine,
 
 __all__ = ["ServeServer", "OP_INFER", "OP_HEALTH", "OP_READY", "OP_RELOAD",
            "OP_STATS", "OP_DRAIN", "OP_SHUTDOWN", "OP_PREPARE_RELOAD",
-           "OP_COMMIT_RELOAD", "OP_ABORT_RELOAD", "OP_TELEMETRY",
+           "OP_COMMIT_RELOAD", "OP_ABORT_RELOAD", "OP_TELEMETRY", "OP_DUMP",
            "SERVE_OP_NAMES", "STATUS_OK", "STATUS_REJECTED",
            "STATUS_DEADLINE", "STATUS_BAD_REQUEST", "STATUS_DRAINING",
            "STATUS_INTERNAL", "STATUS_NOT_READY"]
@@ -95,10 +98,10 @@ from ..wire import SERVE_WIRE
 
 (OP_INFER, OP_HEALTH, OP_READY, OP_RELOAD, OP_STATS, OP_DRAIN,
  OP_SHUTDOWN, OP_PREPARE_RELOAD, OP_COMMIT_RELOAD,
- OP_ABORT_RELOAD, OP_TELEMETRY) = SERVE_WIRE.codes(
+ OP_ABORT_RELOAD, OP_TELEMETRY, OP_DUMP) = SERVE_WIRE.codes(
     "infer", "health", "ready", "reload", "stats", "drain",
     "serve_shutdown", "prepare_reload", "commit_reload", "abort_reload",
-    "telemetry")
+    "telemetry", "dump")
 
 SERVE_OP_NAMES = dict(SERVE_WIRE.names())
 
@@ -333,11 +336,20 @@ class ServeServer:
             out["batcher"] = self._batcher.stats()
         return out
 
-    def telemetry(self, drain: bool = True) -> dict:
+    def telemetry(self, drain: bool = True,
+                  retained: Optional[list] = None) -> dict:
         """This process's telemetry contribution (``OP_TELEMETRY``): span
         ring (drained by default — repeated collections are increments),
         metrics snapshot, clock anchor. A FleetServer overrides this to
-        pull and append every live replica's parts."""
+        pull and append every live replica's parts.
+
+        ``retained`` is the tail-retention verdict list riding the
+        request (obs/tail.py): pending traces named in it promote into
+        the ring BEFORE the drain, so a downstream hop's held spans leave
+        with the collection that carried their verdict; everything past
+        the hold window expires in the same pass."""
+        if retained:
+            obs.tail.resolve(retained)
         # stats first: anything stats() mirrors into gauges must land in
         # the snapshot telemetry_part() takes
         st = self.stats(include_metrics=False)
@@ -372,8 +384,10 @@ class ServeServer:
                 # replica spans trace either way ("absent = new root")
                 key, wctx = obs_context.extract_key(key)
                 rec = obs.enabled()
+                root_here = False
                 if wctx is None and rec and opcode == OP_INFER:
                     wctx = obs_context.new_root()
+                    root_here = True
                 t0 = time.monotonic() if rec else 0.0
                 opname = SERVE_OP_NAMES.get(opcode, str(opcode))
                 try:
@@ -384,6 +398,25 @@ class ServeServer:
                     if rec:
                         obs.observe(f"serve.rpc.{opname}_seconds",
                                     time.monotonic() - t0)
+                    # tail retention: a server-side root's verdict
+                    # happens HERE — latency + the outcome _do_infer
+                    # noted (shed/deadline/error rode the reply to
+                    # the client; the same verdict decides whether
+                    # the trace survives). When the CLIENT owns the
+                    # root, the reply status byte carries the outcome —
+                    # but hedge/breaker flags noted by the router on
+                    # THIS thread never reach the client, so
+                    # finish_remote applies the policy to the flags
+                    # locally (retaining the fleet-side spans) and, like
+                    # finish_root, always clears this thread's notes so
+                    # they cannot leak into the next request on this
+                    # connection — even ones taken while telemetry was
+                    # off.
+                    if root_here:
+                        obs.tail.finish_root(wctx, time.monotonic() - t0)
+                    else:
+                        obs.tail.finish_remote(wctx,
+                                               time.monotonic() - t0)
                 if not alive:
                     return
         except (ConnectionError, OSError):
@@ -489,12 +522,15 @@ class ServeServer:
                     with self._telemetry_lock:
                         blob = self._telemetry_tokens.get(token)
                 if blob is None:
-                    tel = self.telemetry(drain=bool(spec.get("drain", True)))
+                    tel = self.telemetry(drain=bool(spec.get("drain", True)),
+                                         retained=spec.get("retained"))
                     if spec.get("format") == "prometheus":
                         from ..obs.export import parts_to_prometheus
 
                         blob = parts_to_prometheus(
-                            tel["parts"]).encode("utf-8")
+                            tel["parts"],
+                            openmetrics=bool(spec.get("openmetrics", True)),
+                        ).encode("utf-8")
                     else:
                         blob = json.dumps(tel, default=float).encode("utf-8")
                     if token is not None:
@@ -507,6 +543,29 @@ class ServeServer:
             except Exception as e:  # noqa: BLE001 — wire-reported
                 obs.inc("serve.telemetry_errors")
                 self._reply(conn, OP_TELEMETRY, _err_payload(
+                    STATUS_INTERNAL, f"{type(e).__name__}: {e}"))
+        elif opcode == OP_DUMP:
+            # flight-recorder snapshot (obs/blackbox.py): the bundle is
+            # built from the always-on ring — nothing drains, so retries
+            # are harmless and no dedup token is needed
+            try:
+                spec = json.loads(bytes(payload).decode("utf-8")) \
+                    if len(payload) else {}
+                from ..obs import blackbox
+
+                reason = str(spec.get("reason", "wire"))
+                doc = blackbox.bundle(reason=reason)
+                if spec.get("write") and blackbox.enabled():
+                    # persist the SAME document the reply carries (a
+                    # second bundle_dict here would snapshot a later,
+                    # different ring)
+                    doc["path"] = blackbox.dump(reason=reason, doc=doc)
+                blob = json.dumps(doc, default=str).encode("utf-8")
+                self._reply(conn, OP_DUMP,
+                            struct.pack("<B", STATUS_OK) + blob)
+            except Exception as e:  # noqa: BLE001 — wire-reported
+                obs.inc("serve.dump_errors")
+                self._reply(conn, OP_DUMP, _err_payload(
                     STATUS_INTERNAL, f"{type(e).__name__}: {e}"))
         elif opcode == OP_DRAIN:
             stop = bool(payload and payload[0])
@@ -547,8 +606,10 @@ class ServeServer:
                 else self._default_timeout
             outs, version = fut.result(timeout=wait + 1.0)
         except RequestRejected as e:
+            obs.tail.note("shed")
             return _err_payload(STATUS_REJECTED, str(e))
         except DeadlineExceeded as e:
+            obs.tail.note("deadline")
             # DEADLINE means "your deadline passed, the work was shed"; a
             # deadline-LESS request timing out the server-side wait is an
             # internal condition (the work may still execute), not an SLO
@@ -559,8 +620,10 @@ class ServeServer:
                     f"server wait exceeded {self._default_timeout}s: {e}")
             return _err_payload(STATUS_DEADLINE, str(e))
         except Draining as e:
+            obs.tail.note("shed")
             return _err_payload(STATUS_DRAINING, str(e))
         except ServeError as e:
+            obs.tail.note("error")
             return _err_payload(STATUS_INTERNAL, str(e))
         with obs.trace.span("serve.serialize", outputs=len(outs)):
             reply = (struct.pack("<BI", STATUS_OK, version)

@@ -434,6 +434,10 @@ def dump_stacks(reason: str = "manual") -> str:
         obs.inc("tsan.watchdog_dumps")
         obs.event("tsan.watchdog_dump", reason=reason,
                   threads=len(frames))
+        # a stalled fleet is exactly the "last seconds" question the
+        # flight recorder answers: snapshot the telemetry ring + profiler
+        # + these stacks as a bundle (throttled; no-op when disarmed)
+        obs.blackbox.trigger(f"watchdog:{reason}"[:120])
     except Exception:  # noqa: BLE001 — diagnosis must never crash the host
         pass
     return text

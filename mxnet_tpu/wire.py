@@ -196,6 +196,10 @@ SERVE_WIRE = WireRegistry(
         # draining the span ring is destructive: retried collections
         # re-serve the cached reply from the token LRU
         OpSpec("telemetry", 42, "serve", mutating=True, dedup="token"),
+        # flight-recorder snapshot (obs/blackbox.py): read-only — the
+        # bundle is built from the always-on ring without draining
+        # anything, so retries are harmless by construction
+        OpSpec("dump", 43, "serve"),
     ])
 
 

@@ -52,6 +52,11 @@ def pytest_configure(config):
         "checkpointed rejoin (docs/ROBUSTNESS.md \"Elastic training\"); "
         "run via `pytest -m elastic` or `make elastic`")
     config.addinivalue_line(
+        "markers", "blackbox: black-box plane tests — tail-based trace "
+        "retention, continuous stack profiler, crash flight recorder "
+        "(docs/OBSERVABILITY.md); run via `pytest -m blackbox` or "
+        "`make prof`")
+    config.addinivalue_line(
         "markers", "serve_mesh: mesh-sharded serving + elastic autoscale "
         "tests on the 8-virtual-device CPU mesh — tensor-parallel engines, "
         "replica groups on mesh slices, quarantine→activate joins "

@@ -211,6 +211,51 @@ def test_hedging_slow_primary_fast_secondary():
         pool.stop()
 
 
+def test_hedge_racer_tail_notes_reach_the_root_verdict():
+    """Tail-retention notes are thread-local, and hedged attempts run on
+    fresh racer threads — a breaker/failure note set inside a racer must
+    ride back to the request thread's retention verdict (regression: it
+    died in the racer's TLS and the trace dropped as fast_path)."""
+    from mxnet_tpu import obs
+    from mxnet_tpu.obs import metrics as obs_metrics
+    from mxnet_tpu.obs import tail as obs_tail
+    replicas = [LocalReplica(_linear_factory(delay=0.5)),
+                LocalReplica(_linear_factory())]
+    pool = ReplicaPool(replicas, probe_interval=0.2,
+                       ready_timeout=60).start()
+    try:
+        obs.enable()
+        obs_tail.enable()
+        # retain ONLY flagged-interesting traces: no slow bar, no baseline
+        obs_tail.buffer().policy = obs_tail.RetentionPolicy(
+            slow_ms=1e9, budget_per_s=1e9, burst=1e9, baseline=0.0)
+        router = Router(pool, hedge_ms=40.0)
+        router._rr = 0  # slow replica primary → the hedge fires
+        real_attempt = router._attempt
+        req_tid = threading.get_ident()
+
+        def noted_attempt(member, arrays, deadline, priority):
+            if threading.get_ident() != req_tid:
+                obs.tail.note(breaker=True)  # lands in the RACER's TLS
+            return real_attempt(member, arrays, deadline, priority)
+
+        router._attempt = noted_attempt
+        outs, _ = router.infer([X], deadline_ms=10000)
+        np.testing.assert_array_equal(outs[0], X)
+        assert router.hedges == 1
+        # the racer's note reached the root close: retained as "breaker"
+        # (first sorted flag), not dropped as fast_path
+        st = obs_tail.stats()
+        assert st["retained"] == 1 and st["dropped"] == 0
+        assert obs_metrics.registry.counter(
+            "tail.retained.breaker").value == 1
+    finally:
+        pool.stop()
+        obs_tail.disable()
+        obs.disable()
+        obs.reset()
+
+
 # ---------------------------------------------------------------------------
 # 4. fleet-atomic two-phase reload
 # ---------------------------------------------------------------------------

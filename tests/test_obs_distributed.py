@@ -393,16 +393,29 @@ def test_telemetry_endpoint_drains_and_exposes_prometheus(obs_on):
     finally:
         cli.close()
         srv.stop()
-    # exposition parses as Prometheus text: TYPE headers + name{labels} value
+    # exposition parses as OpenMetrics: HELP/TYPE headers +
+    # name{labels} value, optional exemplars (`# {trace_id="..."} value
+    # [ts]`) riding histogram bucket lines, `# EOF` terminating
     line_re = re.compile(
         r"^(# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)"
-        r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE+.inf-]+)$")
+        r"|# HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S.*"
+        r"|# EOF"
+        r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE+.inf-]+"
+        r"( # \{[^}]*\} [0-9eE+.inf-]+( [0-9eE+.-]+)?)?)$")
     lines = [ln for ln in prom.splitlines() if ln]
     assert lines, "empty exposition"
     for ln in lines:
         assert line_re.match(ln), f"invalid exposition line: {ln!r}"
+    assert lines[-1] == "# EOF"
     assert any("mxnet_serve_latency_seconds_bucket" in ln
                and 'le="' in ln for ln in lines)
+    # HELP precedes TYPE for described families (the description registry)
+    idx = {ln.split(" ", 3)[2]: i for i, ln in enumerate(lines)
+           if ln.startswith("# TYPE ")}
+    for i, ln in enumerate(lines):
+        if ln.startswith("# HELP "):
+            fam = ln.split(" ", 3)[2]
+            assert idx.get(fam, -1) == i + 1, f"HELP/TYPE split for {fam}"
 
 
 def test_prometheus_histogram_buckets_are_cumulative():

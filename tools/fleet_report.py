@@ -17,14 +17,20 @@ own — and this tool turns the parts into:
   p99 vs target, shed-by-reason, breaker open-time, hedge win rate
   (``obs/slo.py``), computed over the MERGED metrics.
 
-SIGKILL'd replicas answer nothing — but their ``replica-<pid>.jsonl``
-evidence files (``MXNET_OBS_DIR``) do: pass them via ``--jsonl`` and they
-join the same timeline as extra pid lanes.
+SIGKILL'd replicas answer nothing — but their evidence files do: pass
+``replica-<pid>.jsonl`` streams (``MXNET_OBS_DIR``) and/or flight-recorder
+bundles (``obs/blackbox.py`` — ``blackbox-<pid>-last.json``, the periodic
+"last seconds" snapshot a SIGKILL cannot suppress) via ``--jsonl`` and
+they join the same timeline as extra pid lanes — a bundle's lane carries
+the continuous profiler's ``prof:<phase>`` spans, attributing the corpse's
+final seconds by phase. A stream the kill tore mid-line is skipped past
+with a counted warning, never an error.
 
 Usage::
 
     python tools/fleet_report.py --connect 127.0.0.1:9191 \
-        --trace merged.json --prom - [--jsonl obs/replica-*.jsonl]
+        --trace merged.json --prom - \
+        [--jsonl obs/replica-*.jsonl obs/blackbox-*-last.json]
         [--target 0.99] [--p99-ms 50] [--no-drain]
 """
 from __future__ import annotations
@@ -40,12 +46,27 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def jsonl_to_part(path: str) -> dict:
-    """A JSONL evidence file as a telemetry part (the dead replica's
-    contribution: its clock record anchors the lane, its flushed spans are
-    whatever it managed to record before the kill)."""
-    from trace_report import load_trace_meta
+    """An evidence file — a JSONL stream or a flight-recorder bundle — as
+    a telemetry part (the dead replica's contribution: its clock record
+    anchors the lane, its spans are whatever it recorded before the kill;
+    a bundle also carries the profiler's ``prof:<phase>`` lane). Torn
+    trailing records are skipped and counted (``"torn_records"``)."""
+    import json as _json
 
-    spans, instants, metrics, meta = load_trace_meta(path)
+    from trace_report import load_trace_meta
+    from mxnet_tpu.obs import blackbox
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = _json.loads(text)
+    except ValueError:
+        doc = None
+    if blackbox.is_bundle(doc):
+        # the bundle schema is owned by obs/blackbox.py — its reader
+        # already folds the profiler samples into the span lane
+        return blackbox.read_bundle(doc)
+    spans, instants, metrics, meta = load_trace_meta(path, text=text)
     events = []
     for ev in spans:
         events.append(dict(ev, ph="X"))
@@ -58,9 +79,17 @@ def jsonl_to_part(path: str) -> dict:
                        "tid": ev.get("tid"),
                        "args": {"value": ev.get("value", 0)}})
     events.sort(key=lambda e: e.get("ts", 0.0))
-    return {"pid": meta.get("pid"), "role": f"jsonl:{path.rsplit('/',1)[-1]}",
+    base = path.rsplit("/", 1)[-1]
+    role = (f"blackbox:{base}" if meta.get("blackbox_reason")
+            else f"jsonl:{base}")
+    part = {"pid": meta.get("pid"), "role": role,
             "wall_epoch": meta.get("wall_epoch"),
             "spans": events, "metrics": metrics or {}}
+    if meta.get("skipped_lines"):
+        part["torn_records"] = meta["skipped_lines"]
+    if meta.get("blackbox_reason"):
+        part["blackbox_reason"] = meta["blackbox_reason"]
+    return part
 
 
 def main(argv=None):
@@ -71,9 +100,14 @@ def main(argv=None):
                     help="write the merged chrome trace here")
     ap.add_argument("--prom", default=None, metavar="OUT.prom",
                     help="write the Prometheus exposition ('-' = stdout)")
+    ap.add_argument("--prom-strict", action="store_true",
+                    help="strict text format 0.0.4 (no OpenMetrics "
+                         "exemplars/EOF) — for node_exporter textfile "
+                         "collectors and pushgateways")
     ap.add_argument("--jsonl", nargs="*", default=(),
-                    help="per-replica JSONL evidence files to merge in "
-                         "(SIGKILL'd members)")
+                    help="evidence files to merge in (SIGKILL'd members): "
+                         "per-replica JSONL streams and/or flight-recorder "
+                         "blackbox-*.json bundles")
     ap.add_argument("--no-drain", action="store_true",
                     help="peek without consuming the span rings")
     ap.add_argument("--no-slo", action="store_true",
@@ -107,12 +141,17 @@ def main(argv=None):
     # the dead, who answer nothing, contribute through their files
     live_pids = {p.get("pid") for p in tel["parts"]}
     jsonl_parts = []
+    torn = 0
     for path in args.jsonl:
         jp = jsonl_to_part(path)
+        torn += jp.get("torn_records", 0)
         if jp.get("pid") is not None and jp["pid"] in live_pids:
             continue
         jsonl_parts.append(jp)
     parts = tel["parts"] + jsonl_parts
+    if torn and not args.json:
+        print(f"WARNING: skipped {torn} torn/garbled evidence record(s) "
+              "— stream(s) truncated mid-line (SIGKILL?)")
 
     # dedupe by pid: parts from one process share one registry (an
     # in-process LocalReplica fleet); merging each copy would multiply
@@ -125,7 +164,8 @@ def main(argv=None):
         uniq.append(p.get("metrics") or {})
     merged_metrics = merge_metrics(uniq)
     out = {"parts": [{"pid": p.get("pid"), "role": p.get("role"),
-                      "spans": len(p.get("spans") or ())} for p in parts]}
+                      "spans": len(p.get("spans") or ())} for p in parts],
+           "torn_records": torn}
 
     if args.trace:
         doc = merge_chrome_parts(parts, metrics=merged_metrics)
@@ -137,7 +177,8 @@ def main(argv=None):
                   f"-> {args.trace}")
 
     if args.prom:
-        text = parts_to_prometheus(parts)
+        text = parts_to_prometheus(parts,
+                                   openmetrics=not args.prom_strict)
         if args.prom == "-":
             sys.stdout.write(text)
         else:

@@ -542,6 +542,178 @@ def run_obs_overhead(model="mlp", duration=4.0, sample=0.1, clients=4,
             "ok": bool(pct < threshold_pct)}
 
 
+def run_prof_overhead(model="mlp", duration=4.0, hz=None, clients=4,
+                      max_batch_size=8, request_rows=1, threshold_pct=5.0,
+                      segments=5):
+    """What the BLACK-BOX plane costs, measured (docs/OBSERVABILITY.md
+    "Tail sampling"/"Continuous profiling"): closed-loop qps through the
+    full engine→batcher→socket stack in THREE interleaved configurations
+    against one endpoint —
+
+    - ``off``: no telemetry at all;
+    - ``plain``: the PR-7 span/metrics plane recording every request
+      durably (sample rate 1.0) — what "observe everything" already cost
+      before this plane existed;
+    - ``on``: telemetry + tail-mode buffering (every request's spans
+      into the pending buffer, retention verdict at root close) + the
+      continuous profiler at ``hz`` (``MXNET_OBS_PROF_HZ``, default 67).
+
+    ``prof_overhead_pct`` — the gated number — is the plain→on delta:
+    what tail buffering + 67 Hz profiling ADD on top of recording
+    telemetry, mirroring how the PR 7/9 overhead legs each gate their
+    own plane's increment (the off→plain recording cost is PR 7's,
+    gated by ``--obs-overhead`` at its deployed sample rate; it is
+    reported here as ``record_overhead_pct`` for reference).
+    ``bench.py`` records + gates it under ``threshold_pct``: "record
+    everything, keep the interesting" only earns its place if the
+    keep-or-drop machinery is near-free on top of the recording.
+
+    Each configuration's ``segments`` segments interleave round-robin
+    and the best of each side is compared — the elastic_bench
+    methodology: host-load drift over a multi-second run otherwise lands
+    on whichever side happened to run last and swamps a small delta."""
+    from mxnet_tpu import obs, serve
+
+    net, arg, aux, feat = _build_model(model)
+    engine = serve.InferenceEngine(net, arg, aux,
+                                   max_batch_size=max_batch_size,
+                                   lint="off")
+    engine.warmup(feat)
+    srv = serve.ServeServer(engine, port=0, max_linger_ms=2.0)
+    srv.start()
+    addr = ("127.0.0.1", srv.port)
+    rng = np.random.RandomState(1)
+    payload = rng.rand(request_rows, *feat).astype(np.float32)
+
+    def segment(seg_s: float) -> float:
+        """Drive `clients` closed-loop threads for seg_s; return qps."""
+        done = [0] * clients
+        stop_at = time.perf_counter() + seg_s
+
+        def worker(i):
+            cli = serve.ServeClient(*addr)
+            n = 0
+            while time.perf_counter() < stop_at:
+                cli.infer(payload)
+                n += 1
+            done[i] = n
+            cli.close()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(done) / (time.perf_counter() - t0)
+
+    was_on = obs.enabled()
+    prev_rate = obs.context.sample_rate()
+    prev_stream = obs.trace.tracer.stream_path
+    tail_was_on = obs.tail.enabled()
+    prev_tail_buf = obs.tail.buffer()  # the CALLER's buffer + policy
+    prof_was_on = obs.profile.enabled()
+    prev_prof_hz = obs.profile.profiler.hz if prof_was_on else None
+    if prof_was_on:
+        # a caller-owned profiler sampling through the off/plain
+        # segments would charge its cost to the wrong side
+        obs.profile.stop()
+    seg_s = duration / max(segments, 1)
+    qps_off: list = []
+    qps_plain: list = []
+    qps_on: list = []
+    prof_samples = 0
+    prof_stacks = 0
+    prof_hz = float(hz) if hz else None
+
+    def cfg_off():
+        obs.tail.disable() if obs.tail.enabled() else None
+        obs.disable()
+
+    def cfg_plain():
+        if obs.tail.enabled():
+            obs.tail.disable()
+        obs.context.set_sample_rate(1.0)
+        obs.enable()
+
+    tail_buf = None
+
+    def cfg_on():
+        nonlocal tail_buf
+        obs.enable()
+        # re-attach the SAME buffer across segments so retain/drop
+        # counters accumulate (enable() would mint a fresh one)
+        if tail_buf is None:
+            tail_buf = obs.tail.enable()
+        else:
+            obs.tail.set_buffer(tail_buf)
+        return obs.profile.start(hz=hz)
+
+    try:
+        # warm all three paths once (connections, code paths, allocator)
+        cfg_off()
+        segment(min(seg_s, 1.0))
+        cfg_plain()
+        segment(min(seg_s, 1.0))
+        p = cfg_on()
+        segment(min(seg_s, 1.0))
+        obs.profile.stop()
+        for _ in range(max(segments, 1)):
+            cfg_off()
+            qps_off.append(segment(seg_s))
+            cfg_plain()
+            qps_plain.append(segment(seg_s))
+            prof = cfg_on()
+            qps_on.append(segment(seg_s))
+            st = prof.stats()
+            obs.profile.stop()
+            prof_samples += st["samples"]
+            prof_stacks = max(prof_stacks, st["distinct_stacks"])
+            prof_hz = st["hz"]
+        tail_stats = (tail_buf.stats() if tail_buf is not None else {})
+    finally:
+        obs.profile.stop()
+        if prof_was_on:
+            # the caller ran a continuous profiler before the bench (e.g.
+            # MXNET_OBS_PROF=1): restart one at their rate so post-bench
+            # flight-recorder bundles keep their profiler slice
+            obs.profile.start(hz=prev_prof_hz)
+        if tail_was_on:
+            # the bench swapped its own buffer in (cfg_on) — hand the
+            # caller's original back, retained log and policy intact
+            obs.tail.set_buffer(prev_tail_buf)
+        elif obs.tail.enabled():
+            obs.tail.disable()
+        obs.disable()
+        obs.context.set_sample_rate(prev_rate)
+        if was_on:
+            obs.enable(jsonl=prev_stream)  # resume the caller's stream
+        else:
+            obs.reset()  # telemetry was off: leave no residue
+        srv.stop()
+    best_off, best_plain, best_on = max(qps_off), max(qps_plain), max(qps_on)
+    pct = 100.0 * (best_plain - best_on) / best_plain if best_plain else 0.0
+    rec_pct = 100.0 * (best_off - best_plain) / best_off if best_off else 0.0
+    return {"model": model, "profiler_hz": prof_hz,
+            "duration_s": duration, "clients": clients,
+            "segments": len(qps_off),
+            "qps_off": round(best_off, 2),
+            "qps_plain": round(best_plain, 2),
+            "qps_on": round(best_on, 2),
+            "qps_off_segments": [round(q, 1) for q in qps_off],
+            "qps_plain_segments": [round(q, 1) for q in qps_plain],
+            "qps_on_segments": [round(q, 1) for q in qps_on],
+            "prof_samples": prof_samples,
+            "prof_distinct_stacks": prof_stacks,
+            "tail_retained": tail_stats.get("retained", 0),
+            "tail_dropped": tail_stats.get("dropped", 0),
+            "record_overhead_pct": round(rec_pct, 2),
+            "prof_overhead_pct": round(pct, 2),
+            "threshold_pct": threshold_pct,
+            "ok": bool(pct < threshold_pct)}
+
+
 def run_chaos_bench(model="mlp", duration=12.0, qps=120.0, replicas=3,
                     max_batch_size=8, max_linger_ms=2.0, deadline_ms=500.0,
                     request_rows=1, hedge_ms=None, kill_replica=0):
@@ -712,6 +884,14 @@ def main(argv=None):
                          "JSON; warns when over the 5%% budget)")
     ap.add_argument("--sample", type=float, default=0.1,
                     help="head-sampling rate for --obs-overhead")
+    ap.add_argument("--prof-overhead", action="store_true",
+                    help="measure the black-box plane's overhead: "
+                         "closed-loop qps with everything off vs tail-mode "
+                         "buffering + the continuous profiler at --hz "
+                         "(always prints JSON; warns over the 5%% budget)")
+    ap.add_argument("--hz", type=float, default=None,
+                    help="profiler sampling rate for --prof-overhead "
+                         "(default MXNET_OBS_PROF_HZ or 67)")
     ap.add_argument("--scale", action="store_true",
                     help="mesh-scaling bench: closed-loop qps through "
                          "tensor-parallel replica groups on dp 1/2/4 mesh "
@@ -753,6 +933,21 @@ def main(argv=None):
             print(f"WARNING: obs_overhead_pct={res['obs_overhead_pct']} "
                   f"exceeds the {res['threshold_pct']}% budget at "
                   f"sample={args.sample}", file=sys.stderr)
+        return 0
+
+    if args.prof_overhead:
+        if args.connect:
+            ap.error("--prof-overhead measures an in-process stack and "
+                     "cannot target --connect")
+        res = run_prof_overhead(model=args.model, duration=args.duration,
+                                hz=args.hz, clients=args.clients,
+                                max_batch_size=args.max_batch_size,
+                                request_rows=args.request_rows)
+        print(json.dumps(res, indent=1))
+        if not res["ok"]:
+            print(f"WARNING: prof_overhead_pct={res['prof_overhead_pct']} "
+                  f"exceeds the {res['threshold_pct']}% budget at "
+                  f"{res['profiler_hz']} Hz", file=sys.stderr)
         return 0
 
     if args.scale:

@@ -2,10 +2,13 @@
 """trace_report.py — terminal breakdown of one or MANY obs traces.
 
 Reads chrome-trace ``trace.json`` files (``mx.obs.export(...)`` /
-``tools/profile_step.py --trace-out`` / ``tools/fleet_report.py``) and/or
+``tools/profile_step.py --trace-out`` / ``tools/fleet_report.py``),
 JSONL event streams (``MXNET_OBS_JSONL=...`` — including the per-replica
-``replica-<pid>.jsonl`` evidence a SIGKILL'd fleet member leaves behind)
-and prints:
+``replica-<pid>.jsonl`` evidence a SIGKILL'd fleet member leaves behind),
+and/or **flight-recorder bundles** (``obs/blackbox.py`` —
+``blackbox-<pid>-*.json``, detected by their ``{"blackbox": 1}`` marker;
+their recent-event ring AND continuous-profiler samples join the timeline
+as that pid's lane) and prints:
 
 1. the per-phase time breakdown — every span name aggregated
    (count / total / mean / max / % of wall), step phases first;
@@ -55,20 +58,68 @@ def load_trace(path: str) -> Tuple[List[dict], List[dict], Optional[dict]]:
     return spans, instants, metrics
 
 
-def load_trace_meta(path: str):
+def _norm_seconds_event(ev: dict, spans: list, instants: list,
+                        meta: dict) -> None:
+    """File one seconds-based event dict (JSONL stream / blackbox bundle
+    schema) into the spans / instants / counter-sample collections."""
+    ph = ev.get("ph")
+    if ph == "X":
+        spans.append({"name": ev.get("name", "?"), "ts": ev.get("ts", 0.0),
+                      "dur": ev.get("dur", 0.0) or 0.0,
+                      "tid": ev.get("tid"),
+                      "pid": ev.get("pid"),
+                      "args": ev.get("args") or {}})
+    elif ph == "i":
+        instants.append({"name": ev.get("name", "?"),
+                         "ts": ev.get("ts", 0.0),
+                         "tid": ev.get("tid"),
+                         "pid": ev.get("pid"),
+                         "args": ev.get("args") or {}})
+    elif ph == "C":
+        args = ev.get("args") or {}
+        meta["counters"].append({
+            "name": ev.get("name", "?"), "ts": ev.get("ts", 0.0),
+            "tid": ev.get("tid"), "pid": ev.get("pid"),
+            "value": args.get("value", next(iter(args.values()), None))})
+
+
+def load_trace_meta(path: str, text=None):
     """``load_trace`` plus the file's merge metadata: ``{"pid",
-    "wall_epoch", "counters"}`` (pid/wall_epoch may be None on old
-    captures; counters are ``"C"`` counter-track samples — the
-    ``device.live_bytes`` memory lane)."""
-    with open(path) as f:
-        text = f.read()
-    meta = {"pid": None, "wall_epoch": None, "counters": []}
+    "wall_epoch", "counters", "skipped_lines", "blackbox_reason"}``
+    (pid/wall_epoch may be None on old captures; counters are ``"C"``
+    counter-track samples — the ``device.live_bytes`` memory lane;
+    skipped_lines counts torn/garbled JSONL records — a SIGKILL can end a
+    stream mid-line, which must never make the corpse unreadable).
+    ``text`` skips the file read when the caller already holds the
+    content (fleet_report probes the same file for the bundle schema)."""
+    if text is None:
+        with open(path) as f:
+            text = f.read()
+    meta = {"pid": None, "wall_epoch": None, "counters": [],
+            "skipped_lines": 0, "blackbox_reason": None}
     # chrome traces are one JSON document with "traceEvents"; JSONL lines
     # each start with "{" too, so try the whole-document parse first
     try:
         doc = json.loads(text)
     except ValueError:
         doc = None
+    if isinstance(doc, dict) and doc.get("blackbox") == 1:
+        # a flight-recorder bundle (obs/blackbox.py): the recent-event
+        # ring plus the continuous profiler's sample lane, one pid
+        spans, instants = [], []
+        meta["pid"] = doc.get("pid")
+        meta["wall_epoch"] = doc.get("wall_epoch")
+        meta["blackbox_reason"] = doc.get("reason")
+        events = [e for e in (doc.get("events") or ())
+                  if isinstance(e, dict)]
+        prof = doc.get("profiler") or {}
+        events.extend(e for e in (prof.get("samples") or ())
+                      if isinstance(e, dict))
+        for ev in events:
+            _norm_seconds_event(ev, spans, instants, meta)
+        spans.sort(key=lambda e: e["ts"])
+        instants.sort(key=lambda e: e["ts"])
+        return spans, instants, doc.get("metrics"), meta
     if isinstance(doc, dict) and "traceEvents" in doc:
         spans, instants = [], []
         for ev in doc.get("traceEvents", []):
@@ -106,32 +157,23 @@ def load_trace_meta(path: str):
         try:
             ev = json.loads(line)
         except ValueError:
-            continue  # torn final line after a SIGKILL
+            # torn final line after a SIGKILL: skip it, COUNT it — the
+            # report surfaces the count so a truncated corpse is visible
+            # without ever being unreadable
+            meta["skipped_lines"] += 1
+            continue
+        if not isinstance(ev, dict):
+            meta["skipped_lines"] += 1
+            continue
         ph = ev.get("ph")
-        if ph == "X":
-            spans.append({"name": ev["name"], "ts": ev.get("ts", 0.0),
-                          "dur": ev.get("dur", 0.0),
-                          "tid": ev.get("tid"),
-                          "pid": ev.get("pid"),
-                          "args": ev.get("args") or {}})
-        elif ph == "i":
-            instants.append({"name": ev["name"], "ts": ev.get("ts", 0.0),
-                             "tid": ev.get("tid"),
-                             "pid": ev.get("pid"),
-                             "args": ev.get("args") or {}})
-        elif ph == "C":
-            args = ev.get("args") or {}
-            meta["counters"].append({
-                "name": ev["name"], "ts": ev.get("ts", 0.0),
-                "tid": ev.get("tid"), "pid": ev.get("pid"),
-                "value": args.get("value",
-                                  next(iter(args.values()), None))})
-        elif ph == "M":
+        if ph == "M":
             if "metrics" in ev:
                 metrics = ev["metrics"]
             if ev.get("name") == "clock":  # the stream's first record
                 meta["pid"] = ev.get("pid", meta["pid"])
                 meta["wall_epoch"] = ev.get("wall_epoch")
+        else:
+            _norm_seconds_event(ev, spans, instants, meta)
     return spans, instants, metrics, meta
 
 
@@ -165,7 +207,9 @@ def merge_loaded(loaded: List[tuple]) -> tuple:
     each file's wall-clock anchor. Returns ``(spans, instants, metrics,
     lanes, clock_note, counters)`` — ``clock_note`` is None only when
     EVERY file carried an anchor (cross-file timestamps are then
-    trustworthy); ``counters`` are the merged counter-track samples."""
+    trustworthy); ``counters`` are the merged counter-track samples.
+    Per-lane ``torn`` counts surface each file's skipped (truncated)
+    records; ``blackbox`` marks flight-recorder bundle lanes."""
     anchors = [m["wall_epoch"] for *_rest, m in loaded
                if m["wall_epoch"] is not None]
     base = min(anchors) if anchors else 0.0
@@ -199,6 +243,10 @@ def merge_loaded(loaded: List[tuple]) -> tuple:
             n += 1
         lanes[str(fallback_pid)] = {"file_index": i, "events": n,
                                     "wall_epoch": meta["wall_epoch"]}
+        if meta.get("skipped_lines"):
+            lanes[str(fallback_pid)]["torn"] = meta["skipped_lines"]
+        if meta.get("blackbox_reason"):
+            lanes[str(fallback_pid)]["blackbox"] = meta["blackbox_reason"]
         # one registry per PROCESS: two files from one pid (a JSONL stream
         # plus an export, say) snapshot the same registry — summing both
         # copies would double every count
@@ -265,6 +313,36 @@ def device_cost_table(instants: List[dict], top: int = 10) -> List[dict]:
     return rows[:top]
 
 
+def profiler_section(spans: List[dict]) -> Optional[dict]:
+    """The continuous profiler's lane (``obs/profile.py`` — ``prof:<phase>``
+    spans, in live telemetry parts and flight-recorder bundles alike)
+    aggregated by phase: sample counts and approximate seconds, hottest
+    first — "what were this process's last seconds spent on". None when
+    no profiler lane is present."""
+    agg = {}
+    for s in spans:
+        if not s["name"].startswith("prof:"):
+            continue
+        phase = s["name"][5:] or "?"
+        a = s.get("args") or {}
+        ent = agg.setdefault(phase, {"phase": phase, "samples": 0,
+                                     "seconds": 0.0, "leaves": {}})
+        n = a.get("samples", 1) or 1
+        ent["samples"] += n
+        ent["seconds"] += s.get("dur", 0.0) or 0.0
+        leaf = a.get("leaf")
+        if leaf:
+            ent["leaves"][leaf] = ent["leaves"].get(leaf, 0) + n
+    if not agg:
+        return None
+    rows = sorted(agg.values(), key=lambda e: -e["seconds"])
+    for r in rows:
+        top = sorted(r["leaves"].items(), key=lambda kv: -kv[1])[:3]
+        r["top_leaves"] = [k for k, _ in top]
+        del r["leaves"]
+    return {"phases": rows}
+
+
 def health_section(instants: List[dict], counters: List[dict],
                    metrics: Optional[dict]) -> Optional[dict]:
     """The training-health story in one block: the loss / grad-norm counter
@@ -307,17 +385,20 @@ def report(paths, top: int = 10, _loaded=None) -> dict:
     loaded = _loaded if _loaded is not None \
         else [load_trace_meta(p) for p in paths]
     spans, instants, metrics, lanes, note, counters = merge_loaded(loaded)
+    torn = sum(info.get("torn", 0) for info in lanes.values())
     out = {
         "trace": paths[0] if len(paths) == 1 else list(paths),
         "n_spans": len(spans),
         "n_events": len(instants),
         "lanes": lanes,
         "clock_note": note,
+        "torn_records": torn,
         "phases": phase_breakdown(spans),
         "top_spans": sorted(spans, key=lambda s: -s["dur"])[:top],
         "events": instants,
         "counters": counter_tracks(counters),
         "device_programs": device_cost_table(instants, top=top),
+        "profiler": profiler_section(spans),
         "health": health_section(instants, counters, metrics),
         "metrics": metrics,
     }
@@ -394,10 +475,15 @@ def render(rep: dict, stream=None) -> None:
     lanes = rep.get("lanes") or {}
     if len(lanes) > 1:
         w("lanes: " + ", ".join(
-            f"pid {p} ({info['events']} ev)"
+            f"pid {p} ({info['events']} ev"
+            + (f", blackbox:{info['blackbox']}" if info.get("blackbox")
+               else "") + ")"
             for p, info in sorted(lanes.items())) + "\n")
     if rep.get("clock_note"):
         w(f"NOTE: {rep['clock_note']}\n")
+    if rep.get("torn_records"):
+        w(f"WARNING: skipped {rep['torn_records']} torn/garbled "
+          "record(s) — a stream truncated mid-line (SIGKILL?)\n")
     w("\n")
 
     w("Per-phase breakdown:\n")
@@ -432,6 +518,14 @@ def render(rep: dict, stream=None) -> None:
               f"{p['flops'] / 1e9:>10.4g}"
               f"{p['bytes_accessed'] / 1e6:>13.4g}"
               f"{p['peak_hbm_bytes'] / 1e6:>13.4g}\n")
+
+    prof = rep.get("profiler")
+    if prof:
+        w("\nContinuous profiler (by phase):\n")
+        w(f"  {'Phase':<28}{'Samples':>8}{'~Seconds':>10}  Top frames\n")
+        for r in prof["phases"]:
+            w(f"  {r['phase']:<28}{r['samples']:>8}{r['seconds']:>10.3f}  "
+              f"{', '.join(r['top_leaves'])}\n")
 
     h = rep.get("health")
     if h:
