@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart
 
 # repo self-lint: framework invariants + the concurrency-correctness pass
 # (lock-order cycles, blocking-under-lock, CV/thread discipline, wire
@@ -102,6 +102,20 @@ dossier:
 health:
 	$(PYTHON) -m pytest tests/ -q -m health -p no:cacheprovider
 	$(PYTHON) tools/health_bench.py
+
+# persistent AOT program cache (docs/PERFORMANCE.md "Program cache and
+# cold start"): key-derivation/hit/miss/reject units, bitwise parity of
+# cache-hit vs fresh-compile execution, fused-update dispatch bound on
+# hits, ProcReplica restart-warms-from-disk chaos leg, keep-last-N GC
+progcache:
+	$(PYTHON) -m pytest tests/ -q -m progcache -p no:cacheprovider
+
+# cold-vs-warm cold-start A/B on CPU with the gated assertion (warm start
+# performs ZERO fresh XLA compiles — every compile_log entry a cache_hit;
+# strictly fewer compiles than cold), so a program-key-stability
+# regression fails here, not a TPU round later
+coldstart: progcache
+	$(PYTHON) tools/serve_bench.py --cold
 
 # serving suite: compiled engine program bound, SLO scheduler, endpoint
 # lifecycle + chaos degradation (docs/SERVING.md)

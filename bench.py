@@ -500,6 +500,29 @@ def bench_serve(platform):
             "buckets": res.get("buckets")}
 
 
+def bench_cold_start(platform):
+    """Replica cold start, cold vs warmed persistent program cache
+    (docs/PERFORMANCE.md "Program cache and cold start"): two ProcReplica
+    spawns against the same cache dir — the first compiles every bucket,
+    the second deserializes them. ``cold_start_to_ready_s`` (the warm
+    number) is the trajectory gain; the compile counts are the
+    deterministic key-stability gate (`make coldstart` asserts them)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    model = os.environ.get("BENCH_COLD_MODEL",
+                           "resnet18_v1" if platform == "tpu" else "mlp")
+    res = serve_bench.run_cold_bench(
+        model=model,
+        max_batch_size=int(os.environ.get("BENCH_SERVE_BATCH", 8)))
+    assert res["ok"], (
+        f"warm start performed {res['fresh_compiles_warm']} fresh XLA "
+        f"compile(s) (cold: {res['fresh_compiles_cold']}) — program-cache "
+        "keys are unstable across processes")
+    return res
+
+
 def bench_serve_scale(platform):
     """Mesh-sharded serving scaling (docs/SERVING.md "Mesh-sharded serving
     and elastic autoscaling"): closed-loop serve_qps through dp∈{1,2,4}
@@ -862,6 +885,16 @@ def main():
             extra["serve"] = bench_serve(platform)
         except Exception as e:
             extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("cold_start"):
+        try:
+            # persistent AOT program cache (docs/PERFORMANCE.md "Program
+            # cache and cold start"): replica spawn-to-ready, cold vs
+            # warmed cache — cold_start_to_ready_s is the first-class
+            # trajectory metric next to serve_qps (a fleet autoscaler
+            # waits on exactly this number)
+            extra["cold_start"] = bench_cold_start(platform)
+        except Exception as e:
+            extra["cold_start_error"] = f"{type(e).__name__}: {e}"[:200]
     if not over_budget("serve_scale"):
         try:
             # serve throughput vs data-parallel replica groups on mesh
@@ -959,6 +992,7 @@ def main():
         "lm_seq2048": "lm_seq2048_bf16",
         "lm_seq4096": "lm_seq4096_bf16",
         "serve": "serve",
+        "cold_start": "cold_start",
         "serve_scale": "serve_scale",
         "serve_ramp": "serve_ramp",
         "obs_overhead": "obs_overhead",

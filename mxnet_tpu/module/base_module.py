@@ -147,6 +147,14 @@ class BaseModule:
         # boundary activates it, and then resumes from the newest shared
         # checkpoint (which the survivors flushed before that same
         # boundary's rendezvous): the checkpointed rejoin
+        # a manager built from a bare directory is ours to close at the end;
+        # a caller-supplied manager outlives the fit (only flushed).
+        # (Resolved BEFORE the elastic join: a quarantined rejoiner warms
+        # its update program from the newest shared checkpoint while it
+        # waits for the activation boundary.)
+        owns_manager = not isinstance(checkpoint, CheckpointManager)
+        manager = as_manager(checkpoint)
+
         elastic = getattr(kvstore, "elastic", None) \
             if not isinstance(kvstore, str) else None
         elastic_info = None
@@ -157,6 +165,13 @@ class BaseModule:
                     "elastic: quarantined (generation %d, fleet at epoch "
                     "%d) — waiting for the next epoch boundary",
                     elastic_info.generation, elastic_info.epoch)
+                # persistent program cache (docs/PERFORMANCE.md "Program
+                # cache and cold start"): compile — or deserialize — the
+                # fused update step NOW, overlapping the quarantine wait,
+                # so activation → first lockstep reduce never stalls the
+                # live fleet on this rank's XLA compile
+                self._prewarm_update_programs(manager, optimizer,
+                                              optimizer_params, train_data)
                 elastic_info = elastic.await_activation()
                 self.logger.info(
                     "elastic: activated at epoch %d generation %d, shard "
@@ -169,10 +184,6 @@ class BaseModule:
                 except NotImplementedError:
                     pass  # keep the construction-time shard
 
-        # a manager built from a bare directory is ours to close at the end;
-        # a caller-supplied manager outlives the fit (only flushed)
-        owns_manager = not isinstance(checkpoint, CheckpointManager)
-        manager = as_manager(checkpoint)
         if isinstance(resume, bool):  # bool is an int: keep True out of the
             resume = "auto" if resume else "never"  # pinned-step branch
         resume_state = None
@@ -522,6 +533,59 @@ class BaseModule:
         if not root:
             for t, v in zip(targets, vals):
                 t._set_data(nd_array(np.asarray(v, t.dtype))._data)
+
+    def _prewarm_update_programs(self, manager, optimizer, optimizer_params,
+                                 train_data) -> bool:
+        """Best-effort elastic-rejoin warm (mxnet_tpu/progcache.py): build
+        the fused update step's program for the parameter set in the
+        newest SHARED checkpoint — deserializing from the persistent cache
+        when a previous life of this worker (or any peer on this host)
+        already compiled it, compiling into the cache otherwise — without
+        touching optimizer counters or weights. Runs while quarantined, so
+        the cost overlaps the activation wait; a mismatch in derived keys
+        just means the real first step misses the cache (the pre-PR cost),
+        never a wrong program. Returns whether a program was warmed."""
+        from .. import progcache
+
+        if not progcache.active() or manager is None:
+            return False
+        try:
+            state = manager.load_latest()
+            if state is None:
+                return False
+            arg_params = state.arg_params()
+            fixed = getattr(self, "_fixed_param_names", set())
+            names = [n for n in getattr(self, "_param_names", [])
+                     if n not in fixed]
+            if not names or any(n not in arg_params for n in names):
+                return False
+            from ..ndarray import array as nd_array
+            from ..optimizer import create as opt_create
+            from ..optimizer.optimizer import Optimizer, Updater
+
+            if isinstance(optimizer, Optimizer):
+                opt = optimizer
+            else:
+                # mirror Module.init_optimizer's construction (incl. the
+                # 1/batch rescale default) so the static key matches the
+                # one the real engine derives after activation
+                params = dict(optimizer_params or {})
+                provide = getattr(train_data, "provide_data", None)
+                if "rescale_grad" not in params and provide:
+                    params["rescale_grad"] = 1.0 / provide[0][1][0]
+                opt = opt_create(optimizer, **params)
+            indices = [i for i, n in enumerate(
+                getattr(self, "_param_names", [])) if n not in fixed]
+            weights = [nd_array(np.asarray(arg_params[n])) for n in names]
+            warmed = Updater(opt).prewarm_batch(indices, weights)
+            if warmed:
+                self.logger.info(
+                    "elastic: fused update program warmed from the "
+                    "persistent cache while quarantined")
+            return warmed
+        except Exception as e:  # noqa: BLE001 — warm is strictly optional
+            self.logger.debug("progcache prewarm skipped: %s", e)
+            return False
 
     def _elastic_sync_grads(self, kv):
         """Mean-allreduce this step's gradients over the live fleet (one

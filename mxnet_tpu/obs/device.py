@@ -19,8 +19,10 @@ per-op device stats and an ``aggregate_stats`` memory table (TBV, SURVEY.md
   compile, measured and run. Records mirror into ``device.*`` metrics and a
   ``device.compile`` instant event (the top-programs table in
   ``tools/trace_report.py``). The (site, label) → cost registry here is
-  the program-identity/cost store the AOT compile cache (ROADMAP item 4)
-  will key off.
+  the program-identity/cost store the persistent AOT program cache
+  (``mxnet_tpu/progcache.py``) keys off — both derive identity through
+  ``progcache.program_key``, so a cached program and its cost record can
+  never disagree.
 - **MFU/roofline attribution** (:func:`attribute`): folding an execute
   span's wall duration with its program's cost record gives analytic MFU
   (``flops / dt / peak``) and a roofline class — compute-bound when the
@@ -174,7 +176,8 @@ def analyze_compiled(compiled) -> dict:
     return cost
 
 
-def capture(jitted, args: tuple, site: str, label: str, kwargs=None):
+def capture(jitted, args: tuple, site: str = None, label: str = None,
+            kwargs=None, key=None):
     """AOT-compile ``jitted`` (a ``jax.jit`` wrapper) for the given example
     ``args`` and return ``(compiled, cost)``.
 
@@ -183,15 +186,41 @@ def capture(jitted, args: tuple, site: str, label: str, kwargs=None):
     tax). On any failure (exotic backend, lowering restriction) returns
     ``(None, None)`` and the caller stays on its ``jax.jit`` path —
     capture must never break dispatch.
+
+    ``key`` takes a :class:`~mxnet_tpu.progcache.ProgramKey` — the ONE
+    shared program-identity derivation (``progcache.program_key``): the
+    registry files under its (site, label) and the cost record carries
+    its digest, so the device plane, ``compile_log`` entries, and the
+    persistent program cache can never key the same program differently.
     """
+    if key is not None:
+        site, label = key.site, key.label
     try:
         lowered = jitted.lower(*args, **(kwargs or {}))
         compiled = lowered.compile()
     except Exception:  # lint-ok: fall back to the jit path, never raise
         return None, None
     cost = analyze_compiled(compiled)
+    if key is not None:
+        cost = dict(cost, program_key=key.digest)
     record(site, label, cost)
     return compiled, cost
+
+
+def adopt_cached_cost(key, meta: dict) -> dict:
+    """Cost salvage for a persistent program-cache hit
+    (``mxnet_tpu/progcache.py``): the writer's compile-time cost analysis
+    rides the cache entry's metadata, so the registry/MFU attribution work
+    on hits without re-analyzing. Filters ``meta`` down to
+    :data:`COST_FIELDS` and — when the device plane records — files it
+    under the entry's shared ProgramKey. Returns the cost dict, ``{}``
+    when the writer captured none (callers skip an all-zero record)."""
+    cost = {k: meta[k] for k in COST_FIELDS if k in meta}
+    if not any(cost.values()):
+        return {}
+    if active():
+        record(key.site, key.label, dict(cost, program_key=key.digest))
+    return cost
 
 
 def record(site: str, label: str, cost: dict) -> None:

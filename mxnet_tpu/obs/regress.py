@@ -91,6 +91,11 @@ GAIN_SPECS = (
      "extra.lm_seq4096_bf16.flash.spread", True),
     ("serve_qps", "extra.serve.serve_qps", None, True),
     ("serve_p99_ms", "extra.serve.serve_p99_ms", None, False),
+    # replica spawn → readiness-probe-OK with a WARMED persistent program
+    # cache (progcache.py; the cold twin rides extra.cold_start.cold_s) —
+    # the fleet-elasticity number: what autoscale scale-out actually waits
+    ("cold_start_to_ready_s", "extra.cold_start.cold_start_to_ready_s",
+     None, False),
 )
 
 

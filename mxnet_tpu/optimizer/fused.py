@@ -479,34 +479,16 @@ class FusedUpdateEngine:
         jitted = self._cache.get(key)
         is_compile = jitted is None
         if is_compile:
-            jitted = self._build(specs, mp, scaler_on, factor, window, cgn_on,
-                                 health_on)
-            entry = {
-                "optimizer": type(opt).__name__,
-                "static": self._static_key(),
-                "avals": key[4],
-                "state_structure": specs,
-                "flags": (scaler_on, cgn_on, health_on),
-            }
-            if _device.active():
-                # ONE compile serves accounting and execution: the AOT
-                # executable replaces the jit wrapper in the cache, and its
-                # XLA cost/memory analyses land in this compile_log entry
-                compiled, cost = _device.capture(
-                    jitted, (ws, gs, state_leaves, lrs, wds, ts, rescale,
-                             scale, unskipped, streak_in, cgn_val, extras),
-                    site="update", label=type(opt).__name__)
-                if compiled is not None:
-                    jitted = compiled
-                if cost:
-                    entry.update(cost)
-                    self._costs[key] = cost
+            example = (ws, gs, state_leaves, lrs, wds, ts, rescale,
+                       scale, unskipped, streak_in, cgn_val, extras)
+            jitted, entry = self._compile(key, example)
             self._cache[key] = jitted
             self.compile_log.append(entry)
             # telemetry: every compile counts; a compile AFTER the first is
             # a retrace (something static churned — the TraceLinter's
             # update-retrace-churn rule diagnoses which component)
-            obs.inc("update.compile")
+            obs.inc("update.cache_hit" if entry.get("cache_hit")
+                    else "update.compile")
             if len(self.compile_log) > 1:
                 obs.inc("update.retrace")
 
@@ -569,6 +551,127 @@ class FusedUpdateEngine:
                 self.last_health["skip_streak"] = scaler_out[3]
         else:
             self.last_health = None
+
+    # -- persistent program cache -----------------------------------------
+    def _program_key(self, key):
+        """The fused step's :class:`~mxnet_tpu.progcache.ProgramKey` —
+        the in-process memo ``key`` canonicalized through the ONE shared
+        derivation (``progcache.program_key``), so the device-plane cost
+        registry, this engine's ``compile_log``, and the persistent cache
+        agree on the program's identity byte for byte."""
+        from .. import progcache as _progcache
+
+        return _progcache.program_key("update", type(self.optimizer).__name__,
+                                      key)
+
+    def _compile(self, key, example):
+        """Resolve one cache-key miss to an executable + its compile_log
+        entry: persistent-cache hit (deserialize the stored executable —
+        zero fresh XLA work) > AOT compile with device-cost capture >
+        plain ``jax.jit``. A corrupt/stale/foreign entry was already
+        counted as a reject by the cache and lands here as a miss."""
+        from .. import progcache as _progcache
+
+        opt = self.optimizer
+        (_, _, specs, mp, _, _, _, scaler_on, factor, window, cgn_on,
+         health_on, _) = key
+        entry = {
+            "optimizer": type(opt).__name__,
+            "static": self._static_key(),
+            "avals": key[4],
+            "state_structure": specs,
+            "flags": (scaler_on, cgn_on, health_on),
+        }
+        _device = obs.device
+        pc = _progcache.cache()
+        pk = None
+        if pc is not None:
+            pk = self._program_key(key)
+            entry["program_key"] = pk.digest
+            cached = pc.get(pk)
+            if cached is not None:
+                entry["cache_hit"] = True
+                cost = _device.adopt_cached_cost(pk, cached.meta)
+                if cost:
+                    entry.update(cost)
+                    self._costs[key] = cost
+                return cached.executable, entry
+        entry["cache_hit"] = False
+        jitted = self._build(specs, mp, scaler_on, factor, window, cgn_on,
+                             health_on)
+        compiled = cost = None
+        if _device.active():
+            # ONE compile serves accounting and execution: the AOT
+            # executable replaces the jit wrapper in the cache, and its
+            # XLA cost/memory analyses land in this compile_log entry
+            compiled, cost = _device.capture(jitted, example, site="update",
+                                             label=type(opt).__name__,
+                                             key=pk)
+        elif pc is not None:  # cache armed, cost capture vetoed: plain AOT
+            compiled = _progcache.aot_compile(jitted, example)
+            cost = (_device.analyze_compiled(compiled)
+                    if compiled is not None else None)
+        if compiled is not None:
+            if pc is not None:
+                pc.put(pk, compiled, meta=dict(cost or {}))
+            jitted = compiled
+        if cost:
+            entry.update(cost)
+            self._costs[key] = cost
+        return jitted, entry
+
+    def prewarm(self, indices, weights, grads, states, loss_scaler=None,
+                clip_global_norm=None) -> bool:
+        """Populate the compile cache for the step ``apply`` would run on
+        these tensors — WITHOUT executing it or touching optimizer
+        counters. The elastic-rejoin path calls this while quarantined so
+        the compile/deserialize overlaps the wait for the activation
+        boundary instead of stalling the fleet's first lockstep reduce.
+        Returns True when the program is now cached (either source)."""
+        opt = self.optimizer
+        if not self.supported():
+            return False
+        n = len(indices)
+        # example traced scalars only — values never shape the program
+        lrs = np.zeros(n, np.float32)
+        wds = np.zeros(n, np.float32)
+        ts = np.ones(n, np.float32)
+        rescale = np.float32(opt.rescale_grad)
+        mp = tuple(bool(opt._use_mp(w)) for w in weights)
+        specs = tuple(_state_spec(s) for s in states)
+        ws = tuple(w._data for w in weights)
+        gs = tuple(g._data for g in grads)
+        state_leaves = []
+        for s in states:
+            lv: list = []
+            _state_leaves(s, lv)
+            state_leaves.append(tuple(x._data for x in lv))
+        state_leaves = tuple(state_leaves)
+        scaler_on = loss_scaler is not None
+        cgn_on = clip_global_norm is not None and clip_global_norm > 0
+        if scaler_on:
+            factor = float(loss_scaler._factor)
+            window = int(loss_scaler._window)
+        else:
+            factor, window = 2.0, 0
+        health_on = obs.health.stats_for_this_step()
+        key = (type(opt), self._static_key(), specs, mp,
+               tuple(self._aval(x) for x in ws),
+               tuple(self._aval(x) for x in gs),
+               tuple(tuple(self._aval(x) for x in lp) for lp in state_leaves),
+               scaler_on, factor, window, cgn_on, health_on, self._donate)
+        if key in self._cache:
+            return True
+        example = (ws, gs, state_leaves, lrs, wds, ts, rescale,
+                   np.float32(1), np.int32(0), np.int32(0),
+                   np.float32(clip_global_norm if cgn_on else 0.0),
+                   _extras_prep(opt, n))
+        jitted, entry = self._compile(key, example)
+        self._cache[key] = jitted
+        self.compile_log.append(entry)
+        obs.event("progcache.prewarm", optimizer=type(opt).__name__,
+                  cache_hit=bool(entry.get("cache_hit")))
+        return True
 
     # -- compile -----------------------------------------------------------
     def _build(self, specs, mp, scaler_on, factor, window, cgn_on,

@@ -415,6 +415,32 @@ class Updater:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
 
+    def prewarm_batch(self, indices, weights, loss_scaler=None,
+                      clip_global_norm=None) -> bool:
+        """Compile (or deserialize from the persistent program cache) the
+        fused step for this parameter set WITHOUT running it — optimizer
+        counters, weights, and states are untouched. The elastic-rejoin
+        path warms here while quarantined (docs/PERFORMANCE.md "Program
+        cache and cold start"). Returns True when the program is cached."""
+        from .fused import fused_update_enabled
+
+        if not fused_update_enabled() or len(set(indices)) != len(indices):
+            return False
+        eng = self._get_engine()
+        if not eng.supported():
+            return False
+        # existing states are reused; missing ones are built LOCALLY and
+        # discarded — only their aval structure shapes the program, and
+        # persisting a state derived from prewarm-time weights would seed
+        # the real first update with a stale fp32 master copy
+        states = [self.states.get(i) if i in self.states
+                  else self.optimizer.create_state_multi_precision(i, w)
+                  for i, w in zip(indices, weights)]
+        grads = [w.zeros_like() for w in weights]
+        return eng.prewarm(indices, weights, grads, states,
+                           loss_scaler=loss_scaler,
+                           clip_global_norm=clip_global_norm)
+
     def update_batch(self, indices, grads, weights, loss_scaler=None,
                      clip_global_norm=None):
         """Update a whole parameter set at once. Fused-by-default: one donated

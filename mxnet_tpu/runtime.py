@@ -180,6 +180,28 @@ _ENV_REGISTRY = {
     "MXNET_OBS_BLACKBOX_PROF_S": ("10", "Seconds of profiler samples a "
                                   "bundle embeds (a bounded slice of the "
                                   "ring, not all ~16 min of it)."),
+    # persistent AOT program cache (mxnet_tpu/progcache.py,
+    # docs/PERFORMANCE.md "Program cache and cold start")
+    "MXNET_PROGCACHE": (None, "1 = arm the persistent AOT program cache "
+                        "at the default dir (~/.cache/mxnet_tpu/"
+                        "progcache); 0 = veto even with a dir set. "
+                        "Serve-bucket and fused-update programs warm "
+                        "across processes by deserializing the stored "
+                        "executable (same machine code — bitwise) instead "
+                        "of recompiling."),
+    "MXNET_PROGCACHE_DIR": (None, "Program-cache directory (setting it "
+                            "arms the cache). Inherited by ProcReplica "
+                            "children, so autoscale scale-out and "
+                            "restart-after-SIGKILL warm from disk; a "
+                            "stale/foreign/corrupt entry is a counted "
+                            "reject that degrades to a plain compile."),
+    "MXNET_PROGCACHE_KEEP": ("128", "Keep-last-N GC bound: most recently "
+                             "USED entries kept (reads touch mtime), "
+                             "older ones dropped after each write."),
+    "MXNET_SERVE_WARMUP_THREADS": (None, "Thread-pool width for "
+                                   "InferenceEngine.warmup's concurrent "
+                                   "per-bucket compiles (default "
+                                   "min(buckets, cores); 1 = serial)."),
     # distributed (DMLC_* names kept for launcher compat)
     "DMLC_ROLE": (None, "worker|server|scheduler — set by tools/launch.py."),
     "DMLC_PS_ROOT_URI": (None, "Coordinator/PS host (reference ps-lite env)."),

@@ -69,7 +69,8 @@ from .fleet import (CircuitBreaker, FleetServer, LocalReplica, ProcReplica,
                     ReplicaPool, Router)
 from .autoscale import Autoscaler, AutoscalePolicy
 
-__all__ = ["load", "load_params", "InferenceEngine", "DynamicBatcher",
+__all__ = ["load", "load_params", "ship_programs", "programs_dir_for",
+           "InferenceEngine", "DynamicBatcher",
            "Future", "ServeServer", "ServeClient", "ServeError",
            "RequestRejected", "DeadlineExceeded", "Draining",
            "default_buckets", "CircuitBreaker", "FleetServer",
@@ -151,13 +152,74 @@ def _load_artifact(path: str, epoch: Optional[int], symbol,
     raise ServeError(f"unrecognized artifact descriptor {sym_file}")
 
 
+def programs_dir_for(path: str) -> str:
+    """The conventional location of an artifact's shipped program-cache
+    payload (``mxnet_tpu/progcache.py``): ``<dir>/programs`` for a
+    checkpoint-manager directory, ``<prefix>-programs`` for the file
+    kinds. ``ship_programs`` writes it; ``load`` auto-discovers it."""
+    if os.path.isdir(path):
+        return os.path.join(path, "programs")
+    return f"{path}-programs"
+
+
+def ship_programs(engine: InferenceEngine, path: str) -> int:
+    """Export ``engine``'s compiled bucket executables as the artifact's
+    ``programs/`` payload, so every process that ``load``s the artifact
+    warms by deserializing instead of compiling (O(load) cold start —
+    docs/PERFORMANCE.md "Program cache and cold start"). For a gluon
+    export, the descriptor json additionally records the payload dirname.
+    Returns the number of programs written."""
+    d = programs_dir_for(path)
+    n = engine.save_programs(d)
+    if n == 0:
+        # a payload dir with nothing in it (backend refused every export)
+        # must not exist: load() would auto-discover it and let the empty
+        # dir override a populated env-armed cache
+        try:
+            os.rmdir(d)
+        except OSError:
+            pass  # non-empty (foreign files) or already gone — leave it
+    sym_file = f"{path}-symbol.json"
+    if n and os.path.exists(sym_file):
+        try:
+            with open(sym_file) as f:
+                desc = json.load(f)
+            if isinstance(desc, dict) \
+                    and desc.get("format") == "mxnet_tpu-hybrid":
+                from ..checkpoint.atomic import atomic_write_json
+
+                desc["programs"] = os.path.basename(d)
+                atomic_write_json(sym_file, desc)
+        except (OSError, ValueError):
+            pass  # the payload still loads by the dir convention
+    return n
+
+
+def _discover_programs(path: str) -> Optional[str]:
+    d = programs_dir_for(path)
+    try:
+        # only a payload with at least one entry beats the env-armed
+        # cache — an empty/foreign dir is no payload at all
+        if any(e.endswith(".mxprog") for e in os.listdir(d)):
+            return d
+    except OSError:
+        pass
+    return None
+
+
 def load(path: str, epoch: Optional[int] = None, symbol=None, *,
          prefix: str = "ckpt", **engine_kwargs) -> InferenceEngine:
     """Build an :class:`InferenceEngine` from any trained artifact (see
     the module docstring for the three artifact kinds). Extra kwargs go to
     the engine (``max_batch_size``, ``buckets``, ``data_names``,
-    ``lint``)."""
+    ``lint``). An artifact shipping a ``programs/`` payload
+    (:func:`ship_programs`) becomes the engine's program cache — its
+    buckets warm from disk."""
     sym, arg, aux = _load_artifact(path, epoch, symbol, prefix)
+    if "progcache_dir" not in engine_kwargs:
+        shipped = _discover_programs(path)
+        if shipped is not None:
+            engine_kwargs["progcache_dir"] = shipped
     return InferenceEngine(sym, arg, aux, **engine_kwargs)
 
 

@@ -90,6 +90,15 @@ def _shape_of(v) -> tuple:
     return tuple(int(d) for d in s)
 
 
+def _sig_of(arrays) -> tuple:
+    """THE program signature of a (padded) batch — ``infer``'s accounting
+    key and ``warmup``'s already-compiled filter both derive through this
+    one function, so the two can never silently drift apart (a mismatch
+    would make every warmup re-run full inferences instead of returning
+    0 on the second call)."""
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+
 def default_buckets(max_batch_size: int) -> List[int]:
     """Power-of-two batch buckets up to ``max_batch_size`` (which is always
     included, power of two or not): 32 → [1, 2, 4, 8, 16, 32]."""
@@ -142,6 +151,17 @@ class InferenceEngine:
         Pre-flight ``Symbol.lint`` at load time; "error" refuses to serve a
         graph with error-severity findings (a bad graph should fail at
         deploy, not on the first customer request).
+    progcache_dir : str, optional
+        Directory of a persistent AOT program cache for THIS engine
+        (``mxnet_tpu/progcache.py``) — e.g. an artifact's shipped
+        ``programs/`` payload. Default: the process-global cache
+        (``MXNET_PROGCACHE_DIR`` / ``MXNET_PROGCACHE=1``), or no
+        persistence. With a cache, a bucket whose program was compiled by
+        ANY earlier process (same graph, avals, platform, code) warms by
+        deserializing the stored executable — the ``compile_log`` entry
+        records ``cache_hit: True`` and zero fresh XLA compilation
+        happens; the loaded program is the same machine code, so the
+        bitwise serve-vs-predict contract is untouched.
     mesh : jax.sharding.Mesh, optional
         Shard the engine over a device mesh (typically one replica group's
         slice — ``parallel.mesh_slices``): parameters are committed
@@ -167,7 +187,8 @@ class InferenceEngine:
                  buckets: Optional[Sequence[int]] = None,
                  lint: str = "warn",
                  pad_value: float = 0.0,
-                 mesh=None, rules=None, data_spec=None):
+                 mesh=None, rules=None, data_spec=None,
+                 progcache_dir: Optional[str] = None):
         import jax
 
         from ..executor import _build_graph_fn
@@ -309,6 +330,9 @@ class InferenceEngine:
         # one entry per distinct input signature ever compiled. The
         # TraceLinter serve-retrace-churn rule audits this log.
         self._programs: Dict[tuple, int] = {}   # sig -> execution count
+        # counters mutate from concurrent warmup threads (+= is not atomic
+        # once XLA releases the GIL mid-infer); compile_log appends are
+        self._stat_lock = threading.Lock()
         self.compile_log: List[dict] = []
         self._free_cache: Dict[tuple, tuple] = {}
         self.exec_count = 0
@@ -317,6 +341,22 @@ class InferenceEngine:
         # analyzed (flops/bytes/HBM into compile_log) and then executed
         self._aot: Dict[tuple, object] = {}      # sig -> compiled executable
         self._sig_cost: Dict[tuple, dict] = {}   # sig -> cost record
+
+        # persistent AOT program cache (mxnet_tpu/progcache.py): explicit
+        # dir (an artifact's programs/ payload) beats the process-global
+        # env-armed cache. Key statics = everything that determines the
+        # traced program short of the batch signature — the graph itself,
+        # argument layout, pad value, and mesh placement; progcache adds
+        # the platform/topology/version fingerprint per entry.
+        from .. import progcache as _progcache
+
+        self._progcache = (_progcache.ProgramCache(progcache_dir)
+                           if progcache_dir else _progcache.cache())
+        self._sig_key: Dict[tuple, object] = {}   # sig -> ProgramKey
+        self._key_statics = None
+        self.cache_hits = 0
+        if self._progcache is not None:
+            self._key_statics = self._compute_key_statics()
 
     # ------------------------------------------------------------------
     # properties / stats
@@ -345,6 +385,36 @@ class InferenceEngine:
 
         return mesh_scope(self.mesh)
 
+    def _compute_key_statics(self):
+        """The serve-program statics fed to ``progcache.program_key``:
+        graph json (hashed), argument layout, avals, pad value, and — for
+        a sharded engine — the mesh axes + concrete device ids (a program
+        compiled for one slice must never load onto another)."""
+        mesh_desc = None
+        if self.mesh is not None:
+            mesh_desc = (tuple(self.mesh.axis_names),
+                         tuple(self.mesh.devices.shape),
+                         tuple(int(d.id) for d in self.mesh.devices.flat),
+                         repr(self._data_spec))
+        return (self.symbol.tojson().encode("utf-8"),
+                tuple(self._data_names), tuple(self._param_names),
+                tuple(self._aux_names), tuple(self._free_names),
+                self._param_avals, self._aux_avals, self._pad_value,
+                mesh_desc)
+
+    def _program_key(self, sig, bucket: int):
+        """One :class:`~mxnet_tpu.progcache.ProgramKey` per signature —
+        the SAME derivation the device-plane cost registry and the
+        persistent cache file names use (progcache.program_key)."""
+        pk = self._sig_key.get(sig)
+        if pk is None:
+            from .. import progcache as _progcache
+
+            pk = _progcache.program_key("serve", f"bucket{bucket}",
+                                        (self._key_statics, sig))
+            self._sig_key[sig] = pk
+        return pk
+
     def stats(self) -> dict:
         staged = self._staged
         out = {
@@ -355,7 +425,11 @@ class InferenceEngine:
             "executions": self.exec_count,
             "programs": {repr(k): v for k, v in self._programs.items()},
             "compiles": len(self.compile_log),
+            "cache_hits": self.cache_hits,
         }
+        if self._progcache is not None:
+            out["progcache"] = dict(self._progcache.stats,
+                                    dir=self._progcache.root)
         if self.mesh is not None:
             from ..parallel.mesh import mesh_axes
 
@@ -482,7 +556,7 @@ class InferenceEngine:
             arrays = [np.concatenate(
                 [a, np.full((pad,) + a.shape[1:], self._pad_value, a.dtype)],
                 axis=0) for a in arrays]
-        sig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        sig = _sig_of(arrays)
         if self.mesh is not None:
             # commit the padded batch onto the mesh slice (dp-sharded when
             # the spec and bucket allow, replicated otherwise) — the sig is
@@ -506,31 +580,66 @@ class InferenceEngine:
         rec = obs.enabled()
         t0 = time.monotonic() if rec else 0.0
         is_compile = sig not in self._programs
+        cache_hit = False
         if is_compile:
             entry = {
                 "sig": sig, "bucket": bucket,
                 "param_avals": self._param_avals,
                 "version_at_compile": snapshot.version,
             }
-            if obs.device.active():
+            pc = self._progcache
+            pk = None
+            if pc is not None:
+                # persistent cache first: a hit deserializes the SAME
+                # machine code an earlier process compiled — zero fresh
+                # XLA work, bitwise-identical outputs
+                pk = self._program_key(sig, bucket)
+                entry["program_key"] = pk.digest
+                cached = pc.get(pk)
+                if cached is not None:
+                    cache_hit = True
+                    self._aot[sig] = cached.executable
+                    cost = obs.device.adopt_cached_cost(pk, cached.meta)
+                    if cost:
+                        entry.update(cost)
+                        self._sig_cost[sig] = cost
+            entry["cache_hit"] = cache_hit
+            if not cache_hit and (obs.device.active() or pc is not None):
                 # one AOT compile per signature: cost/memory analysis into
                 # the compile_log entry, the executable into the sig cache
                 # (params stay traced arguments — reload still swaps arrays
                 # without touching the program)
                 with self._mesh_ctx():
-                    compiled, cost = obs.device.capture(
-                        self._jitted,
-                        (self._rng_data, arg_vals, list(snapshot.aux_vals)),
-                        site="serve", label=f"bucket{bucket}")
+                    if obs.device.active():
+                        compiled, cost = obs.device.capture(
+                            self._jitted,
+                            (self._rng_data, arg_vals,
+                             list(snapshot.aux_vals)),
+                            site="serve", label=f"bucket{bucket}", key=pk)
+                    else:  # cache armed, cost capture vetoed: plain AOT
+                        from .. import progcache as _progcache
+
+                        compiled = _progcache.aot_compile(
+                            self._jitted,
+                            (self._rng_data, arg_vals,
+                             list(snapshot.aux_vals)))
+                        cost = (obs.device.analyze_compiled(compiled)
+                                if compiled is not None else None)
                 if compiled is not None:
                     self._aot[sig] = compiled
+                    if pc is not None:
+                        pc.put(pk, compiled,
+                               meta=dict(cost or {}, bucket=bucket))
                 if cost:
                     entry.update(cost)
                     self._sig_cost[sig] = cost
             self.compile_log.append(entry)
+            if cache_hit:
+                with self._stat_lock:
+                    self.cache_hits += 1
         fn = self._aot.get(sig, self._jitted)
         with obs.trace.span("serve.execute", bucket=bucket, rows=n_valid,
-                            compile=is_compile,
+                            compile=is_compile, cache_hit=cache_hit,
                             version=snapshot.version) as sp:
             with self._mesh_ctx():
                 outs, _new_aux = fn(self._rng_data, arg_vals,
@@ -551,16 +660,24 @@ class InferenceEngine:
             profiler.count_dispatch("d2h", len(host))
         if rec:
             dt = time.monotonic() - t0
-            if is_compile:
+            if is_compile and not cache_hit:
                 obs.inc("serve.compile")
                 obs.observe("serve.compile_seconds", dt)
+            elif cache_hit:
+                # a deserialize is not an XLA compile — count it apart so
+                # "zero fresh compilations on warm start" is checkable;
+                # and dt here includes the disk read + CRC + load, so it
+                # stays out of the steady-state execute histogram too
+                obs.inc("serve.cache_hit")
+                obs.observe("serve.deserialize_seconds", dt)
             else:
                 obs.observe("serve.execute_seconds", dt)
             obs.inc("serve.rows_executed", n_valid)
             obs.inc("serve.rows_padding", bucket - n_valid)
             obs.device.sample()  # live-HBM counter track, per batch
-        self._programs[sig] = self._programs.get(sig, 0) + 1
-        self.exec_count += 1
+        with self._stat_lock:
+            self._programs[sig] = self._programs.get(sig, 0) + 1
+            self.exec_count += 1
         return ([np.asarray(o)[:n_valid] if np.ndim(o) else np.asarray(o)
                  for o in host], snapshot.version)
 
@@ -570,21 +687,111 @@ class InferenceEngine:
         outs, _version = self.infer(list(inputs))
         return outs[0] if len(outs) == 1 else outs
 
-    def warmup(self, *feature_shapes, dtype=np.float32) -> int:
+    def warmup(self, *feature_shapes, dtype=np.float32,
+               concurrency: Optional[int] = None) -> int:
         """Pre-compile every bucket for the given per-row feature shape(s)
         (one tuple per data input; call once per distinct signature).
         Returns the number of programs compiled. Servers call this before
         flipping readiness so the first customer request never eats an XLA
-        compile."""
+        compile.
+
+        Buckets warm **concurrently** (a thread pool over per-bucket
+        compiles — XLA releases the GIL while it optimizes, so distinct
+        buckets' compilations genuinely overlap; cache-hit deserialization
+        runs at the same parallelism). ``concurrency`` caps the pool
+        (``MXNET_SERVE_WARMUP_THREADS`` overrides the default of
+        min(buckets, cores); 1 restores the serial path)."""
         shapes = list(feature_shapes) or [()]
         if len(shapes) != len(self._data_names):
             raise ServeError(
                 f"warmup needs one feature shape per data input "
                 f"({len(self._data_names)}), got {len(shapes)}")
         before = self.num_programs
-        for b in self.buckets:
+        todo = [b for b in self.buckets
+                if _sig_of([np.zeros((b,) + tuple(s), dtype)
+                            for s in shapes]) not in self._programs]
+        if concurrency is None:
+            import os as _os
+
+            from ..obs._env import env_int
+
+            concurrency = env_int(
+                "MXNET_SERVE_WARMUP_THREADS",
+                min(len(todo) or 1, max(1, _os.cpu_count() or 2)))
+
+        def _one(b):
             self.infer([np.zeros((b,) + tuple(s), dtype) for s in shapes])
+
+        if concurrency <= 1 or len(todo) <= 1:
+            for b in todo:
+                _one(b)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(concurrency, len(todo)),
+                    thread_name_prefix="mxnet-serve-warmup") as pool:
+                # list() re-raises the first worker's exception here,
+                # matching the serial path's failure surface
+                list(pool.map(_one, todo))
         return self.num_programs - before
+
+    def save_programs(self, directory: str, keep: Optional[int] = None,
+                      durable: bool = True) -> int:
+        """Export this engine's compiled executables into ``directory`` as
+        a persistent program-cache payload (the artifact ``programs/``
+        convention ``serve.load`` auto-discovers — ``serve.ship_programs``
+        wraps this with descriptor bookkeeping). Signatures compiled
+        through the plain jit path (no cache/capture active) are
+        AOT-recompiled from their recorded signature so every warmed
+        bucket ships. Returns the number of entries written."""
+        from .. import progcache as _progcache
+
+        if self._key_statics is None:
+            self._key_statics = self._compute_key_statics()
+        pc = _progcache.ProgramCache(directory, keep=keep or 0,
+                                     durable=durable)
+        snapshot = self._params
+        written = 0
+        for sig in list(self._programs):
+            bucket = int(sig[0][0][0])
+            compiled = self._aot.get(sig)
+            if compiled is None:
+                # same trace scope as infer's compile sites: model code
+                # (ring attention etc.) discovers the mesh slice at trace
+                # time — an unscoped retrace would ship (and install) the
+                # non-mesh variant of the program
+                with self._mesh_ctx():
+                    compiled = _progcache.aot_compile(
+                        self._jitted, self._args_for_sig(sig, snapshot))
+                if compiled is None:
+                    continue
+                self._aot[sig] = compiled
+            pk = self._program_key(sig, bucket)
+            meta = dict(self._sig_cost.get(sig) or {}, bucket=bucket)
+            if pc.put(pk, compiled, meta=meta):
+                written += 1
+        return written
+
+    def _args_for_sig(self, sig, snapshot) -> tuple:
+        """Rebuild example program arguments from a recorded signature
+        (zero-filled batches — only avals matter to ``lower``)."""
+        import jax
+
+        arrays = [np.zeros(shape, dtype) for shape, dtype in sig]
+        if self.mesh is not None:
+            arrays = [jax.device_put(a, self._data_sharding(a.shape))
+                      for a in arrays]
+        free_vals = self._free_vals(int(sig[0][0][0]),
+                                    [tuple(a.shape) for a in arrays])
+        arg_vals: List = [None] * self._n_args
+        for slot, v in zip(self._param_slots, snapshot.arg_vals):
+            arg_vals[slot] = v
+        for slot, v in zip(self._free_slots, free_vals):
+            arg_vals[slot] = v
+        for slot, v in zip(self._data_slots, arrays):
+            arg_vals[slot] = v
+        return (self._rng_data, arg_vals, list(snapshot.aux_vals))
 
     # ------------------------------------------------------------------
     # hot reload

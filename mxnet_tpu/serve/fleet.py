@@ -242,12 +242,19 @@ class ProcReplica:
 
     def __init__(self, model: str, *, args: Sequence[str] = (),
                  env: Optional[dict] = None, log_path: Optional[str] = None,
-                 obs_dir: Optional[str] = None):
+                 obs_dir: Optional[str] = None,
+                 progcache_dir: Optional[str] = None):
         self.model = model
         self._args = list(args)
         self._env = dict(env or {})
         self._log_path = log_path
         self._obs_dir = obs_dir or os.environ.get("MXNET_OBS_DIR")
+        # persistent AOT program cache (mxnet_tpu/progcache.py): an
+        # explicit dir pins the child's cache; otherwise the parent's
+        # MXNET_PROGCACHE* env rides the inherited environment, so
+        # autoscale scale-out and restart-after-SIGKILL warm their bucket
+        # programs from disk instead of recompiling
+        self._progcache_dir = progcache_dir
         self.proc: Optional[subprocess.Popen] = None
         self.idx = -1  # assigned by the pool
 
@@ -263,6 +270,10 @@ class ProcReplica:
                         os.environ.get(f"MXNET_CHAOS_KILL_REPLICA{self.idx}"))
         if chaos:
             env["MXNET_CHAOS_KILL"] = chaos
+        if self._progcache_dir:
+            # explicit param beats an inherited dir; an inherited
+            # MXNET_PROGCACHE=0 veto is deliberately NOT overridden
+            env["MXNET_PROGCACHE_DIR"] = self._progcache_dir
         if obs.enabled():
             # the whole fleet observes or none of it does — a replica with
             # telemetry off would be a hole in every collected trace

@@ -657,6 +657,12 @@ def main():  # pragma: no cover - CLI shim
     ap.add_argument("--warmup-shape", type=str, default=None,
                     help="comma-separated per-row feature shape to "
                          "pre-compile every bucket for, e.g. 3,224,224")
+    ap.add_argument("--progcache-dir", type=str, default=None,
+                    help="persistent AOT program-cache directory "
+                         "(mxnet_tpu/progcache.py); overrides "
+                         "MXNET_PROGCACHE_DIR — warmup deserializes "
+                         "previously compiled bucket programs instead of "
+                         "recompiling them")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel shard the engine over the first "
                          "N local devices (mesh axis 'tp'; sharding specs "
@@ -665,6 +671,11 @@ def main():  # pragma: no cover - CLI shim
     args = ap.parse_args()
 
     from . import load
+
+    if args.progcache_dir:
+        from .. import progcache
+
+        progcache.configure(args.progcache_dir)
 
     engine_kw = {}
     if args.tp:

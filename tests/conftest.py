@@ -62,6 +62,12 @@ def pytest_configure(config):
         "replica groups on mesh slices, quarantine→activate joins "
         "(docs/SERVING.md \"Mesh-sharded serving\"); run via "
         "`pytest -m serve_mesh` or `make serve_mesh`")
+    config.addinivalue_line(
+        "markers", "progcache: persistent AOT program-cache tests — "
+        "shared key derivation, hit/miss/reject structure, cache-hit "
+        "bitwise parity, replica restart warm-from-disk "
+        "(docs/PERFORMANCE.md \"Program cache and cold start\"); run via "
+        "`pytest -m progcache` or `make progcache`/`make coldstart`")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,416 @@
+"""Persistent AOT program cache (mxnet_tpu/progcache.py,
+docs/PERFORMANCE.md "Program cache and cold start").
+
+- key derivation: one shared ``program_key`` — deterministic across
+  processes, distinct across models/statics, canonicalization units;
+- structure: hit / miss / reject (truncated entry, CRC corruption,
+  foreign-platform fingerprint, stale-code fingerprint) — every bad entry
+  degrades to a plain compile with a counted reject, never a crash;
+- bitwise parity: a cache-hit engine answers bit-for-bit what the
+  fresh-compile engine answered (serve buckets AND the fused update);
+- bounds kept: TraceLinter's serve program bound stays green on hits, the
+  fused update still dispatches one program per step;
+- artifact payloads: ``serve.ship_programs`` → ``serve.load`` warms from
+  the shipped ``programs/`` dir;
+- elastic-rejoin prewarm: a checkpoint-derived ``prewarm_batch`` derives
+  the SAME key a real fit's engine uses (hit, not a wasted compile);
+- keep-last-N GC;
+- the chaos leg (slow): a ProcReplica SIGKILLed and respawned against the
+  same cache dir becomes ready with zero fresh XLA compiles.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler, progcache
+from mxnet_tpu import optimizer as opt_mod
+from mxnet_tpu import serve
+from mxnet_tpu import symbol as sym
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+pytestmark = pytest.mark.progcache
+
+
+@pytest.fixture()
+def cache_dir(tmp_path):
+    """Arm the process-global cache at a per-test dir; disarm after."""
+    d = str(tmp_path / "progcache")
+    progcache.configure(d)
+    yield d
+    progcache.configure(None)
+    os.environ.pop("MXNET_PROGCACHE_DIR", None)
+    os.environ.pop("MXNET_PROGCACHE", None)
+    progcache.reset()
+
+
+def _mlp(hidden=8, in_dim=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = sym.softmax(net, name="prob")
+    rng = np.random.RandomState(0)
+    arg = {"fc1_weight": rng.randn(hidden, in_dim).astype(np.float32) * 0.3,
+           "fc1_bias": rng.randn(hidden).astype(np.float32)}
+    return net, arg
+
+
+def _engine(net, arg, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("lint", "off")
+    return serve.InferenceEngine(net, arg, {}, **kw)
+
+
+# ---------------------------------------------------------------------------
+# key derivation
+# ---------------------------------------------------------------------------
+
+def test_program_key_deterministic_and_distinct():
+    statics = ((b"graph", ("data",), 0.0),
+               {"b": 1, "a": 2}, float("0.1"), type(int))
+    k1 = progcache.program_key("serve", "bucket4", statics)
+    k2 = progcache.program_key("serve", "bucket4", statics)
+    assert k1 == k2 and k1.site == "serve" and k1.label == "bucket4"
+    assert len(k1.digest) == 64
+    # any drift in site/label/statics changes the digest
+    assert progcache.program_key("update", "bucket4", statics) != k1
+    assert progcache.program_key("serve", "bucket8", statics) != k1
+    assert progcache.program_key(
+        "serve", "bucket4", ((b"graph2", ("data",), 0.0),)) != k1
+    # dict ordering canonicalizes away
+    assert progcache.program_key("s", "l", {"a": 1, "b": 2}) \
+        == progcache.program_key("s", "l", {"b": 2, "a": 1})
+
+
+def test_env_fingerprint_fields():
+    fp = progcache.env_fingerprint()
+    for field in ("platform", "device_kind", "num_devices", "jax",
+                  "jaxlib", "code"):
+        assert field in fp, fp
+    assert fp["platform"] == "cpu"
+    # cached copy is defensive — mutating it must not poison the source
+    fp["platform"] = "mars"
+    assert progcache.env_fingerprint()["platform"] == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# hit / miss / reject structure
+# ---------------------------------------------------------------------------
+
+def _put_one(cache, tag="x", shape=(3, 2)):
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x * 2.0)
+    compiled = fn.lower(jnp.zeros(shape)).compile()
+    key = progcache.program_key("test", tag, (tag, shape))
+    assert cache.put(key, compiled, meta={"bucket": 1})
+    return key, compiled
+
+
+def test_roundtrip_hit_and_miss(tmp_path):
+    cache = progcache.ProgramCache(str(tmp_path))
+    key, _ = _put_one(cache)
+    assert cache.stats["write"] == 1
+    miss = progcache.program_key("test", "other", ("other",))
+    assert cache.get(miss) is None
+    assert cache.stats["miss"] == 1
+    entry = cache.get(key)
+    assert entry is not None and entry.meta["bucket"] == 1
+    assert cache.stats["hit"] == 1 and cache.stats["reject"] == 0
+    import jax.numpy as jnp
+
+    out = entry.executable(jnp.ones((3, 2)))
+    np.testing.assert_array_equal(np.asarray(out), np.full((3, 2), 2.0))
+
+
+def test_truncated_entry_rejects(tmp_path):
+    cache = progcache.ProgramCache(str(tmp_path))
+    key, _ = _put_one(cache)
+    path = cache._path(key.digest)
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    assert cache.get(key) is None
+    assert cache.stats["reject"] == 1 and cache.stats["hit"] == 0
+
+
+def test_corrupt_byte_rejects(tmp_path):
+    cache = progcache.ProgramCache(str(tmp_path))
+    key, _ = _put_one(cache)
+    path = cache._path(key.digest)
+    with open(path, "r+b") as f:
+        f.seek(len(progcache._MAGIC) + 30)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert cache.get(key) is None
+    assert cache.stats["reject"] == 1
+
+
+def test_foreign_fingerprint_rejects(tmp_path):
+    cache = progcache.ProgramCache(str(tmp_path))
+    real = progcache.env_fingerprint()
+    try:
+        # entry written by a "TPU process with other code"
+        progcache._env_fp_cache[0] = dict(real, platform="tpu",
+                                          code="f" * 64)
+        key, _ = _put_one(cache)
+    finally:
+        progcache._env_fp_cache[0] = dict(real)
+    assert cache.get(key) is None, \
+        "a foreign-platform executable must never load"
+    assert cache.stats["reject"] == 1
+
+
+def test_wrong_digest_filename_rejects(tmp_path):
+    cache = progcache.ProgramCache(str(tmp_path))
+    key, _ = _put_one(cache)
+    other = progcache.program_key("test", "other", ("other",))
+    os.rename(cache._path(key.digest), cache._path(other.digest))
+    assert cache.get(other) is None  # header digest disagrees with name
+    assert cache.stats["reject"] == 1
+
+
+def test_gc_keep_last_n(tmp_path):
+    cache = progcache.ProgramCache(str(tmp_path), keep=2)
+    keys = []
+    for i in range(4):
+        k, _ = _put_one(cache, tag=f"t{i}", shape=(i + 1, 2))
+        keys.append(k)
+        # strict mtime ordering even on coarse-grained filesystems
+        stamp = time.time() - 100 + i
+        os.utime(cache._path(k.digest), (stamp, stamp))
+    cache.gc()
+    assert cache.entries() <= 2
+    # the most recently used survives, the oldest is gone
+    assert cache.get(keys[-1]) is not None
+    assert cache.get(keys[0]) is None
+
+
+# ---------------------------------------------------------------------------
+# serve engine integration
+# ---------------------------------------------------------------------------
+
+def test_engine_cache_hit_bitwise_parity(cache_dir):
+    net, arg = _mlp()
+    e1 = _engine(net, arg)
+    assert e1.warmup((4,)) == len(e1.buckets)
+    assert all(e.get("cache_hit") is False for e in e1.compile_log)
+    x = np.random.RandomState(3).rand(3, 4).astype(np.float32)
+    ref = e1.predict(x)
+
+    e2 = _engine(net, arg)
+    assert e2.warmup((4,)) == len(e2.buckets)
+    assert [e.get("cache_hit") for e in e2.compile_log] \
+        == [True] * len(e2.buckets), "warm engine must hit every bucket"
+    assert e2.cache_hits == len(e2.buckets)
+    out = e2.predict(x)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out)), \
+        "a deserialized executable is the same machine code — bitwise"
+    # the program bound is still proven, hits included
+    from mxnet_tpu.analysis.trace import TraceLinter
+
+    assert TraceLinter().check_serve_engine(e1) == []
+    assert TraceLinter().check_serve_engine(e2) == []
+    # compile_log entries carry the shared program_key digest
+    assert all(len(e.get("program_key", "")) == 64
+               for e in e1.compile_log + e2.compile_log)
+    # concurrent warmup logs buckets in completion order — compare as sets
+    assert {e["program_key"] for e in e1.compile_log} \
+        == {e["program_key"] for e in e2.compile_log}
+
+
+def test_engine_key_drift_misses_not_collides(cache_dir):
+    net, arg = _mlp(hidden=8)
+    e1 = _engine(net, arg)
+    e1.warmup((4,))
+    # a DIFFERENT graph with identical input avals must not hit
+    net2, arg2 = _mlp(hidden=6)
+    e2 = _engine(net2, arg2)
+    e2.warmup((4,))
+    assert all(e.get("cache_hit") is False for e in e2.compile_log)
+    # so must a changed engine static (pad value)
+    e3 = _engine(net, arg, pad_value=1.0)
+    e3.warmup((4,))
+    assert all(e.get("cache_hit") is False for e in e3.compile_log)
+
+
+def test_corrupt_cache_degrades_to_compile(cache_dir):
+    net, arg = _mlp()
+    e1 = _engine(net, arg)
+    e1.warmup((4,))
+    for f in os.listdir(cache_dir):
+        if f.endswith(".mxprog"):
+            path = os.path.join(cache_dir, f)
+            with open(path, "r+b") as fh:
+                fh.seek(20)
+                fh.write(b"\xde\xad\xbe\xef")
+    e2 = _engine(net, arg)
+    assert e2.warmup((4,)) == len(e2.buckets)  # served anyway
+    assert all(e.get("cache_hit") is False for e in e2.compile_log)
+    assert e2._progcache.stats["reject"] >= len(e2.buckets)
+    x = np.random.RandomState(3).rand(2, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(e1.predict(x)),
+                               np.asarray(e2.predict(x)), rtol=0, atol=0)
+
+
+def test_warmup_concurrent_matches_serial(cache_dir):
+    net, arg = _mlp()
+    e_serial = _engine(net, arg, max_batch_size=8)
+    assert e_serial.warmup((4,), concurrency=1) == len(e_serial.buckets)
+    e_conc = _engine(net, arg, max_batch_size=8, progcache_dir=None)
+    # fresh dir so concurrency exercises the compile path, not hits
+    e_conc._progcache = progcache.ProgramCache(cache_dir + "-conc")
+    e_conc._key_statics = e_conc._compute_key_statics()
+    assert e_conc.warmup((4,), concurrency=4) == len(e_conc.buckets)
+    sigs = [e["sig"] for e in e_conc.compile_log]
+    assert len(set(map(repr, sigs))) == len(sigs) == len(e_conc.buckets)
+    from mxnet_tpu.analysis.trace import TraceLinter
+
+    assert TraceLinter().check_serve_engine(e_conc) == []
+    x = np.random.RandomState(5).rand(6, 4).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(e_serial.predict(x)),
+                                  np.asarray(e_conc.predict(x)))
+
+
+def test_warmup_idempotent_second_call_zero(cache_dir):
+    net, arg = _mlp()
+    e = _engine(net, arg)
+    assert e.warmup((4,)) == len(e.buckets)
+    assert e.warmup((4,)) == 0  # already-compiled buckets skip entirely
+
+
+def test_ship_programs_and_load(cache_dir, tmp_path):
+    # build + warm WITHOUT the global cache, then ship the payload
+    progcache.configure(None)
+    net, arg = _mlp()
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 0, net,
+                             {k: nd.array(v) for k, v in arg.items()}, {})
+    e1 = _engine(net, arg)
+    e1.warmup((4,))
+    n = serve.ship_programs(e1, prefix)
+    assert n == len(e1.buckets)
+    assert os.path.isdir(serve.programs_dir_for(prefix))
+    eng = serve.load(prefix, epoch=0, max_batch_size=4, lint="off")
+    assert eng._progcache is not None \
+        and eng._progcache.root == serve.programs_dir_for(prefix)
+    assert eng.warmup((4,)) == len(eng.buckets)
+    assert all(e.get("cache_hit") for e in eng.compile_log)
+    x = np.random.RandomState(7).rand(3, 4).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(e1.predict(x)),
+                                  np.asarray(eng.predict(x)))
+
+
+# ---------------------------------------------------------------------------
+# fused update engine integration
+# ---------------------------------------------------------------------------
+
+def _one_step(cache_hit_expected, seed=42):
+    rng = np.random.RandomState(seed)
+    opt = opt_mod.create("adam", learning_rate=0.05, rescale_grad=0.5)
+    up = opt_mod.Updater(opt)
+    ws = [nd.array(rng.randn(5, 4).astype(np.float32)),
+          nd.array(rng.randn(3).astype(np.float32))]
+    gs = [nd.array(rng.randn(5, 4).astype(np.float32)),
+          nd.array(rng.randn(3).astype(np.float32))]
+    up.update_batch([0, 1], gs, ws)
+    eng = up._engine
+    assert eng is not None and len(eng.compile_log) == 1
+    assert eng.compile_log[0].get("cache_hit") is cache_hit_expected
+    assert len(eng.compile_log[0].get("program_key", "")) == 64
+    return [w.asnumpy() for w in ws], up
+
+
+def test_fused_cache_hit_bitwise_and_dispatch_bound(cache_dir):
+    w_fresh, _ = _one_step(cache_hit_expected=False)
+    w_hit, up = _one_step(cache_hit_expected=True)
+    for a, b in zip(w_fresh, w_hit):
+        np.testing.assert_array_equal(a, b), \
+            "cache-hit update must be bitwise the fresh-compile update"
+    # the one-program-per-step bound holds on the deserialized executable
+    rng = np.random.RandomState(1)
+    ws = [nd.array(rng.randn(5, 4).astype(np.float32)),
+          nd.array(rng.randn(3).astype(np.float32))]
+    gs = [w.zeros_like() for w in ws]
+    with profiler.count_dispatches() as c:
+        up.update_batch([0, 1], gs, ws)
+    assert c.total_compiled <= 2, c.as_dict()
+
+
+def test_updater_prewarm_populates_without_mutating(cache_dir):
+    rng = np.random.RandomState(0)
+    opt = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9)
+    up = opt_mod.Updater(opt)
+    ws = [nd.array(rng.randn(4, 3).astype(np.float32))]
+    before = ws[0].asnumpy().copy()
+    assert up.prewarm_batch([0], ws)
+    np.testing.assert_array_equal(before, ws[0].asnumpy())
+    assert opt._index_update_count == {}, "prewarm must not advance counts"
+    assert up._engine.compile_log[-1].get("cache_hit") is False
+    # a second updater (the restarted worker) hits from disk
+    opt2 = opt_mod.create("sgd", learning_rate=0.1, momentum=0.9)
+    up2 = opt_mod.Updater(opt2)
+    ws2 = [nd.array(rng.randn(4, 3).astype(np.float32))]
+    assert up2.prewarm_batch([0], ws2)
+    assert up2._engine.compile_log[-1].get("cache_hit") is True
+
+
+def test_module_fit_then_checkpoint_prewarm_hits(cache_dir, tmp_path):
+    """The elastic-rejoin warm path derives the SAME program key from the
+    shared checkpoint that the live fit's engine derives from its bound
+    executor — so a quarantined rejoiner's prewarm is a cache HIT."""
+    from mxnet_tpu.checkpoint import as_manager
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 5).astype(np.float32)
+    y = np.array([0, 1, 2, 3, 0, 1, 2, 3], np.float32)
+    it = NDArrayIter(x, y, batch_size=4)
+    ckpt = str(tmp_path / "ckpt")
+    mod = Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            checkpoint=ckpt, resume="never")
+    pc = progcache.cache()
+    writes = pc.stats["write"]
+    assert writes >= 1
+
+    mod2 = Module(net, context=mx.cpu())
+    mgr = as_manager(ckpt)
+    try:
+        hits_before = pc.stats["hit"]
+        assert mod2._prewarm_update_programs(
+            mgr, "sgd", {"learning_rate": 0.1, "momentum": 0.9}, it)
+        assert pc.stats["hit"] == hits_before + 1, \
+            "checkpoint-derived prewarm must hit the fit's cached program"
+        assert pc.stats["write"] == writes  # nothing recompiled
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# the chaos leg: replica SIGKILL → respawn warms from disk
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_proc_replica_restart_warms_from_cache(tmp_path):
+    import serve_bench
+
+    res = serve_bench.run_cold_bench(model="mlp", max_batch_size=4,
+                                     keep_artifact=str(tmp_path))
+    assert res["ok"], res
+    assert res["fresh_compiles_cold"] == 3  # buckets(4) = [1, 2, 4]
+    assert res["fresh_compiles_warm"] == 0
+    assert res["cache_hits_warm"] == res["compiles_warm"] == 3

@@ -222,6 +222,135 @@ def run_bench(model="mlp", mode="closed", duration=5.0, clients=4, qps=200.0,
     return out
 
 
+def run_cold_bench(model="mlp", max_batch_size=8, timeout=180.0,
+                   keep_artifact=None):
+    """Cold-start-to-ready A/B (docs/PERFORMANCE.md "Program cache and
+    cold start"): spawn a fresh ProcReplica against an empty persistent
+    program cache (cold — every bucket pays an XLA compile at warmup),
+    SIGKILL it, then spawn another against the now-populated cache (warm —
+    every bucket deserializes). ``cold_start_to_ready_s`` is wall time
+    from process spawn to the readiness probe answering OK, measured by
+    the parent — the number a fleet autoscaler actually waits on.
+
+    The gate is on the deterministic quantity: the warm replica must
+    perform ZERO fresh XLA compilations (every compile_log entry a
+    ``cache_hit``, strictly fewer compiles than cold). Wall times are
+    reported honestly — on a small host the jax import dominates tiny
+    models, so the time win tracks model size (``host_cores`` noted)."""
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve
+    from mxnet_tpu.model import save_checkpoint
+
+    tmp = keep_artifact or tempfile.mkdtemp(prefix="mxnet-coldstart-")
+    created = keep_artifact is None
+    try:
+        net, arg, aux, feat = _build_model(model)
+        prefix = os.path.join(tmp, "model")
+        save_checkpoint(
+            prefix, 0, net,
+            {k: mx.nd.array(np.asarray(v)) for k, v in arg.items()},
+            {k: mx.nd.array(np.asarray(v)) for k, v in aux.items()})
+        cache_dir = os.path.join(tmp, "progcache")
+        shape_arg = ",".join(str(d) for d in feat)
+        # replicas run on the REAL backend topology: the test harness's
+        # --xla_force_host_platform_device_count emulation changes XLA:CPU
+        # codegen so bucket kernels hash-collide across programs, and the
+        # JIT's process-wide kernel dedup then yields executables that are
+        # not self-contained — progcache refuses those exports (correctly),
+        # which would make this A/B measure the emulation, not the cache
+        xla_flags = " ".join(
+            tok for tok in os.environ.get("XLA_FLAGS", "").split()
+            if not tok.startswith("--xla_force_host_platform_device_count"))
+        legs = {}
+        for leg in ("cold", "warm"):
+            # an inherited MXNET_PROGCACHE=0 veto would silently disable
+            # the explicit cache dir and mis-diagnose as key instability —
+            # this A/B's whole point is the armed cache, so override it
+            rep = serve.ProcReplica(
+                prefix,
+                args=["--epoch", "0", "--warmup-shape", shape_arg,
+                      "--max-batch-size", str(max_batch_size)],
+                env={"XLA_FLAGS": xla_flags, "MXNET_PROGCACHE": "1"},
+                progcache_dir=cache_dir)
+            rep.idx = 0
+            t0 = time.perf_counter()
+            cli = None
+            graceful = False
+            try:
+                addr = rep.start()
+                cli = serve.ServeClient(*addr, timeout=5.0)
+                ready = False
+                deadline = time.perf_counter() + timeout
+                while time.perf_counter() < deadline:
+                    try:
+                        if cli.ready():
+                            ready = True
+                            break
+                    except Exception:  # noqa: BLE001 — still booting
+                        pass
+                    if not rep.alive():
+                        break
+                    time.sleep(0.05)
+                t_ready = time.perf_counter() - t0
+                if not ready:
+                    raise RuntimeError(
+                        f"{leg} replica never became ready in {timeout}s")
+                eng = cli.stats().get("engine", {})
+                legs[leg] = {
+                    "start_to_ready_s": round(t_ready, 3),
+                    "compiles": int(eng.get("compiles", 0)),
+                    "cache_hits": int(eng.get("cache_hits", 0)),
+                    "progcache": eng.get("progcache"),
+                }
+                # the cold leg always exits by SIGKILL (the chaos story:
+                # no graceful cache flush); warm stops gracefully unless
+                # something above raised — bench.py keeps running after a
+                # raise, so the finally must never leak the child
+                graceful = leg == "warm"
+            finally:
+                if cli is not None:
+                    try:
+                        cli.close()
+                    except Exception:  # noqa: BLE001 — already torn down
+                        pass
+                if not graceful:
+                    rep.kill()
+                rep.stop()  # reap
+        cold, warm = legs["cold"], legs["warm"]
+        cold_fresh = cold["compiles"] - cold["cache_hits"]
+        warm_fresh = warm["compiles"] - warm["cache_hits"]
+        ok = (warm_fresh == 0 and cold_fresh > 0
+              and warm["cache_hits"] == warm["compiles"] > 0
+              and warm["compiles"] <= cold["compiles"])
+        return {
+            "model": model,
+            "max_batch_size": max_batch_size,
+            "cold_start_to_ready_s": warm["start_to_ready_s"],
+            "cold_s": cold["start_to_ready_s"],
+            "warm_s": warm["start_to_ready_s"],
+            "speedup": round(cold["start_to_ready_s"]
+                             / max(warm["start_to_ready_s"], 1e-9), 3),
+            "warm_wall_win": warm["start_to_ready_s"]
+            < cold["start_to_ready_s"],
+            "compiles_cold": cold["compiles"],
+            "compiles_warm": warm["compiles"],
+            "fresh_compiles_cold": cold_fresh,
+            "fresh_compiles_warm": warm_fresh,
+            "cache_hits_warm": warm["cache_hits"],
+            "host_cores": os.cpu_count(),
+            "note": "start-to-ready includes interpreter+jax import; the "
+                    "wall win scales with model compile cost, the compile "
+                    "counts are the deterministic gate",
+            "ok": ok,
+        }
+    finally:
+        if created:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _serve_rules(model):
     """Tensor-parallel sharding specs for the bench models: the mlp gets
     the classic Megatron split (fc1 row-parallel, fc2 column-parallel —
@@ -892,6 +1021,12 @@ def main(argv=None):
     ap.add_argument("--hz", type=float, default=None,
                     help="profiler sampling rate for --prof-overhead "
                          "(default MXNET_OBS_PROF_HZ or 67)")
+    ap.add_argument("--cold", action="store_true",
+                    help="cold-start A/B: spawn a ProcReplica with an "
+                         "empty vs warmed persistent program cache and "
+                         "report cold_start_to_ready_s both ways (always "
+                         "prints JSON; exits 1 when the warm leg performed "
+                         "any fresh XLA compile — the key-stability gate)")
     ap.add_argument("--scale", action="store_true",
                     help="mesh-scaling bench: closed-loop qps through "
                          "tensor-parallel replica groups on dp 1/2/4 mesh "
@@ -948,6 +1083,18 @@ def main(argv=None):
             print(f"WARNING: prof_overhead_pct={res['prof_overhead_pct']} "
                   f"exceeds the {res['threshold_pct']}% budget at "
                   f"{res['profiler_hz']} Hz", file=sys.stderr)
+        return 0
+
+    if args.cold:
+        res = run_cold_bench(model=args.model,
+                             max_batch_size=args.max_batch_size)
+        print(json.dumps(res, indent=1))
+        if not res["ok"]:
+            print("WARNING: warm start performed "
+                  f"{res['fresh_compiles_warm']} fresh XLA compile(s) "
+                  f"(cold: {res['fresh_compiles_cold']}) — program-cache "
+                  "keys are unstable across processes", file=sys.stderr)
+            return 1
         return 0
 
     if args.scale:
