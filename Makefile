@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart train-obs
 
 # repo self-lint: framework invariants + the concurrency-correctness pass
 # (lock-order cycles, blocking-under-lock, CV/thread discipline, wire
@@ -102,6 +102,16 @@ dossier:
 health:
 	$(PYTHON) -m pytest tests/ -q -m health -p no:cacheprovider
 	$(PYTHON) tools/health_bench.py
+
+# training-fleet telemetry plane (docs/OBSERVABILITY.md "Training-fleet
+# telemetry"): detector pure-function units, heartbeat-piggybacked parts,
+# PS OP_TELEMETRY exactly-once, merged rank timeline with a corpse lane,
+# hot-key boundedness, the chaos-slow flagship; then the measured
+# straggler-detection latency + the <5%-gated step-accounting overhead
+train-obs:
+	$(PYTHON) -m pytest tests/ -q -m train_obs -p no:cacheprovider
+	$(PYTHON) tools/elastic_bench.py --straggler
+	$(PYTHON) tools/elastic_bench.py --train-obs
 
 # persistent AOT program cache (docs/PERFORMANCE.md "Program cache and
 # cold start"): key-derivation/hit/miss/reject units, bitwise parity of

@@ -654,6 +654,30 @@ def bench_elastic(platform):
     return res
 
 
+def bench_train_obs(platform):
+    """Training-fleet telemetry plane (docs/OBSERVABILITY.md
+    "Training-fleet telemetry"): the fit-loop step accounting's marginal
+    cost — span tracing on in BOTH configurations, fleet plane vetoed vs
+    on, interleaved best-of (the PR-13 methodology) — gated under the
+    same 5% budget as every other always-on plane; plus the straggler
+    leg's measured detection latency (windows) and step-time skew with
+    one slowed worker."""
+    del platform  # host-side plane: same measurement on any backend
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import elastic_bench
+
+    res = elastic_bench.run_train_obs_overhead(
+        steps=int(os.environ.get("BENCH_TRAIN_OBS_STEPS", 250)))
+    assert res["ok"], (
+        f"train_obs_overhead_pct={res['train_obs_overhead_pct']} >= "
+        f"{res['threshold_pct']}% — the fleet step accounting is too "
+        f"expensive to leave on (ips {res['ips_off']} -> "
+        f"{res['ips_on']})")
+    res["straggler"] = elastic_bench.run_straggler_bench()
+    return res
+
+
 def bench_update_engine_dispatches():
     """Compiled executions per optimizer step (tools/profile_step.py
     counters): the fused engine must stay at 1 program regardless of the
@@ -946,6 +970,15 @@ def main():
                 extra["elastic"]["elastic_recovery_s"]
         except Exception as e:
             extra["elastic_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not over_budget("train_obs"):
+        try:
+            # the training-fleet step accounting must be cheap enough to
+            # leave on for every production fit: spans on both sides,
+            # fleet plane off vs on, <5% gated; the straggler leg reports
+            # detection latency in windows + step-time skew
+            extra["train_obs"] = bench_train_obs(platform)
+        except Exception as e:
+            extra["train_obs_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
             and not over_budget("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's

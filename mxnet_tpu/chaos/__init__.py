@@ -20,6 +20,10 @@ lookup when chaos is off:
   occurrence of it entering an Executor forward (``MXNET_CHAOS_NAN``), so
   the training-health plane's detection → provenance → auto-rollback chain
   (obs/health.py) is deterministically testable end to end.
+- :mod:`mxnet_tpu.chaos.slow` — delay a named rank's step phase at counted
+  occurrences (``MXNET_CHAOS_SLOW``), so the training-fleet straggler
+  detector (obs/fleetstats.py) is chaos-proven: the flagged rank and the
+  blamed phase must match the injection.
 
 Determinism is the point: a chaos test that flakes is worse than no test.
 Every injector fires on a counted occurrence of a named event, never on a
@@ -27,6 +31,6 @@ timer or a random draw.
 """
 from __future__ import annotations
 
-from . import nan, platform, proc, rpc
+from . import nan, platform, proc, rpc, slow
 
-__all__ = ["rpc", "proc", "platform", "nan"]
+__all__ = ["rpc", "proc", "platform", "nan", "slow"]

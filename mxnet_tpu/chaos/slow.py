@@ -1,0 +1,179 @@
+"""Deterministic straggler injection — delay a named rank's step phase at
+counted occurrences.
+
+The training-fleet telemetry plane (obs/fleetstats.py) promises: a rank
+lagging the fleet is *detected* (StragglerDetector verdict within K
+windows) and *blamed* (the lagging phase named). None of that is provable
+unless a straggler can be injected deterministically — so, the chaos
+idiom: a rule names a rank and a step phase, and fires a fixed sleep on
+exact 1-based occurrence counts of that phase completing on that rank.
+The flagged rank and the blamed phase must then match the injection
+(tests/test_fleetstats.py flagship).
+
+The delay fires INSIDE the phase's span (obs/fleetstats.py ``_PhaseCtx``),
+so the injected lag is visible on the merged timeline as exactly the
+stretched phase the detector blames.
+
+Configuration
+-------------
+Programmatic (tests): ``configure([Rule(1, "forward", {5, 6}, 0.25)])``
+then ``reset()``. Env (subprocesses): ``MXNET_CHAOS_SLOW`` as semicolon-
+separated ``rank:phase@occs:seconds`` — occurrences are 1-based counts of
+that (rank, phase) pair, given as a comma list and/or ``lo-hi`` ranges;
+empty means every occurrence. Examples::
+
+    MXNET_CHAOS_SLOW="1:forward@5-40:0.25"    # rank 1, forwards 5..40
+    MXNET_CHAOS_SLOW="0:data_wait::0.1"       # rank 0, every data_wait
+    MXNET_CHAOS_SLOW="2:update@3,7:0.5"       # rank 2, 3rd and 7th update
+
+The rank is resolved from :func:`set_rank` (the elastic session calls it
+with the fleet rank) falling back to ``DMLC_WORKER_ID``. When the env var
+is unset the hook costs one truthiness check (fleetstats gates on the raw
+env string before importing this module at all).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from .. import obs
+
+__all__ = ["Rule", "configure", "reset", "enabled", "maybe_delay",
+           "set_rank", "parse_env"]
+
+
+class Rule:
+    def __init__(self, rank: int, phase: str,
+                 occurrences: Optional[Set[int]] = None,
+                 seconds: float = 0.0):
+        self.rank = int(rank)
+        self.phase = phase
+        self.occurrences = set(occurrences) if occurrences else None
+        self.seconds = float(seconds)
+
+    def __repr__(self):
+        occ = sorted(self.occurrences) if self.occurrences else "all"
+        return f"SlowRule(rank{self.rank}:{self.phase}@{occ}" \
+               f":{self.seconds}s)"
+
+
+class _State(threading.local):
+    """Thread-local counters (the RPC-chaos idiom): concurrent step loops
+    in one test must not race each other's occurrence counts."""
+
+    def __init__(self):
+        self.rules: Optional[List[Rule]] = None
+        self.counters: Dict[int, int] = {}
+
+
+_STATE = _State()
+_PROGRAMMATIC: Optional[List[Rule]] = None
+_RANK: Optional[int] = None
+
+
+def set_rank(r: int) -> None:
+    """Pin this process's fleet rank (the elastic session calls it); the
+    ``DMLC_WORKER_ID`` env var is the fallback."""
+    global _RANK
+    _RANK = int(r)
+
+
+def _rank() -> int:
+    if _RANK is not None:
+        return _RANK
+    return int(os.environ.get(
+        "DMLC_WORKER_ID", os.environ.get("MXNET_WORKER_ID", 0)) or 0)
+
+
+def _parse_occs(spec: str) -> Optional[Set[int]]:
+    if not spec:
+        return None
+    out: Set[int] = set()
+    for tok in filter(None, (t.strip() for t in spec.split(","))):
+        lo, dash, hi = tok.partition("-")
+        if dash:
+            out.update(range(int(lo), int(hi) + 1))
+        else:
+            out.add(int(tok))
+    return out or None
+
+
+def parse_env(spec: str) -> List[Rule]:
+    rules = []
+    for part in filter(None, (p.strip() for p in spec.split(";"))):
+        fields = part.split(":")
+        # canonical rank:phase@occs:seconds; an empty occurrence list may
+        # be written rank:phase:seconds (or rank:phase::seconds)
+        if len(fields) == 4 and fields[2] == "":
+            fields = [fields[0], fields[1], fields[3]]
+        if len(fields) != 3:
+            raise ValueError(f"bad MXNET_CHAOS_SLOW entry {part!r} "
+                             "(want rank:phase@occs:seconds)")
+        rank_s, phase_occ, seconds = fields
+        phase, _, occs = phase_occ.partition("@")
+        if not phase:
+            raise ValueError(f"bad MXNET_CHAOS_SLOW entry {part!r}")
+        try:
+            rules.append(Rule(int(rank_s), phase, _parse_occs(occs),
+                              float(seconds)))
+        except ValueError as e:
+            raise ValueError(
+                f"bad MXNET_CHAOS_SLOW entry {part!r}: {e}") from e
+    return rules
+
+
+def configure(rules: List[Rule]) -> None:
+    global _PROGRAMMATIC
+    _PROGRAMMATIC = list(rules)
+    _STATE.rules = None
+    _STATE.counters = {}
+
+
+def reset() -> None:
+    global _PROGRAMMATIC, _RANK
+    _PROGRAMMATIC = None
+    _RANK = None
+    _STATE.rules = None
+    _STATE.counters = {}
+
+
+def _active_rules() -> List[Rule]:
+    if _PROGRAMMATIC is not None:
+        return _PROGRAMMATIC
+    if _STATE.rules is None:
+        spec = os.environ.get("MXNET_CHAOS_SLOW", "")
+        _STATE.rules = parse_env(spec) if spec else []
+    return _STATE.rules
+
+
+def enabled() -> bool:
+    return bool(_active_rules())
+
+
+def maybe_delay(phase: str) -> float:
+    """Hook at the end of a step phase on this rank: sleeps (and tags the
+    injection in the same timeline the step writes to) when a rule
+    matches this (rank, phase) at this occurrence. Returns the injected
+    seconds (0.0 when nothing fired)."""
+    rules = _active_rules()
+    if not rules:
+        return 0.0
+    my_rank = _rank()
+    injected = 0.0
+    for rule in rules:
+        if rule.rank != my_rank or rule.phase != phase:
+            continue
+        key = id(rule)
+        _STATE.counters[key] = _STATE.counters.get(key, 0) + 1
+        occ = _STATE.counters[key]
+        if rule.occurrences is not None and occ not in rule.occurrences:
+            continue
+        obs.event("chaos.slow", rank=my_rank, phase=phase,
+                  occurrence=occ, seconds=rule.seconds)
+        obs.inc("chaos.injected")
+        obs.inc("chaos.slow.injected")
+        time.sleep(rule.seconds)
+        injected += rule.seconds
+    return injected

@@ -70,6 +70,14 @@ class DistKVStore(KVStore):
             self._elastic = elastic_mod.ElasticWorkerSession(
                 addr, port, rank=self._rank, expected=self._num_workers)
             self._elastic.ensure_joined()
+            # this process IS fleet rank r: pin the training-fleet step
+            # accounting and the straggler injector to it (both fall back
+            # to DMLC_WORKER_ID, but launchers aren't the only entry)
+            from ..chaos import slow as _chaos_slow
+            from ..obs import fleetstats as _fleetstats
+
+            _fleetstats.set_rank(self._rank)
+            _chaos_slow.set_rank(self._rank)
         else:
             self._maybe_init_jax_distributed()
 

@@ -125,10 +125,13 @@ class _Member:
 
 
 class _Round:
-    __slots__ = ("contribs",)
+    __slots__ = ("contribs", "stamps")
 
     def __init__(self):
         self.contribs: Dict[int, np.ndarray] = {}
+        # cid -> arrival monotonic: the reduce wait-by-rank attribution
+        # (who stood waiting vs who arrived last) reads these at release
+        self.stamps: Dict[int, float] = {}
 
 
 class ElasticState:
@@ -141,7 +144,8 @@ class ElasticState:
     """
 
     def __init__(self, hb_interval: Optional[float] = None,
-                 miss_k: Optional[int] = None, on_change=None):
+                 miss_k: Optional[int] = None, on_change=None,
+                 on_prune=None):
         self.cv = tsan.condition("elastic.state.cv")
         self.members: Dict[int, _Member] = {}
         self.generation = 0
@@ -157,6 +161,9 @@ class ElasticState:
         # callbacks poked (outside cv) after any membership change — the
         # PSServer hangs its barrier-release re-check here
         self._on_change = list(on_change or [])
+        # callbacks fired (outside cv) with each PRUNED/LEFT cid — the
+        # PSServer's fleet-telemetry cache drops that member's parts
+        self._on_prune = list(on_prune or [])
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
 
@@ -238,6 +245,8 @@ class ElasticState:
                     # can ever reach
                     self._takeover_locked()
                 self._reevaluate_locked()
+        if m is not None:
+            self._forget_member(cid, pruned=False)
         self._notify_change()
 
     def _bump_generation(self, reason: str, **attrs):
@@ -275,6 +284,8 @@ class ElasticState:
         while not self._stop.wait(self.hb_interval):
             now = time.monotonic()
             changed = False
+            pruned = []
+            rec = obs.enabled()
             with self.cv:
                 for m in list(self.members.values()):
                     if m.state in ("active", "quarantined") \
@@ -288,6 +299,18 @@ class ElasticState:
                     elif m.state == "dead" \
                             and now - m.last_hb > prune_after:
                         del self.members[m.cid]
+                        pruned.append(m.cid)
+                if rec:
+                    # membership liveness as gauges, refreshed per sweep
+                    # (the exposition's fleet-health row; pruned members'
+                    # gauges are removed below, never frozen)
+                    for m in self.members.values():
+                        obs.set_gauge(
+                            f"kvstore.member{m.cid}.last_hb_age_s",
+                            round(now - m.last_hb, 3))
+                    obs.set_gauge("kvstore.generation", self.generation)
+                    obs.set_gauge("kvstore.live_workers",
+                                  self.active_count())
                 if changed:
                     self._bump_generation("death")
                     # fleet takeover: every active died while joiners wait
@@ -296,6 +319,8 @@ class ElasticState:
                     if not self.active_members():
                         self._takeover_locked()
                     self._reevaluate_locked()
+            for cid in pruned:
+                self._forget_member(cid)
             if changed:
                 self._notify_change()
 
@@ -303,6 +328,25 @@ class ElasticState:
         for cb in self._on_change:
             try:
                 cb()
+            except Exception:  # noqa: BLE001 — observer must not kill liveness
+                pass
+
+    def _forget_member(self, cid: int, pruned: bool = True) -> None:
+        """A member left the table for good (prune GC or LEAVE): drop its
+        per-member gauge from the exposition — a removed worker must not
+        sit there forever as a frozen last value. Only a PRUNE (a corpse
+        GC'd long after death) additionally tells the prune observers to
+        drop cached state: a clean LEAVE keeps the member's fleet
+        telemetry — its step attribution is exactly what a post-run
+        train_report pulls — and the caches are LRU-bounded regardless."""
+        from ..obs import metrics as _metrics
+
+        _metrics.remove(f"kvstore.member{cid}.last_hb_age_s")
+        if not pruned:
+            return
+        for cb in self._on_prune:
+            try:
+                cb(cid)
             except Exception:  # noqa: BLE001 — observer must not kill liveness
                 pass
 
@@ -329,6 +373,8 @@ class ElasticState:
             if done is not None:  # idempotent retry of a released round
                 return ST_OK, self.generation, done[0], done[1]
             r = self._rounds.setdefault(ck, _Round())
+            if cid not in r.contribs:
+                r.stamps[cid] = time.monotonic()
             r.contribs.setdefault(cid, arr)  # dedup a duplicated frame
             self._try_complete_round_locked(ck)
             deadline = time.monotonic() + timeout
@@ -367,6 +413,23 @@ class ElasticState:
             self._completed.popitem(last=False)
         del self._rounds[ck]
         obs.inc("elastic.reduce_rounds")
+        if obs.enabled() and r.stamps:
+            # reduce wait-by-rank: each contributor's wait is "round
+            # release minus its arrival" — the rank with ~zero wait
+            # arrived last and is what the fleet stood waiting on. The
+            # per-rank histograms corroborate the StragglerDetector's
+            # blame from the server's own vantage point.
+            now = time.monotonic()
+            last_cid = max(r.stamps, key=lambda c: r.stamps[c])
+            for cid_, t0 in r.stamps.items():
+                m = self.members.get(cid_)
+                if m is None:
+                    continue
+                obs.observe(f"kvstore.reduce_wait.rank{m.rank}_seconds",
+                            now - t0)
+            m = self.members.get(last_cid)
+            if m is not None:
+                obs.inc(f"kvstore.reduce_last_arriver.rank{m.rank}")
         if set(r.contribs) != required:
             # released over a different set than required right now — a
             # member died mid-round (its gradient, if sent, still counts)
@@ -774,10 +837,15 @@ class Heartbeater:
     which is exactly what the liveness monitor is for."""
 
     def __init__(self, host: str, port: int, cid: int, rank: int,
-                 interval: Optional[float] = None):
+                 interval: Optional[float] = None, part_provider=None):
         self._addr = (host, port)
         self._cid = cid
         self._rank = rank
+        # training-fleet telemetry piggyback (obs/fleetstats.py): when the
+        # provider returns a blob, it rides THIS beat after the 16-byte
+        # identity header — no new connection, no new RPC; a None return
+        # (nothing new / telemetry off) costs one call per beat
+        self._part_provider = part_provider
         self.interval = (heartbeat_interval() if interval is None
                          else float(interval))
         self._sock = None
@@ -798,13 +866,27 @@ class Heartbeater:
         from .ps_server import _recv_msg, _send_msg
 
         payload = struct.pack("<QQ", self._cid, self._rank)
+        pending = b""  # a drained part is destructive state: a failed
+        # send keeps the blob for the next beat instead of losing that
+        # rank's windows + spans to a transient connection blip
         while not self._stop.is_set():
             try:
                 if self._sock is None:
                     self._sock = _socket.create_connection(
                         self._addr, timeout=max(2.0, self.interval * 4))
                     configure_socket_keepalive(self._sock)
-                _send_msg(self._sock, OP_HB, "", payload)
+                if not pending and self._part_provider is not None:
+                    try:
+                        pending = self._part_provider() or b""
+                    except Exception:  # noqa: BLE001 — telemetry must
+                        pending = b""  # never break the heartbeat
+                _send_msg(self._sock, OP_HB, "", payload + pending)
+                # clear on a successful SEND, not the ack: a lost reply
+                # would re-ship the part and duplicate its spans in the
+                # server cache (windows are index-keyed and idempotent,
+                # spans are not); a send the kernel refused raises and
+                # keeps the blob for the retry — the routine loss case
+                pending = b""
                 _, _, reply = _recv_msg(self._sock)
                 self._failures = 0
                 if len(reply) >= 13:
@@ -849,7 +931,8 @@ class ElasticWorkerSession:
     def __init__(self, host: str, port: int, rank: int = 0,
                  expected: Optional[int] = None,
                  hb_interval: Optional[float] = None,
-                 reduce_timeout: Optional[float] = None):
+                 reduce_timeout: Optional[float] = None,
+                 part_provider="auto"):
         from .ps_client import PSClient
 
         self._cli = PSClient(host, port, timeout=30.0, retries=8,
@@ -864,6 +947,14 @@ class ElasticWorkerSession:
         self._reduce_timeout = (_reduce_timeout() if reduce_timeout is None
                                 else float(reduce_timeout))
         self._hb_interval = hb_interval
+        # "auto" = this process's real step accounting (obs/fleetstats.py)
+        # rides the heartbeats; in-process multi-rank tests/benches pass
+        # their per-rank accounting's wire_part, None disables
+        if part_provider == "auto":
+            from ..obs import fleetstats as _fleetstats
+
+            part_provider = _fleetstats.wire_part
+        self._part_provider = part_provider
         self._hb: Optional[Heartbeater] = None
         self._round = 0
         self._joined: Optional[JoinInfo] = None
@@ -883,7 +974,8 @@ class ElasticWorkerSession:
         if self._hb is None:
             self._hb = Heartbeater(self._cli._addr[0], self._cli._addr[1],
                                    self.cid, self.rank,
-                                   interval=self._hb_interval)
+                                   interval=self._hb_interval,
+                                   part_provider=self._part_provider)
         if (info.active and wait_for_expected and self._expected
                 and info.active_count < self._expected):
             deadline = time.monotonic() + timeout

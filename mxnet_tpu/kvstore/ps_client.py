@@ -29,8 +29,8 @@ from ..chaos import rpc as chaos_rpc
 from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
                         OP_PUSH, OP_PUSH_SEQ, OP_PUSH_SPARSE,
                         OP_PUSH_SPARSE_SEQ, OP_SET_OPT, OP_SHUTDOWN,
-                        _pack_array, _pack_sparse, _recv_msg, _send_msg,
-                        _unpack_array)
+                        OP_STATS, OP_TELEMETRY, _pack_array, _pack_sparse,
+                        _recv_msg, _send_msg, _unpack_array)
 from .elastic import OP_HB
 
 
@@ -299,5 +299,48 @@ class PSClient:
             raise TimeoutError(
                 "kvstore barrier timed out waiting for stragglers" + detail)
 
+    def telemetry(self, drain: bool = True) -> dict:
+        """Pull the training-fleet telemetry document (``OP_TELEMETRY``):
+        ``{"parts": [...]}`` — the server's own part (RPC lanes + STATS
+        with straggler verdicts and hot keys) plus every cached worker
+        part. Draining is destructive and ``_rpc`` retries lost replies,
+        so the request carries a fresh collection token: a retried frame
+        re-serves the server's cached reply instead of draining (and
+        silently losing) a second batch — the serve-plane idiom."""
+        import json
+
+        spec = {"drain": bool(drain), "token": os.urandom(8).hex()}
+        _, _, reply = self._rpc(OP_TELEMETRY, "",
+                                json.dumps(spec).encode("utf-8"))
+        if bytes(reply[:1]) != b"\x00":
+            raise MXNetError("PS telemetry failed: "
+                             + bytes(reply[1:]).decode("utf-8", "replace"))
+        return json.loads(bytes(reply[1:]).decode("utf-8"))
+
+    def stats(self, include_metrics: bool = True) -> dict:
+        """The server's structured STATS (``OP_STATS``): membership
+        liveness, the training-fleet section, hot keys, and — by default
+        — the metrics registry snapshot under ``"metrics"``."""
+        import json
+
+        payload = b"" if include_metrics \
+            else json.dumps({"metrics": False}).encode("utf-8")
+        _, _, reply = self._rpc(OP_STATS, "", payload)
+        if bytes(reply[:1]) != b"\x00":
+            raise MXNetError("PS stats failed: "
+                             + bytes(reply[1:]).decode("utf-8", "replace"))
+        return json.loads(bytes(reply[1:]).decode("utf-8"))
+
     def shutdown(self):
         self._rpc(OP_SHUTDOWN)
+
+    def close(self):
+        """End this client session (the server keeps running — unlike
+        :meth:`shutdown`). Safe to call twice."""
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

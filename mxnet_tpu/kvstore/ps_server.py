@@ -56,19 +56,21 @@ from .elastic import (ELASTIC_OP_NAMES, OP_EPOCH, OP_HB, OP_JOIN, OP_LEAVE,
 # codes, names, and exactly-once metadata live in ONE table that the
 # protocol linter cross-checks against this module's dispatch
 (OP_INIT, OP_PUSH, OP_PULL, OP_SET_OPT, OP_BARRIER, OP_SHUTDOWN,
- OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ, OP_PUSH_SPARSE_SEQ) = \
+ OP_PUSH_SPARSE, OP_PULL_SPARSE, OP_PUSH_SEQ, OP_PUSH_SPARSE_SEQ,
+ OP_TELEMETRY, OP_STATS) = \
     PS_WIRE.codes("init", "push", "pull", "set_opt", "barrier", "shutdown",
                   "push_sparse", "pull_sparse", "push_seq",
-                  "push_sparse_seq")
+                  "push_sparse_seq", "telemetry", "stats")
 
 # opcode → canonical name (telemetry labels; mxnet_tpu.chaos.rpc mirrors
 # it) — includes the elastic range, which this server also dispatches
 OP_NAMES = dict(PS_WIRE.names())
 
-# one rule table fault-injects both planes (the serve/server.py idiom)
+# one rule table fault-injects both planes (the serve/server.py idiom) —
+# the full PS table, so the fleet-telemetry/stats ops are targetable too
 from ..chaos import rpc as _chaos_rpc  # noqa: E402
 
-_chaos_rpc.OP_NAMES.update(ELASTIC_OP_NAMES)
+_chaos_rpc.OP_NAMES.update(OP_NAMES)
 
 
 def _pack_array(arr: np.ndarray) -> bytes:
@@ -222,8 +224,22 @@ class PSServer:
         # retransmit within the round, and the released LRU acks a
         # retransmit that arrives after the round completed.
         self._barrier_arrived: Dict = {}
+        self._barrier_stamps: Dict = {}  # token -> arrival monotonic (the
+        # per-rank barrier-wait attribution reads these at release)
         self._barrier_released: "OrderedDict" = OrderedDict()
         self._barrier_cv = tsan.condition("ps.barrier")
+        # training-fleet telemetry plane (obs/fleetstats.py): cached
+        # per-worker parts piggybacked on heartbeats + the straggler
+        # detector over them; exactly-once OP_TELEMETRY drains via the
+        # collection-token LRU (the serve-plane idiom)
+        from ..obs import fleetstats as _fleetstats
+
+        self.fleet = _fleetstats.FleetAggregator(
+            member_ranks=self._live_ranks)
+        self._hot_keys = _fleetstats.HotKeyTable()
+        self._telemetry_tokens: "OrderedDict" = OrderedDict()
+        self._telemetry_lock = tsan.lock("ps.telemetry")
+        self._started = time.monotonic()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -268,13 +284,24 @@ class PSServer:
                 hb, miss = self._elastic_cfg
                 self._elastic = elastic_mod.ElasticState(
                     hb_interval=hb, miss_k=miss,
-                    on_change=[self._on_membership_change])
+                    on_change=[self._on_membership_change],
+                    on_prune=[self.fleet.forget])
             return self._elastic
 
     def _on_membership_change(self):
         with self._barrier_cv:
             self._release_barrier_locked()
             self._barrier_cv.notify_all()
+
+    def _live_ranks(self):
+        """Active members' ranks — the fleet aggregator's membership view
+        (judging a window waits for every LIVE rank's report; dead/left
+        ranks stop counting)."""
+        el = self._elastic
+        if el is None:
+            return None
+        with el.cv:
+            return [m.rank for m in el.active_members()]
 
     def _required_workers(self) -> int:
         """Barrier quorum: the LIVE membership once anyone heartbeats, the
@@ -513,14 +540,19 @@ class PSServer:
             grad = _unpack_array(payload[16:])
             from ..chaos.proc import kill_point
 
+            rec = obs.enabled()
+            t_apply = t_wal = 0.0
             with self._locks[key]:
                 with self._seq_lock:
                     fresh = self._applied_seq.get((cid, key), -1) < seq
                 if fresh:
+                    t0 = time.monotonic() if rec else 0.0
                     if self._updater is not None:
                         self._apply(key, grad, self._weights[key])
                     else:
                         self._weights[key] = self._weights[key] + grad
+                    if rec:
+                        t_apply = time.monotonic() - t0
                     # record only AFTER a successful apply, so a
                     # failed apply doesn't burn the seq
                     with self._seq_lock:
@@ -528,8 +560,20 @@ class PSServer:
                     if self._wal is not None:
                         # durable BEFORE the ack: an acked push may never
                         # be resent, so it must survive a SIGKILL here
+                        t0 = time.monotonic() if rec else 0.0
                         self._wal.append(0, cid, seq, key,
                                          bytes(payload[16:]))
+                        if rec:
+                            t_wal = time.monotonic() - t0
+            if rec and fresh:
+                # reduce-plane attribution (docs/OBSERVABILITY.md
+                # "Training-fleet telemetry"): optimizer-apply vs
+                # WAL-append+fsync split per applied push, plus the
+                # bounded top-N hot-key table train_report renders
+                obs.observe("kvstore.server.push.apply_seconds", t_apply)
+                if self._wal is not None:
+                    obs.observe("kvstore.server.push.wal_seconds", t_wal)
+                self._hot_keys.record(key, len(payload) - 16, t_apply)
             # chaos: die with the update applied+recorded but unacked —
             # the client MUST retry and the retry MUST dedupe, across a
             # warm restart when snapshots are on (docs/ROBUSTNESS.md)
@@ -539,7 +583,14 @@ class PSServer:
         elif opcode == OP_PULL:
             with self._locks.get(key, self._global_lock):
                 arr = self._weights[key]
+            rec = obs.enabled()
+            t0 = time.monotonic() if rec else 0.0
             _send_msg(conn, OP_PULL, key, _pack_array(arr))
+            if rec:
+                # the serialize half of the per-RPC split (pushes reply
+                # one status byte; pulls pay the array encode + send)
+                obs.observe("kvstore.server.pull.serialize_seconds",
+                            time.monotonic() - t0)
         elif opcode == OP_PUSH_SPARSE:
             # reference kvstore_dist.h sparse PSKV: only touched rows
             # cross the wire; the server applies a row-sparse update.
@@ -599,8 +650,11 @@ class PSServer:
         elif opcode == OP_HB:
             # empty payload = connection-liveness ping (the client's
             # ping-before-reuse path) — replies without touching membership
+            part_blob = cid = None
             if len(payload) >= 16:
                 cid, _rank = struct.unpack_from("<QQ", payload, 0)
+                if len(payload) > 16:
+                    part_blob = payload[16:]
                 st, gen, count = self._elastic_state().heartbeat(cid)
             elif self._elastic is not None:
                 with self._elastic.cv:
@@ -609,6 +663,15 @@ class PSServer:
             else:
                 st, gen, count = ST_OK, 0, 0
             _send_msg(conn, OP_HB, key, struct.pack("<BQI", st, gen, count))
+            if part_blob is not None:
+                # training-fleet telemetry part piggybacked on the
+                # heartbeat (obs/fleetstats.py): windowed step-phase
+                # summaries + the rank's drained spans — ingested AFTER
+                # last_hb was refreshed and the beat acked, so detector
+                # judging and on_straggler policy hooks can never turn a
+                # received heartbeat into a missed one (hooks must still
+                # return promptly — the SLOMonitor callback contract)
+                self.fleet.add_part(cid, part_blob)
         elif opcode == OP_JOIN:
             cid, rank = struct.unpack_from("<QQ", payload, 0)
             state, gen, epoch, part, nparts, count = \
@@ -647,6 +710,65 @@ class PSServer:
                 (cid,) = struct.unpack_from("<Q", payload, 0)
                 self._elastic.leave(cid)
             _send_msg(conn, OP_LEAVE, key, b"\x00")
+        elif opcode == OP_TELEMETRY:
+            # training-fleet telemetry pull: this server's own part (its
+            # kvstore.server.rpc lanes + STATS) plus every cached worker
+            # part. Draining is destructive and the client retries lost
+            # replies, so a collection token re-serves the cached reply
+            # instead of draining (and losing) a second batch — the
+            # serve-plane OP_TELEMETRY idiom.
+            try:
+                spec = json.loads(bytes(payload).decode("utf-8")) \
+                    if len(payload) else {}
+                token = spec.get("token")
+                drain = bool(spec.get("drain", True))
+                if token is None:
+                    blob = json.dumps(self.telemetry(drain=drain),
+                                      default=float).encode("utf-8")
+                else:
+                    # lookup AND drain under ONE lock hold: a retried
+                    # token racing the original's in-flight drain would
+                    # otherwise miss the cache and drain a second batch —
+                    # the first batch then sits under the token, never
+                    # re-requested (exactly the loss the token prevents).
+                    # The drain is CPU-only (ring + dicts), so holding
+                    # the lock serializes rare operator pulls, not RPCs.
+                    with self._telemetry_lock:
+                        blob = self._telemetry_tokens.get(token)
+                        if blob is None:
+                            blob = json.dumps(
+                                self.telemetry(drain=drain),
+                                default=float).encode("utf-8")
+                            self._telemetry_tokens[token] = blob
+                            while len(self._telemetry_tokens) > 16:
+                                self._telemetry_tokens.popitem(last=False)
+                _send_msg(conn, OP_TELEMETRY, key, b"\x00" + blob)
+            except Exception as e:  # noqa: BLE001 — wire-reported
+                obs.inc("kvstore.telemetry_errors")
+                _send_msg(conn, OP_TELEMETRY, key,
+                          b"\x01" + f"{type(e).__name__}: {e}".encode(
+                              "utf-8", "replace"))
+        elif opcode == OP_STATS:
+            # read-only stats snapshot (membership liveness, straggler
+            # verdicts, hot keys, metrics under "metrics" — the serve
+            # plane's STATS schema); {"metrics": false} skips the
+            # registry snapshot for cheap polls
+            try:
+                include = True
+                if len(payload):
+                    try:
+                        spec = json.loads(bytes(payload).decode("utf-8"))
+                        include = bool(spec.get("metrics", True))
+                    except ValueError:
+                        pass
+                blob = json.dumps(self.stats(include_metrics=include),
+                                  default=str).encode("utf-8")
+                _send_msg(conn, OP_STATS, key, b"\x00" + blob)
+            except Exception as e:  # noqa: BLE001 — wire-reported
+                obs.inc("kvstore.stats_errors")
+                _send_msg(conn, OP_STATS, key,
+                          b"\x01" + f"{type(e).__name__}: {e}".encode(
+                              "utf-8", "replace"))
         elif opcode == OP_SHUTDOWN:
             if self._snap_mgr is not None:
                 try:
@@ -657,6 +779,46 @@ class PSServer:
             self.stop()
             return False
         return True
+
+    # ------------------------------------------------------------------
+    # stats + telemetry surfaces (the serve-plane schema on the PS plane)
+    # ------------------------------------------------------------------
+    def stats(self, include_metrics: bool = True) -> dict:
+        """Structured server state: key count, membership liveness, the
+        training-fleet section (per-rank windows + straggler verdicts),
+        the bounded hot-key table, and — ``include_metrics`` — the full
+        registry snapshot under ``"metrics"`` (ONE schema for every
+        numeric runtime signal, the serve-plane STATS discipline)."""
+        out = {"pid": os.getpid(),
+               "uptime_seconds": round(time.monotonic() - self._started, 3),
+               "keys": len(self._weights),
+               "num_workers": self._num_workers}
+        el = self._elastic
+        if el is not None:
+            with el.cv:
+                out["generation"] = el.generation
+                out["epoch"] = el.epoch
+                out["active_workers"] = el.active_count()
+            out["membership"] = [
+                {"rank": rank, "client_id": str(cid), "state": state,
+                 "last_hb_age_s": age}
+                for rank, cid, state, age in el.liveness_table()]
+        out["fleet"] = self.fleet.stats()
+        out["hot_keys"] = self._hot_keys.snapshot()
+        if include_metrics:
+            out["metrics"] = obs.metrics.snapshot()
+        return out
+
+    def telemetry(self, drain: bool = True) -> dict:
+        """``{"parts": [...]}`` — the OP_TELEMETRY document: this
+        process's part first (role ``ps_server``, STATS attached so one
+        pull carries the straggler verdicts and hot keys), then every
+        cached worker part (role ``rank<r>``) with its windows, spans,
+        and clock anchor — the rank lanes of the merged timeline."""
+        st = self.stats(include_metrics=False)
+        part = obs.telemetry_part(drain=drain, role="ps_server")
+        part["stats"] = st
+        return {"parts": [part] + self.fleet.parts(drain=drain)}
 
     def _record_seq(self, cid, key, seq):
         """Caller holds ``self._seq_lock``. LRU-bounded (client churn)."""
@@ -719,11 +881,24 @@ class PSServer:
             # in the static-quorum mode above)
             if not arrived or not required_cids.issubset(arrived):
                 return False
+        if obs.enabled() and el is not None and self._barrier_stamps:
+            # barrier wait-by-rank (reduce-plane attribution): how long
+            # each arrived rank stood at this rendezvous — the rank with
+            # ~zero wait is the one everyone else waited on
+            now = time.monotonic()
+            with el.cv:
+                rank_of = {m.cid: m.rank for m in el.members.values()}
+            for tok, t0 in self._barrier_stamps.items():
+                r = rank_of.get(tok[0])
+                if r is not None:
+                    obs.observe(f"kvstore.barrier_wait.rank{r}_seconds",
+                                now - t0)
         self._barrier_count = 0
         self._barrier_gen += 1
         for tok in self._barrier_arrived:
             self._barrier_released[tok] = True
         self._barrier_arrived.clear()
+        self._barrier_stamps.clear()
         while len(self._barrier_released) > 65536:
             self._barrier_released.popitem(last=False)
         self._barrier_cv.notify_all()
@@ -804,6 +979,7 @@ class PSServer:
                 else:
                     gen = self._barrier_gen
                     self._barrier_arrived[token] = gen
+                    self._barrier_stamps[token] = time.monotonic()
                     self._barrier_count += 1
             else:
                 gen = self._barrier_gen
@@ -825,6 +1001,7 @@ class PSServer:
                                 0, self._barrier_count - 1)
                             if token is not None:
                                 self._barrier_arrived.pop(token, None)
+                                self._barrier_stamps.pop(token, None)
                         ok = False
                         break
                     self._barrier_cv.wait(timeout=remaining)

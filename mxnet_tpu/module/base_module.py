@@ -11,6 +11,7 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import obs
+from ..obs import fleetstats as _fleetstats
 from ..callback import BatchEndParam
 
 __all__ = ["BaseModule"]
@@ -52,9 +53,13 @@ class BaseModule:
 
     # -- composite helpers ------------------------------------------------
     def forward_backward(self, data_batch):
-        with obs.trace.span("forward"):
+        # fleetstats.phase = the ordinary obs span (same names on the
+        # timeline) + windowed per-rank step accounting + the MXNET_CHAOS
+        # _SLOW straggler injection point (docs/OBSERVABILITY.md
+        # "Training-fleet telemetry")
+        with _fleetstats.phase("forward"):
             self.forward(data_batch, is_train=True)
-        with obs.trace.span("backward"):
+        with _fleetstats.phase("backward"):
             self.backward()
 
     def score(self, eval_data, eval_metric, num_batch=None, reset=True, epoch=0,
@@ -320,7 +325,7 @@ class BaseModule:
                 while True:
                     # data_wait = time the step loop blocks on the iterator
                     # (decode + host→device when PrefetchingIter is behind)
-                    with obs.trace.span("data_wait"):
+                    with _fleetstats.phase("data_wait"):
                         data_batch = next(batches, _STOP)
                     if data_batch is _STOP:
                         break
@@ -331,20 +336,20 @@ class BaseModule:
                         # worker SIGKILL'd mid-epoch shrinks the round's
                         # required set after K missed heartbeats and this
                         # returns over the survivors — no barrier timeout
-                        with obs.trace.span("elastic.sync_grads"):
+                        with _fleetstats.phase("elastic.sync_grads"):
                             self._elastic_sync_grads(kvstore)
                     if health_monitor is not None:
                         # stats variant only on steps the sentinel will
                         # sample — the per-param norms' cost amortizes 1/K
                         health_mod.request_stats(health_monitor.will_sample())
-                    with obs.trace.span("update"):
+                    with _fleetstats.phase("update"):
                         self.update()
                     global_step += 1
                     # live device memory, once per batch: the counter track
                     # in the chrome trace + the steady-state leak detector
                     # (one flag check when telemetry is off)
                     obs.device.sample(step=global_step)
-                    with obs.trace.span("metric"):
+                    with _fleetstats.phase("metric"):
                         self.update_metric(eval_metric, data_batch.label)
                     if health_monitor is not None:
                         # sampled every K steps; sits BEFORE this step's
@@ -403,10 +408,15 @@ class BaseModule:
                     if (manager is not None and checkpoint_batch_period
                             and can_position
                             and global_step % checkpoint_batch_period == 0):
-                        with obs.trace.span("checkpoint", step=global_step):
+                        with _fleetstats.phase("checkpoint",
+                                               step=global_step):
                             manager.save(self._capture_training_state(
                                 epoch, nbatch, global_step, train_data),
                                 global_step)
+                    # close the step's fleet accounting: phases recorded
+                    # above fold into this rank's current window; sealed
+                    # windows ride the next heartbeat to the PS server
+                    _fleetstats.step_complete(global_step)
                     if manager is not None and manager.preempted.is_set():
                         # flush a final snapshot after the in-flight batch;
                         # with a non-positionable iterator no mid-epoch point
@@ -491,6 +501,9 @@ class BaseModule:
                                          epoch, name, val)
                 epoch += 1
         finally:
+            # seal the partial fleet-accounting window so a short fit's
+            # step attribution still ships on the closing heartbeats
+            _fleetstats.flush()
             if health_monitor is not None:
                 health_mod.request_stats(None)
                 health_mod.deactivate()

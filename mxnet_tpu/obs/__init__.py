@@ -45,11 +45,12 @@ from . import blackbox  # crash flight recorder
 from . import slo  # SLO monitor over merged telemetry
 from . import device  # device plane: XLA cost/memory accounting, MFU
 from . import health  # training-health plane: numerics sentinel + rollback
+from . import fleetstats  # training-fleet plane: step attribution, stragglers
 
 __all__ = ["trace", "metrics", "context", "export_mod", "tail", "profile",
-           "blackbox", "slo", "device", "health", "enable", "disable",
-           "enabled", "span", "event", "inc", "observe", "set_gauge",
-           "export", "reset", "telemetry_part"]
+           "blackbox", "slo", "device", "health", "fleetstats", "enable",
+           "disable", "enabled", "span", "event", "inc", "observe",
+           "set_gauge", "export", "reset", "telemetry_part"]
 
 # re-exported hot-path helpers (obs.span is obs.trace.span)
 span = trace.span
@@ -91,6 +92,7 @@ def reset() -> None:
     metrics.reset()
     device.reset()
     tail.reset()
+    fleetstats.reset()
 
 
 # -- self-gating convenience helpers for instrumentation call sites --------

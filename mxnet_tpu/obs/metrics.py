@@ -342,6 +342,25 @@ _DESCRIPTIONS: Dict[str, str] = {
     "health.rollbacks": "automatic checkpoint rollbacks taken",
     "health.lr_backoffs": "automatic learning-rate backoffs taken",
     "health.nan_provenance": "NaN blame passes run",
+    # training-fleet telemetry plane (obs/fleetstats.py)
+    "train.step.seconds": "per-rank optimizer-step wall time",
+    "train.straggler.verdicts":
+        "straggler verdicts fired by the fleet detector",
+    "train.straggler.recoveries":
+        "flagged ranks cleared after sustained recovery",
+    "train.straggler.flagged": "ranks currently flagged as stragglers",
+    "train.fleet.bad_parts":
+        "piggybacked worker telemetry parts that failed to parse",
+    "kvstore.generation": "PS membership generation (fleet view)",
+    "kvstore.live_workers": "active workers at the last liveness sweep",
+    "kvstore.server.push.apply_seconds":
+        "optimizer-apply time per applied push (reduce-plane split)",
+    "kvstore.server.push.wal_seconds":
+        "WAL append+fsync time per applied push (reduce-plane split)",
+    "kvstore.server.pull.serialize_seconds":
+        "reply array encode+send time per pull (reduce-plane split)",
+    "kvstore.telemetry_errors": "PS OP_TELEMETRY handler failures",
+    "kvstore.stats_errors": "PS OP_STATS handler failures",
     # tail retention / profiler / flight recorder (the black-box plane)
     "tail.resolved":
         "pending traces promoted by a telemetry-plane verdict list",
@@ -353,6 +372,19 @@ _DESCRIPTIONS: Dict[str, str] = {
 # (prefix, help) families for dynamically named metrics — longest prefix
 # wins so `kvstore.server.rpc.` beats `kvstore.rpc.` beats `kvstore.`
 _FAMILY_DESCRIPTIONS = (
+    ("train.step.", "per-rank step-phase durations (fleet accounting)"),
+    ("train.straggler.rank",
+     "1 while the named rank is flagged as a straggler"),
+    ("kvstore.member", "per-member heartbeat age at the last liveness"
+                       " sweep (removed when the member is pruned)"),
+    ("kvstore.reduce_wait.",
+     "per-rank wait at generation-scoped reduce release"),
+    ("kvstore.reduce_last_arriver.",
+     "rounds in which the named rank arrived last (what the fleet"
+     " waited on)"),
+    ("kvstore.barrier_wait.", "per-rank wait at barrier release"),
+    ("kvstore.server.push.", "PS push service-time split (apply vs WAL)"),
+    ("kvstore.server.pull.", "PS pull service-time split (serialize)"),
     ("kvstore.server.rpc.", "PS server-side service time per opcode"),
     ("kvstore.rpc.backoff", "per-retry backoff sleeps"),
     ("kvstore.rpc.", "PS client-side RPC latency per opcode"),

@@ -124,6 +124,36 @@ _ENV_REGISTRY = {
                         "counted forward occurrences, e.g. 'data@5' "
                         "(chaos/nan.py — tests the breach/provenance/"
                         "rollback chain deterministically)."),
+    # training-fleet telemetry plane (obs/fleetstats.py,
+    # docs/OBSERVABILITY.md "Training-fleet telemetry")
+    "MXNET_OBS_FLEET": (None, "0 = veto the training-fleet plane (per-rank "
+                        "step-phase windows, heartbeat piggyback, "
+                        "straggler detection) even with MXNET_OBS=1; it "
+                        "is on by default whenever telemetry records."),
+    "MXNET_OBS_FLEET_WINDOW": ("10", "Optimizer steps per accounting "
+                               "window; windows seal at multiples of "
+                               "this and ship on the next heartbeat."),
+    "MXNET_OBS_FLEET_FACTOR": ("1.5", "Straggler threshold: a rank whose "
+                               "own time (step minus reduce-wait) "
+                               "exceeds the fleet median by this factor "
+                               "is lagging."),
+    "MXNET_OBS_FLEET_K": ("3", "Consecutive lagging windows before a "
+                          "straggler verdict fires (and, symmetrically, "
+                          "recovered windows before it clears)."),
+    "MXNET_OBS_FLEET_SHIP_S": ("2", "Max seconds between heartbeat-"
+                               "piggybacked telemetry ships when no new "
+                               "window sealed (spans still flow)."),
+    "MXNET_OBS_FLEET_MAX_SPANS": ("4096", "Newest spans kept per "
+                                  "piggybacked ship (a stalled fleet "
+                                  "cannot grow one heartbeat frame "
+                                  "without bound)."),
+    "MXNET_OBS_FLEET_HOT_KEYS": ("32", "Capacity of the PS server's "
+                                 "bounded top-N hot-key table "
+                                 "(space-saving admission)."),
+    "MXNET_CHAOS_SLOW": (None, "Chaos: delay a named rank's step phase at "
+                         "counted occurrences, e.g. '1:forward@5-40:0.25' "
+                         "(chaos/slow.py — proves the straggler detector "
+                         "flags the injected rank AND phase)."),
     # black-box plane (obs/tail.py, obs/profile.py, obs/blackbox.py —
     # docs/OBSERVABILITY.md "Tail sampling" / "Continuous profiling" /
     # "Flight recorder")

@@ -170,6 +170,14 @@ PS_WIRE = WireRegistry(
         OpSpec("reduce", 18, "elastic", mutating=True, dedup="idempotent"),
         OpSpec("epoch", 19, "elastic", mutating=True, dedup="idempotent"),
         OpSpec("leave", 20, "elastic", mutating=True, dedup="idempotent"),
+        # training-fleet telemetry pull (obs/fleetstats.py): draining the
+        # server's span ring + cached per-worker parts is destructive, so
+        # retried collections re-serve the cached reply from the token LRU
+        # (the serve-plane OP_TELEMETRY=42 idiom on the PS wire)
+        OpSpec("telemetry", 21, "elastic", mutating=True, dedup="token"),
+        # server stats snapshot (membership liveness, straggler verdicts,
+        # hot keys, metrics under "metrics") — read-only, retries harmless
+        OpSpec("stats", 22, "elastic"),
     ])
 
 

@@ -63,6 +63,12 @@ def pytest_configure(config):
         "(docs/SERVING.md \"Mesh-sharded serving\"); run via "
         "`pytest -m serve_mesh` or `make serve_mesh`")
     config.addinivalue_line(
+        "markers", "train_obs: training-fleet telemetry tests — per-rank "
+        "step attribution, straggler detection/blame, PS telemetry "
+        "opcode, reduce-plane accounting (docs/OBSERVABILITY.md "
+        "\"Training-fleet telemetry\"); run via `pytest -m train_obs` or "
+        "`make train-obs`")
+    config.addinivalue_line(
         "markers", "progcache: persistent AOT program-cache tests — "
         "shared key derivation, hit/miss/reject structure, cache-hit "
         "bitwise parity, replica restart warm-from-disk "

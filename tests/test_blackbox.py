@@ -226,6 +226,10 @@ def test_recorder_ring_records_tail_held_spans_too():
     spans the tail policy would later DROP."""
     obs.enable()
     tail.enable()
+    # pin the uniform baseline to 0 (the test_tail idiom): the default
+    # 1% keep-anyway coin flip would promote the "doomed" trace into the
+    # durable ring about one run in a hundred — a flake, not a finding
+    tail.buffer().policy = tail.RetentionPolicy(baseline=0.0)
     blackbox.enable()
     ctx = context.new_root()
     with context.use(ctx):

@@ -1,0 +1,796 @@
+"""Training-fleet telemetry plane (docs/OBSERVABILITY.md "Training-fleet
+telemetry", obs/fleetstats.py):
+
+1. StragglerDetector as a PURE function on synthetic per-rank series —
+   lag/recover/flap hysteresis, blame selection (compute / data-wait /
+   reduce-wait), lockstep blindness handled via own-time, zero false
+   positives on a uniform fleet;
+2. windowed per-rank step accounting (seal at window boundaries,
+   ``train.step.*`` histograms, ship-once wire parts);
+3. the PS-wire telemetry plane: heartbeat-piggybacked worker parts, the
+   OP_TELEMETRY pull (server part + rank parts), exactly-once drains
+   under chaos ``drop_reply``, STATS with membership gauges + straggler
+   verdicts + ``metrics.snapshot()`` under "metrics";
+4. reduce-plane accounting: hot-key table boundedness, push apply/WAL
+   split histograms, reduce wait-by-rank;
+5. the merged multi-rank timeline — live ranks over the wire, a
+   SIGKILL'd rank's JSONL corpse as an extra lane;
+6. ``MXNET_CHAOS_SLOW`` determinism; flagship (slow): a 3-worker elastic
+   fit with rank 1's forward slowed → the detector names rank 1 AND
+   blames compute within K windows, rendered by train_report, with zero
+   false positives on the uninjected twin run.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import obs
+from mxnet_tpu.obs import fleetstats
+
+pytestmark = pytest.mark.train_obs
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable()
+    obs.reset()
+    yield
+    from mxnet_tpu.chaos import rpc as chaos_rpc
+    from mxnet_tpu.chaos import slow as chaos_slow
+
+    chaos_rpc.reset()
+    chaos_slow.reset()
+    obs.disable()
+    obs.reset()
+
+
+def _win(step_time, forward=0.0, data=0.0, reduce=0.0, steps=10):
+    return {"steps": steps, "step_time": step_time,
+            "phases": {"forward": forward, "data_wait": data,
+                       "elastic.sync_grads": reduce}}
+
+
+# ---------------------------------------------------------------------------
+# 1. the detector as a pure function
+# ---------------------------------------------------------------------------
+
+def test_detector_flags_lagging_rank_with_compute_blame():
+    d = fleetstats.StragglerDetector(factor=1.5, k=3)
+    evs = []
+    for i in range(6):
+        evs += d.observe(i, {
+            0: _win(1.0, forward=0.2, reduce=0.75),
+            1: _win(1.0, forward=0.22, reduce=0.73),
+            2: _win(1.0, forward=0.9, reduce=0.05)})
+    fired = [e for e in evs if e["kind"] == "straggler"]
+    assert len(fired) == 1  # fires ONCE, not per window
+    v = fired[0]
+    assert v["rank"] == 2 and v["blame"] == "compute"
+    assert v["window"] == 2  # k=3 consecutive windows: 0,1,2
+    assert 2 in d.flagged
+
+
+def test_detector_lockstep_blindness_needs_own_time():
+    """Under lockstep sync every rank's RAW step time is the slowest
+    rank's — the detector must still name the slow rank (own time lags)
+    and must NOT flag the fast ranks (their inflation is reduce-wait)."""
+    d = fleetstats.StragglerDetector(factor=1.5, k=2)
+    evs = []
+    for i in range(4):
+        evs += d.observe(i, {
+            0: _win(1.0, forward=0.1, reduce=0.85),
+            1: _win(1.0, forward=0.12, reduce=0.83),
+            2: _win(1.0, forward=0.95, reduce=0.02)})
+    assert {e["rank"] for e in evs if e["kind"] == "straggler"} == {2}
+
+
+def test_detector_data_wait_blame():
+    d = fleetstats.StragglerDetector(factor=1.5, k=2)
+    evs = []
+    for i in range(3):
+        evs += d.observe(i, {
+            0: _win(0.3, forward=0.2, data=0.05),
+            1: _win(0.3, forward=0.2, data=0.05),
+            2: _win(0.9, forward=0.2, data=0.65)})
+    fired = [e for e in evs if e["kind"] == "straggler"]
+    assert fired and fired[0]["rank"] == 2
+    assert fired[0]["blame"] == "data_wait"
+
+
+def test_detector_reduce_wait_blame():
+    """Async shape: a rank whose own work is normal but whose step time
+    AND reduce-wait both lag the fleet (its RPC path is slow) is blamed
+    on the reduce plane."""
+    d = fleetstats.StragglerDetector(factor=1.5, k=2)
+    evs = []
+    for i in range(3):
+        evs += d.observe(i, {
+            0: _win(1.0, forward=0.8, reduce=0.15),
+            1: _win(1.0, forward=0.82, reduce=0.13),
+            2: _win(2.2, forward=0.8, reduce=1.35)})
+    fired = [e for e in evs if e["kind"] == "straggler"]
+    assert fired and fired[0]["rank"] == 2
+    assert fired[0]["blame"] == "reduce_wait"
+
+
+def test_detector_no_false_positive_on_uniform_fleet():
+    d = fleetstats.StragglerDetector(factor=1.5, k=2)
+    rng = np.random.RandomState(3)
+    for i in range(20):
+        per = {r: _win(0.1 * (1 + 0.1 * rng.rand()),
+                       forward=0.08, reduce=0.01) for r in range(4)}
+        assert d.observe(i, per) == []
+    assert d.flagged == {}
+
+
+def test_detector_recover_and_flap_hysteresis():
+    d = fleetstats.StragglerDetector(factor=1.5, k=2)
+    lag = {0: _win(1.0, forward=0.9), 1: _win(0.3, forward=0.25),
+           2: _win(0.3, forward=0.26)}
+    ok = {0: _win(0.3, forward=0.25), 1: _win(0.3, forward=0.25),
+          2: _win(0.3, forward=0.26)}
+    # just-under-factor lag: above the recovery threshold, below factor
+    mid = {0: _win(0.4, forward=0.35), 1: _win(0.3, forward=0.25),
+           2: _win(0.3, forward=0.26)}
+    i = 0
+    evs = []
+    for w in (lag, lag):
+        evs += d.observe(i, w)
+        i += 1
+    assert 0 in d.flagged
+    # flapping around the threshold must NOT clear the verdict
+    for w in (mid, lag, mid, lag):
+        evs += d.observe(i, w)
+        i += 1
+    assert 0 in d.flagged
+    assert not [e for e in evs if e["kind"] == "recovered"]
+    # one clean window is not enough (k=2)...
+    evs += d.observe(i, ok)
+    i += 1
+    assert 0 in d.flagged
+    # ...two consecutive clean windows clear it
+    evs += d.observe(i, ok)
+    rec = [e for e in evs if e["kind"] == "recovered"]
+    assert rec and rec[0]["rank"] == 0 and rec[0]["was_blamed"] == "compute"
+    assert 0 not in d.flagged
+
+
+def test_judging_not_throttled_after_clean_leave():
+    """A cleanly-departed member keeps its cached telemetry (post-run
+    reports) — but its corpse must NOT count toward the expected report
+    set, or every window after a scale-down would wait out the STALE_S
+    timeout before judging (regression: live view replaces, never
+    max-es, the reporting count)."""
+    agg = fleetstats.FleetAggregator(
+        detector=fleetstats.StragglerDetector(factor=1.5, k=1),
+        member_ranks=lambda: [0, 1])  # rank 2 LEFT; its cache remains
+
+    def part(rank, w, st):
+        return json.dumps({
+            "rank": rank, "pid": 100 + rank,
+            "windows": [{"w": w, "steps": 4, "step_time": st,
+                         "phases": {"forward": st}}]}).encode()
+
+    agg.add_part(3, part(2, 0, 0.1))  # the leaver's last window
+    for w in (0, 1):
+        agg.add_part(1, part(0, w, 0.1))
+        agg.add_part(2, part(1, w, 0.1))
+    # window 1 has only the two LIVE ranks — it must be judged NOW, not
+    # after the 15s stale escape hatch
+    assert agg._judged_to == 1
+
+
+def test_detector_needs_two_ranks():
+    d = fleetstats.StragglerDetector(factor=1.5, k=1)
+    assert d.observe(0, {0: _win(9.0, forward=9.0)}) == []
+
+
+def test_aggregator_survives_garbage_windows():
+    """JSON-valid but semantically-garbage parts (version skew, a buggy
+    custom part_provider) must neither poison the cache nor crash the
+    heartbeat handler that ingests them — bad windows are counted and
+    skipped at ingest."""
+    obs.enable()
+    agg = fleetstats.FleetAggregator(
+        detector=fleetstats.StragglerDetector(factor=1.5, k=1))
+    good = {"w": 0, "steps": 4, "step_time": 0.1,
+            "phases": {"forward": 0.09}}
+    bad = [{"w": 1, "steps": 4, "step_time": None},       # null numeric
+           {"w": 1, "steps": 4, "step_time": 0.1,
+            "phases": ["not", "a", "dict"]},              # wrong type
+           {"steps": 4}]                                  # no index
+    assert agg.add_part(1, json.dumps(
+        {"rank": 0, "windows": [good] + bad}).encode())
+    assert agg.add_part(2, json.dumps(
+        {"rank": 1, "windows": [good]}).encode())
+    # only the sane window was cached; the garbage was counted
+    assert list(agg._members[1].windows) == [0]
+    assert obs.metrics.registry.get("train.fleet.bad_parts").value >= 3
+    # and not-JSON-at-all still returns False without raising
+    assert not agg.add_part(3, b"\xff\xfe garbage")
+    # the shared summarizer agrees with the cached view
+    s = fleetstats.summarize_windows(agg._members[1].windows.values())
+    assert s["steps"] == 4 and s["phases"]["compute"] > 0
+
+
+# ---------------------------------------------------------------------------
+# 2. windowed step accounting
+# ---------------------------------------------------------------------------
+
+def test_step_accounting_windows_seal_and_ship_once():
+    obs.enable()
+    acc = fleetstats.StepAccounting(rank=5, window=3, own_spans=False,
+                                    ship_interval_s=9999)
+    for step in range(1, 8):  # 7 steps: windows 0,1 sealed, 2 partial
+        with acc.phase("forward"):
+            pass
+        with acc.phase("data_wait"):
+            pass
+        acc.step_complete(step)
+    assert [w["w"] for w in acc.windows] == [0, 1]
+    w0 = acc.windows[0]
+    assert w0["steps"] == 3
+    assert set(w0["phases"]) == {"forward", "data_wait"}
+    assert w0["step_time"] > 0
+    # the first ship carries both sealed windows; the next has nothing
+    blob = acc.wire_part()
+    part = json.loads(blob.decode())
+    assert part["rank"] == 5
+    assert [w["w"] for w in part["windows"]] == [0, 1]
+    assert acc.wire_part() is None
+    # flush seals the partial window and it ships
+    acc.flush()
+    part2 = json.loads(acc.wire_part().decode())
+    assert [w["w"] for w in part2["windows"]] == [2]
+    assert part2["windows"][0]["steps"] == 1
+    # per-step histograms recorded
+    h = obs.metrics.registry.get("train.step.seconds")
+    assert h is not None and h.count == 7
+    assert obs.metrics.registry.get("train.step.forward_seconds").count == 7
+
+
+def test_step_accounting_zero_cost_when_off():
+    acc = fleetstats.StepAccounting(rank=0, window=2, own_spans=False)
+    with acc.phase("forward"):
+        pass
+    acc.step_complete(1)
+    assert not acc.windows and acc.wire_part() is None
+    assert obs.metrics.registry.get("train.step.seconds") is None
+
+
+def test_fleet_veto_disables_accounting():
+    obs.enable()
+    os.environ["MXNET_OBS_FLEET"] = "0"
+    try:
+        acc = fleetstats.StepAccounting(rank=0, window=1, own_spans=False)
+        with acc.phase("forward"):
+            pass
+        acc.step_complete(1)
+        assert not acc.windows
+    finally:
+        del os.environ["MXNET_OBS_FLEET"]
+
+
+# ---------------------------------------------------------------------------
+# 3. hot keys
+# ---------------------------------------------------------------------------
+
+def test_hot_key_table_bounded_and_hot_keys_surface():
+    t = fleetstats.HotKeyTable(capacity=8)
+    rng = np.random.RandomState(0)
+    for i in range(2000):
+        # two genuinely hot keys in a sea of one-off cold ones
+        if i % 3 != 2:
+            key = "hot0" if i % 2 == 0 else "hot1"
+        else:
+            key = f"cold{i}"
+        t.record(key, nbytes=64, apply_s=0.001 * rng.rand())
+        assert len(t) <= 8  # BOUNDED, always
+    snap = t.snapshot(n=2)
+    assert {r["key"] for r in snap} == {"hot0", "hot1"}
+    assert all(r["pushes"] > 100 for r in snap)
+    assert all("push_rate" in r and "apply_ms_avg" in r for r in snap)
+
+
+# ---------------------------------------------------------------------------
+# 4. the PS-wire telemetry plane
+# ---------------------------------------------------------------------------
+
+def _mk_server(**kw):
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    srv = PSServer(host="127.0.0.1", port=0, **kw)
+    srv.start()
+    return srv
+
+
+def test_heartbeat_piggyback_caches_parts_and_detects_straggler():
+    from mxnet_tpu.kvstore.elastic import ElasticWorkerSession
+
+    obs.enable()
+    srv = _mk_server(hb_interval=0.05, miss_k=4)
+    srv.fleet.detector = fleetstats.StragglerDetector(factor=1.5, k=2)
+    verdicts = []
+    srv.fleet.on_straggler(verdicts.append)
+    accs = [fleetstats.StepAccounting(rank=r, window=2, own_spans=False,
+                                      ship_interval_s=0.02)
+            for r in range(3)]
+    sessions = []
+    try:
+        sessions = [ElasticWorkerSession(
+            "127.0.0.1", srv.port, rank=r, hb_interval=0.05,
+            part_provider=accs[r].wire_part) for r in range(3)]
+        for s in sessions:
+            s.ensure_joined(wait_for_expected=False)
+
+        def _loop(r):
+            for step in range(1, 13):
+                with accs[r].phase("forward"):
+                    time.sleep(0.03 if r == 2 else 0.005)
+                accs[r].step_complete(step)
+            accs[r].flush()
+
+        ts = [threading.Thread(target=_loop, args=(r,), daemon=True)
+              for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not verdicts:
+            time.sleep(0.05)
+        fired = [v for v in verdicts if v["kind"] == "straggler"]
+        assert fired, srv.fleet.stats()
+        assert fired[0]["rank"] == 2
+        assert fired[0]["blame"] == "compute"
+        # STATS: serve-plane schema — metrics under "metrics", membership
+        # liveness, the training-fleet section with the verdict
+        from mxnet_tpu.kvstore.ps_client import PSClient
+
+        cli = PSClient("127.0.0.1", srv.port, timeout=10, retries=3,
+                       retry_interval=0.1)
+        st = cli.stats()
+        assert "metrics" in st and "counters" in st["metrics"]
+        assert st["fleet"]["stragglers"][0]["rank"] == 2
+        assert set(st["fleet"]["ranks"]) == {"0", "1", "2"}
+        assert any(m["state"] == "active" for m in st["membership"])
+        # straggler surfaced as metrics too
+        assert st["metrics"]["counters"].get(
+            "train.straggler.verdicts", 0) >= 1
+        assert st["metrics"]["gauges"].get("train.straggler.rank2") == 1
+        # membership liveness gauges (refreshed by the liveness sweep)
+        assert any(k.startswith("kvstore.member")
+                   and k.endswith("last_hb_age_s")
+                   for k in st["metrics"]["gauges"])
+        # rank parts ride the telemetry pull with their windows
+        tel = cli.telemetry()
+        roles = {p.get("role") for p in tel["parts"]}
+        assert "ps_server" in roles
+        assert {"rank0", "rank1", "rank2"} <= roles
+        rank2 = next(p for p in tel["parts"] if p.get("role") == "rank2")
+        assert rank2["windows"]
+    finally:
+        for s in sessions:
+            s.close()
+        srv.stop()
+
+
+def test_ps_telemetry_exactly_once_under_chaos_drop_reply():
+    from mxnet_tpu.chaos import rpc as chaos_rpc
+    from mxnet_tpu.kvstore.ps_client import PSClient
+
+    obs.enable()
+    srv = _mk_server()
+    try:
+        cli = PSClient("127.0.0.1", srv.port, timeout=10, retries=4,
+                       retry_interval=0.05)
+        cli.init("uniq_marker_key", np.zeros(4, np.float32))
+        time.sleep(0.1)  # let the server-side span land in the ring
+        chaos_rpc.configure(
+            [chaos_rpc.Rule("telemetry", "drop_reply", {1})])
+        tel = cli.telemetry()  # first reply dropped -> retried token
+        chaos_rpc.reset()
+        server_part = next(p for p in tel["parts"]
+                           if p.get("role") == "ps_server")
+        # in-process test: client + server share one tracer ring, so
+        # filter to the SERVER-side span of the marker RPC
+        marker = [s for s in server_part["spans"]
+                  if s.get("name") == "kvstore.server.rpc"
+                  and (s.get("args") or {}).get("key")
+                  == "uniq_marker_key"]
+        # the drained INIT span came through EXACTLY once despite the
+        # retry (the retried frame re-served the cached reply instead of
+        # draining a drained ring)
+        assert len(marker) == 1, marker
+        # a FRESH collection does not see it again (drains are increments)
+        tel2 = cli.telemetry()
+        server_part2 = next(p for p in tel2["parts"]
+                            if p.get("role") == "ps_server")
+        assert not [s for s in server_part2["spans"]
+                    if s.get("name") == "kvstore.server.rpc"
+                    and (s.get("args") or {}).get("key")
+                    == "uniq_marker_key"]
+    finally:
+        srv.stop()
+
+
+def test_member_prune_and_leave_remove_gauges_and_cached_parts():
+    from mxnet_tpu.kvstore.elastic import ElasticWorkerSession
+
+    obs.enable()
+    srv = _mk_server(hb_interval=0.05, miss_k=3)
+    try:
+        acc = fleetstats.StepAccounting(rank=0, window=1, own_spans=False,
+                                        ship_interval_s=0.02)
+        s = ElasticWorkerSession("127.0.0.1", srv.port, rank=0,
+                                 hb_interval=0.05,
+                                 part_provider=acc.wire_part)
+        info = s.ensure_joined(wait_for_expected=False)
+        assert info.active
+        with acc.phase("forward"):
+            pass
+        acc.step_complete(1)
+        acc.flush()
+        cid = s.cid
+        deadline = time.monotonic() + 10
+        gname = f"kvstore.member{cid}.last_hb_age_s"
+        while time.monotonic() < deadline:
+            if obs.metrics.registry.get(gname) is not None \
+                    and srv.fleet._members.get(cid) is not None:
+                break
+            time.sleep(0.05)
+        assert obs.metrics.registry.get(gname) is not None
+        assert srv.fleet._members.get(cid) is not None
+        s.close()  # leave() — the member is gone from the exposition
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if obs.metrics.registry.get(gname) is None:
+                break
+            time.sleep(0.05)
+        # gauge removed (not frozen forever) — but the cached telemetry
+        # SURVIVES a clean leave: its step attribution is what a
+        # post-run train_report pulls (the cache is LRU-bounded anyway)
+        assert obs.metrics.registry.get(gname) is None
+        assert srv.fleet._members.get(cid) is not None
+        # the prune GC path (a corpse reaped long after death)
+        # additionally drops the cached parts
+        srv._elastic._forget_member(cid, pruned=True)
+        assert srv.fleet._members.get(cid) is None
+    finally:
+        srv.stop()
+
+
+def test_push_split_metrics_and_hot_keys(tmp_path):
+    from mxnet_tpu.kvstore.ps_client import PSClient
+
+    obs.enable()
+    srv = _mk_server(snapshot_dir=str(tmp_path), snapshot_period=0)
+    try:
+        cli = PSClient("127.0.0.1", srv.port, timeout=10, retries=3,
+                       retry_interval=0.1)
+        cli.init("w_hot", np.zeros(128, np.float32))
+        cli.init("w_cold", np.zeros(128, np.float32))
+        g = np.ones(128, np.float32)
+        for _ in range(6):
+            cli.push("w_hot", g)
+        cli.push("w_cold", g)
+        cli.pull("w_hot")
+        st = cli.stats()
+        hot = st["hot_keys"]
+        assert hot[0]["key"] == "w_hot" and hot[0]["pushes"] == 6
+        hists = st["metrics"]["histograms"]
+        assert hists["kvstore.server.push.apply_seconds"]["count"] == 7
+        # WAL split recorded (snapshot_dir arms the WAL)
+        assert hists["kvstore.server.push.wal_seconds"]["count"] == 7
+        assert hists["kvstore.server.pull.serialize_seconds"]["count"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_reduce_wait_by_rank_recorded():
+    from mxnet_tpu.kvstore.elastic import ElasticWorkerSession
+
+    obs.enable()
+    srv = _mk_server(hb_interval=0.05, miss_k=4)
+    sessions = []
+    try:
+        sessions = [ElasticWorkerSession("127.0.0.1", srv.port, rank=r,
+                                         hb_interval=0.05,
+                                         part_provider=None)
+                    for r in range(2)]
+        for s in sessions:
+            s.ensure_joined(wait_for_expected=False)
+        arr = np.ones(16, np.float32)
+        results = {}
+
+        def _contrib(r, delay):
+            time.sleep(delay)
+            results[r] = sessions[r].allreduce("k", arr, timeout=30)
+
+        t0 = threading.Thread(target=_contrib, args=(0, 0.0), daemon=True)
+        t1 = threading.Thread(target=_contrib, args=(1, 0.3), daemon=True)
+        t0.start()
+        t1.start()
+        t0.join(timeout=30)
+        t1.join(timeout=30)
+        assert results[0][0][0] == 2.0
+        h0 = obs.metrics.registry.get("kvstore.reduce_wait.rank0_seconds")
+        h1 = obs.metrics.registry.get("kvstore.reduce_wait.rank1_seconds")
+        assert h0 is not None and h1 is not None
+        # rank 0 arrived first and waited ~0.3s; rank 1 arrived last and
+        # waited ~0 — the server names rank 1 as what the fleet waited on
+        assert h0.sum > h1.sum
+        c = obs.metrics.registry.get("kvstore.reduce_last_arriver.rank1")
+        assert c is not None and c.value == 1
+    finally:
+        for s in sessions:
+            s.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 5. merged multi-rank timeline with a corpse lane
+# ---------------------------------------------------------------------------
+
+def test_merged_timeline_includes_corpse_lane(tmp_path):
+    from mxnet_tpu.kvstore.elastic import ElasticWorkerSession
+
+    import train_report
+
+    obs.enable()
+    srv = _mk_server(hb_interval=0.05, miss_k=4)
+    sessions = []
+    try:
+        accs = [fleetstats.StepAccounting(
+            rank=r, window=1, own_spans=False, ship_interval_s=0.02)
+            for r in range(2)]
+        sessions = [ElasticWorkerSession(
+            "127.0.0.1", srv.port, rank=r, hb_interval=0.05,
+            part_provider=accs[r].wire_part) for r in range(2)]
+        for s in sessions:
+            s.ensure_joined(wait_for_expected=False)
+        for step in (1, 2):
+            for acc in accs:
+                with acc.phase("forward"):
+                    time.sleep(0.002)
+                acc.step_complete(step)
+        for acc in accs:
+            acc.flush()
+        time.sleep(0.4)
+        tel = fleetstats.collect("127.0.0.1", srv.port)
+        # the wire gave us the server + both live ranks; a SIGKILL'd
+        # rank's evidence is its flush-per-event JSONL stream — fake its
+        # corpse: a clock anchor, a forward span, then a TORN final line
+        corpse = tmp_path / "rank9.jsonl"
+        corpse.write_text(
+            json.dumps({"ph": "M", "name": "clock", "pid": 994242,
+                        "wall_epoch": time.time() - 1.0}) + "\n"
+            + json.dumps({"ph": "X", "name": "forward", "ts": 0.1,
+                          "dur": 0.05, "tid": 1, "pid": 994242}) + "\n"
+            + '{"ph": "X", "name": "upda')  # SIGKILL mid-write
+        doc_path = tmp_path / "pulled.json"
+        doc_path.write_text(json.dumps(tel, default=float))
+        out = train_report.main([
+            "--input", str(doc_path), "--jsonl", str(corpse),
+            "--trace", str(tmp_path / "merged.json"), "--json"])
+        merged = json.loads((tmp_path / "merged.json").read_text())
+        names = {e["args"]["name"] for e in merged["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert "ps_server" in names
+        assert {"rank0", "rank1"} <= names
+        assert any(n.startswith("jsonl:rank9") for n in names)
+        # the corpse's lane carries its forward span, rebased via its
+        # wall-clock anchor onto the same origin as the live lanes
+        corpse_spans = [e for e in merged["traceEvents"]
+                        if e.get("pid") == 994242 and e.get("ph") == "X"]
+        assert any(e["name"] == "forward" for e in corpse_spans)
+        assert out["torn_records"] == 1
+        # every live part carried a wall-clock anchor (the merge key)
+        assert all(p.get("wall_epoch") is not None for p in tel["parts"])
+        assert "Training fleet" in out["report"]
+        assert "rank" in out["report"]
+    finally:
+        for s in sessions:
+            s.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 6. the chaos straggler injector
+# ---------------------------------------------------------------------------
+
+def test_chaos_slow_parse_and_counted_occurrences():
+    from mxnet_tpu.chaos import slow
+
+    rules = slow.parse_env("1:forward@2-3,7:0.01;0:data_wait::0.02")
+    assert rules[0].rank == 1 and rules[0].phase == "forward"
+    assert rules[0].occurrences == {2, 3, 7}
+    assert rules[1].occurrences is None and rules[1].seconds == 0.02
+    with pytest.raises(ValueError):
+        slow.parse_env("garbled")
+
+    slow.configure([slow.Rule(1, "forward", {2}, 0.05)])
+    slow.set_rank(1)
+    assert slow.maybe_delay("forward") == 0.0   # occurrence 1
+    t0 = time.monotonic()
+    assert slow.maybe_delay("forward") == 0.05  # occurrence 2 fires
+    assert time.monotonic() - t0 >= 0.05
+    assert slow.maybe_delay("forward") == 0.0   # occurrence 3
+    assert slow.maybe_delay("backward") == 0.0  # other phases untouched
+    slow.set_rank(0)
+    assert slow.maybe_delay("forward") == 0.0   # other ranks untouched
+
+
+def test_chaos_slow_fires_inside_fleetstats_phase():
+    from mxnet_tpu.chaos import slow
+
+    obs.enable()
+    os.environ["MXNET_CHAOS_SLOW"] = "3:forward::0.03"
+    try:
+        slow.configure(slow.parse_env(os.environ["MXNET_CHAOS_SLOW"]))
+        slow.set_rank(3)
+        acc = fleetstats.StepAccounting(rank=3, window=1, own_spans=False)
+        t0 = time.monotonic()
+        with acc.phase("forward"):
+            pass
+        assert time.monotonic() - t0 >= 0.03
+        acc.step_complete(1)
+        acc.flush()
+        # the injected delay lands in the PHASE the detector will blame
+        assert acc.windows[0]["phases"]["forward"] >= 0.03
+        # and is tagged in the same timeline
+        assert any(e[1] == "chaos.slow" for e in obs.trace.events())
+    finally:
+        del os.environ["MXNET_CHAOS_SLOW"]
+
+
+# ---------------------------------------------------------------------------
+# flagship (slow): chaos-proven detection on a real 3-worker elastic fit
+# ---------------------------------------------------------------------------
+
+def _worker_env(rank, n, ps_port, extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "MXNET_ELASTIC": "1",
+        "MXNET_ELASTIC_HEARTBEAT_S": "0.2",
+        "MXNET_ELASTIC_MISS_K": "4",
+        "MXNET_PS_ADDR": "127.0.0.1",
+        "MXNET_PS_PORT": str(ps_port),
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_WORKER_ID": str(rank),
+        "DMLC_ROLE": "worker",
+        "MXNET_OBS": "1",
+        "MXNET_OBS_FLEET_WINDOW": "2",
+    })
+    env.pop("MXNET_CHAOS_SLOW", None)
+    env.update(extra or {})
+    return env
+
+
+def _run_fleet(tmp_path, tag, chaos_env):
+    import socket as _socket
+
+    from mxnet_tpu.kvstore.ps_client import PSClient
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ps_env = dict(os.environ)
+    ps_env.update({"JAX_PLATFORMS": "cpu",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                   "MXNET_OBS": "1",
+                   "MXNET_OBS_FLEET_FACTOR": "1.5",
+                   "MXNET_OBS_FLEET_K": "2"})
+    ps_env.pop("MXNET_CHAOS_SLOW", None)
+    ps = subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.kvstore.ps_server",
+         "--port", str(port)],
+        env=ps_env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            line = ps.stdout.readline()
+            if "listening" in line:
+                break
+        workers = []
+        for r in range(3):
+            env = _worker_env(r, 3, port, extra=chaos_env)
+            workers.append(subprocess.Popen(
+                [sys.executable, os.path.join(HERE, "elastic_worker.py"),
+                 "--ckpt-dir", str(tmp_path / f"ckpt_{tag}"),
+                 "--epochs", "4", "--step-delay", "0.05"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        outs = []
+        for w in workers:
+            out, _ = w.communicate(timeout=420)
+            outs.append(out)
+            assert w.returncode == 0, out[-3000:]
+        # the PS outlives the fleet: pull its verdicts + telemetry now
+        cli = PSClient("127.0.0.1", port, timeout=15, retries=3,
+                       retry_interval=0.2)
+        stats = cli.stats()
+        tel = cli.telemetry()
+        return stats, tel, outs
+    finally:
+        ps.terminate()
+        try:
+            ps.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            ps.kill()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_flagship_chaos_slow_rank1_flagged_with_compute_blame(tmp_path):
+    """3-worker elastic fit with ``MXNET_CHAOS_SLOW`` stretching rank 1's
+    forward from step 3 on: the PS-side detector must name rank 1 with
+    blame=compute within K windows; the uninjected twin run must produce
+    ZERO straggler verdicts; the one merged timeline carries all ranks'
+    step phases plus the server's RPC lanes on shared wall-clock
+    anchors, rendered by train_report."""
+    import train_report
+
+    # injected run: rank 1's forward +0.25s from its 3rd step onward
+    stats, tel, _ = _run_fleet(
+        tmp_path, "inj",
+        {"MXNET_CHAOS_SLOW": "1:forward@3-999:0.25"})
+    fleet = stats["fleet"]
+    assert fleet["stragglers"], fleet
+    v = fleet["stragglers"][0]
+    assert v["rank"] == 1
+    assert v["blame"] == "compute"
+    # detection latency: flagged within K(=2)+2 windows of the first
+    # fully-slowed window (window 1 holds steps 3-4)
+    first_fired = next(x for x in fleet["verdicts"]
+                       if x["kind"] == "straggler")
+    assert first_fired["window"] <= 1 + 2 + 2, fleet["verdicts"]
+    # per-rank phase attribution made it to the server: rank 1's compute
+    # dominates its peers'
+    ranks = fleet["ranks"]
+    assert ranks["1"]["phases"]["compute"] \
+        > 2 * ranks["0"]["phases"]["compute"]
+    # ONE merged chrome timeline: all ranks' step phases + the PS
+    # server's RPC lanes on the shared wall-clock anchor
+    roles = {p.get("role") for p in tel["parts"]}
+    assert {"ps_server", "rank0", "rank1", "rank2"} <= roles
+    assert all(p.get("wall_epoch") is not None for p in tel["parts"])
+    doc_path = tmp_path / "pulled.json"
+    doc_path.write_text(json.dumps(tel, default=float))
+    out = train_report.main(["--input", str(doc_path),
+                             "--trace", str(tmp_path / "merged.json"),
+                             "--json"])
+    assert "STRAGGLERS" in out["report"] and "rank 1" in out["report"]
+    merged = json.loads((tmp_path / "merged.json").read_text())
+    by_pid = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") == "X":
+            by_pid.setdefault(e["pid"], set()).add(e["name"])
+    rank_pids = [p["pid"] for p in tel["parts"]
+                 if str(p.get("role", "")).startswith("rank")]
+    srv_pid = next(p["pid"] for p in tel["parts"]
+                   if p.get("role") == "ps_server")
+    for pid in rank_pids:
+        assert "forward" in by_pid.get(pid, set()), by_pid.get(pid)
+    assert "kvstore.server.rpc" in by_pid.get(srv_pid, set())
+
+    # uninjected twin: ZERO false positives
+    stats2, _tel2, _ = _run_fleet(tmp_path, "clean", {})
+    assert stats2["fleet"]["stragglers"] == []
+    assert [x for x in stats2["fleet"]["verdicts"]
+            if x["kind"] == "straggler"] == []

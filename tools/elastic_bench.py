@@ -168,6 +168,206 @@ def run_elastic_bench(workers: int = 3, ops: int = 200, segments: int = 5,
         srv_el.stop()
 
 
+def run_straggler_bench(workers: int = 3, window: int = 4, factor: float = 1.5,
+                        k: int = 2, hb_interval: float = 0.05,
+                        steps: int = 40, base_s: float = 0.01,
+                        slow_s: float = 0.05) -> dict:
+    """Straggler leg (docs/OBSERVABILITY.md "Training-fleet telemetry"):
+    ``workers`` in-process elastic sessions run a lockstep
+    compute+allreduce step loop with per-rank fleet accounting riding the
+    heartbeats; the last rank's compute is slowed after two clean windows.
+    Reports **detection latency in windows** (verdict window minus first
+    slowed window) and the fleet's step-time skew — the evidence base
+    ROADMAP item 4's bounded-staleness design needs."""
+    from mxnet_tpu import obs
+    from mxnet_tpu.kvstore.elastic import ElasticWorkerSession
+    from mxnet_tpu.kvstore.ps_server import PSServer
+    from mxnet_tpu.obs import fleetstats
+
+    import numpy as np
+
+    was_enabled = obs.enabled()
+    obs.enable()
+    srv = PSServer(host="127.0.0.1", port=0, hb_interval=hb_interval,
+                   miss_k=3)
+    srv.fleet.detector = fleetstats.StragglerDetector(factor=factor, k=k)
+    verdicts = []
+    srv.fleet.on_straggler(verdicts.append)
+    srv.start()
+    slow_rank = workers - 1
+    slow_from = window * 2 + 1  # two clean windows, then the lag begins
+    accs = [fleetstats.StepAccounting(rank=r, window=window,
+                                      own_spans=False,
+                                      ship_interval_s=hb_interval / 2)
+            for r in range(workers)]
+    sessions = []
+    try:
+        sessions = [ElasticWorkerSession(
+            "127.0.0.1", srv.port, rank=r, hb_interval=hb_interval,
+            part_provider=accs[r].wire_part) for r in range(workers)]
+        for s in sessions:
+            s.ensure_joined(wait_for_expected=False)
+        arr = np.ones(256, np.float32)
+
+        def _loop(r):
+            acc, s = accs[r], sessions[r]
+            for step in range(1, steps + 1):
+                with acc.phase("forward"):
+                    time.sleep(slow_s if (r == slow_rank
+                                          and step >= slow_from)
+                               else base_s)
+                with acc.phase("elastic.sync_grads"):
+                    s.allreduce("bench_straggle", arr, timeout=60)
+                acc.step_complete(step)
+            acc.flush()
+
+        threads = [threading.Thread(target=_loop, args=(r,), daemon=True)
+                   for r in range(workers)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        time.sleep(max(0.3, hb_interval * 6))  # final ships + judging
+        stats = srv.fleet.stats()
+        ranks = stats["ranks"]
+        med = sorted(v["step_time_avg"] for v in ranks.values())[
+            len(ranks) // 2] if ranks else 0.0
+        skew = {r: round(v["step_time_avg"] / max(med, 1e-9), 3)
+                for r, v in ranks.items()}
+        straggler_events = [v for v in verdicts if v["kind"] == "straggler"]
+        first = straggler_events[0] if straggler_events else None
+        first_slow_window = (slow_from - 1) // window
+        detection_windows = (first["window"] - first_slow_window + 1
+                             if first else None)
+        return {
+            "workers": workers,
+            "window_steps": window,
+            "factor": factor,
+            "k": k,
+            "flagged_rank": first["rank"] if first else None,
+            "blame": first["blame"] if first else None,
+            "detection_windows": detection_windows,
+            "step_time_skew": skew,
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "ok": (first is not None and first["rank"] == slow_rank
+                   and first["blame"] == "compute"
+                   and detection_windows <= k + 2),
+        }
+    finally:
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001
+                pass
+        srv.stop()
+        if not was_enabled:
+            obs.disable()
+
+
+def run_train_obs_overhead(steps: int = 250, warmup: int = 30,
+                           repeats: int = 7, batch: int = 64,
+                           threshold_pct: float = 5.0) -> dict:
+    """Train-telemetry overhead leg (the PR-13 interleaved off/on
+    methodology): the fit-shaped step loop — every phase wrapped in
+    ``fleetstats.phase`` exactly like ``BaseModule.fit`` — with span
+    tracing ON in both configurations (its cost is PR 7's
+    separately-budgeted ``obs_overhead`` leg, the health-bench
+    discipline) and the FLEET plane off (``MXNET_OBS_FLEET=0`` veto) vs
+    on: the delta is this PR's marginal cost (phase accumulation, window
+    sealing, ``train.step.*`` histograms), interleaved, best of each
+    side, gated under 5% by bench.py."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import metric as metric_mod
+    from mxnet_tpu import obs
+    from mxnet_tpu.io import NDArrayIter
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.obs import fleetstats
+
+    np.random.seed(17)
+    mx.random.seed(17)
+    rng = np.random.RandomState(17)
+    X = rng.randn(batch * 4, 128).astype(np.float32)
+    y = rng.randint(0, 8, batch * 4).astype(np.float32)
+    it = NDArrayIter(X, y, batch_size=batch, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=256, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = metric_mod.create("ce")
+    batch0 = next(iter(it))
+
+    def _run(n, step0=0):
+        import jax
+
+        t0 = time.perf_counter()
+        for i in range(n):
+            with fleetstats.phase("data_wait"):
+                pass  # synthetic iterator: instant
+            with fleetstats.phase("forward"):
+                mod.forward(batch0, is_train=True)
+            with fleetstats.phase("backward"):
+                mod.backward()
+            with fleetstats.phase("update"):
+                mod.update()
+            with fleetstats.phase("metric"):
+                mod.update_metric(metric, batch0.label)
+            fleetstats.step_complete(step0 + i + 1)
+        jax.block_until_ready(
+            [w._data for w in mod._exec.arg_dict.values()])
+        return time.perf_counter() - t0
+
+    was_enabled = obs.enabled()
+    stream = obs.trace.tracer.stream_path
+    prev_veto = os.environ.get("MXNET_OBS_FLEET")
+
+    def _veto(v):
+        if v:
+            os.environ["MXNET_OBS_FLEET"] = "0"
+        elif "MXNET_OBS_FLEET" in os.environ:
+            del os.environ["MXNET_OBS_FLEET"]
+
+    try:
+        obs.enable()  # spans on BOTH sides — the delta is the fleet plane
+        _veto(True)
+        _run(warmup)
+        _veto(False)
+        _run(warmup)
+        dt_off = dt_on = float("inf")
+        for _ in range(max(1, repeats)):
+            _veto(True)
+            dt_off = min(dt_off, _run(steps))
+            _veto(False)
+            dt_on = min(dt_on, _run(steps))
+        ips_off = steps / dt_off
+        ips_on = steps / dt_on
+        overhead = (ips_off - ips_on) / ips_off * 100.0
+        return {
+            "steps": steps,
+            "ips_off": round(ips_off, 1),
+            "ips_on": round(ips_on, 1),
+            "train_obs_overhead_pct": round(overhead, 2),
+            "threshold_pct": threshold_pct,
+            "ok": overhead < threshold_pct,
+        }
+    finally:
+        if prev_veto is None:
+            os.environ.pop("MXNET_OBS_FLEET", None)
+        else:
+            os.environ["MXNET_OBS_FLEET"] = prev_veto
+        obs.disable()
+        if was_enabled:
+            obs.enable(jsonl=stream)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--workers", type=int, default=3)
@@ -175,10 +375,26 @@ def main(argv=None) -> int:
     ap.add_argument("--segments", type=int, default=5)
     ap.add_argument("--heartbeat", type=float, default=0.2)
     ap.add_argument("--miss-k", type=int, default=3)
+    ap.add_argument("--straggler", action="store_true",
+                    help="run ONLY the straggler-detection leg (one "
+                         "slowed worker; detection latency in windows + "
+                         "step-time skew)")
+    ap.add_argument("--train-obs", action="store_true",
+                    help="run ONLY the train-telemetry overhead leg "
+                         "(fit-shaped loop, interleaved off/on, <5%% "
+                         "gated)")
     args = ap.parse_args(argv)
-    res = run_elastic_bench(workers=args.workers, ops=args.ops,
-                            segments=args.segments,
-                            hb_interval=args.heartbeat, miss_k=args.miss_k)
+    if args.straggler:
+        res = run_straggler_bench(workers=args.workers)
+    elif args.train_obs:
+        res = run_train_obs_overhead()
+    else:
+        res = run_elastic_bench(workers=args.workers, ops=args.ops,
+                                segments=args.segments,
+                                hb_interval=args.heartbeat,
+                                miss_k=args.miss_k)
+        res["straggler"] = run_straggler_bench(workers=args.workers)
+        res["ok"] = res["ok"] and res["straggler"]["ok"]
     print(json.dumps(res, indent=2))
     return 0 if res["ok"] else 2
 

@@ -95,7 +95,14 @@ def jsonl_to_part(path: str) -> dict:
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--connect", required=True, metavar="HOST:PORT",
-                    help="a ServeServer/FleetServer endpoint")
+                    help="a ServeServer/FleetServer endpoint (or, with "
+                         "--ps, a PSServer)")
+    ap.add_argument("--ps", action="store_true",
+                    help="the endpoint is a TRAINING-plane PSServer: pull "
+                         "its OP_TELEMETRY (server part + cached per-rank "
+                         "worker parts) — rank lanes merge into the same "
+                         "one timeline; tools/train_report.py renders the "
+                         "per-rank phase/straggler analysis")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="write the merged chrome trace here")
     ap.add_argument("--prom", default=None, metavar="OUT.prom",
@@ -126,15 +133,23 @@ def main(argv=None):
     from mxnet_tpu.serve import ServeClient
 
     host, _, port = args.connect.partition(":")
-    cli = ServeClient(host, int(port))
-    try:
-        tel = cli.telemetry(drain=not args.no_drain)
-        # stats ride the front part when the server attached them (the
-        # router's breaker open-time lives there)
+    if args.ps:
+        from mxnet_tpu.obs import fleetstats
+
+        tel = fleetstats.collect(host, int(port),
+                                 drain=not args.no_drain)
         stats = next((p.get("stats") for p in tel["parts"]
                       if p.get("stats")), None)
-    finally:
-        cli.close()
+    else:
+        cli = ServeClient(host, int(port))
+        try:
+            tel = cli.telemetry(drain=not args.no_drain)
+            # stats ride the front part when the server attached them
+            # (the router's breaker open-time lives there)
+            stats = next((p.get("stats") for p in tel["parts"]
+                          if p.get("stats")), None)
+        finally:
+            cli.close()
     # a live replica answers OP_TELEMETRY *and* has a JSONL file — a glob
     # like obs/replica-*.jsonl matches both, so drop evidence whose pid
     # already reported over the wire (its spans would merge twice); only
@@ -188,7 +203,7 @@ def main(argv=None):
                 print(f"prometheus exposition -> {args.prom}")
         out["prometheus_lines"] = text.count("\n")
 
-    if not args.no_slo:
+    if not args.no_slo and not args.ps:  # SLO math is serve-plane
         mon = SLOMonitor(deadline_target=args.target,
                          p99_target_ms=args.p99_ms)
         # a FleetServer's "batcher" IS the Router — its stats carry the
