@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart train-obs
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart train-obs copytrack
 
 # repo self-lint: framework invariants + the concurrency-correctness pass
 # (lock-order cycles, blocking-under-lock, CV/thread discipline, wire
@@ -22,6 +22,15 @@ tsan:
 	MXNET_TSAN=1 MXNET_TSAN_STALL_S=30 $(PYTHON) -m pytest tests/test_tsan.py tests/test_fleet.py -q -p no:cacheprovider
 	MXNET_TSAN=1 MXNET_TSAN_STALL_S=30 $(PYTHON) -m pytest tests/test_elastic.py -q -p no:cacheprovider
 	$(PYTHON) tools/tsan_bench.py
+
+# data-plane sanitizer (docs/ANALYSIS.md "Data-plane lint"): the dataplane
+# lint test subset with the MXNET_COPYTRACK runtime twin exercised e2e,
+# then a COPYTRACK-instrumented serve smoke that prints the wire-hop cost
+# table (p50 hop cost, bytes copied / serialize calls / host syncs per
+# request) — the measured denominator for the zero-copy rewrite
+copytrack:
+	$(PYTHON) -m pytest tests/ -q -m dataplane -p no:cacheprovider
+	$(PYTHON) tools/serve_bench.py --wire-hop --duration 4
 
 # the static-analysis test subset (graph/trace/sharding/repo lint)
 lint-tests:

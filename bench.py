@@ -36,6 +36,10 @@ NOMINAL_V5E_BF16_TFLOPS = 197.0
 NOMINAL_V5E_HBM_GBPS = 819.0
 
 
+class _SkipLeg(Exception):
+    """Raised inside a leg's try block when --extras deselects it."""
+
+
 class _device_cost_capture:
     """Force obs.device program-cost capture (MXNET_DEVICE_COST=1) for a
     leg without enabling span telemetry — the XLA cost analysis rides the
@@ -610,6 +614,25 @@ def bench_prof_overhead(platform):
     return res
 
 
+def bench_wire_hop(platform):
+    """Per-request wire-hop cost on the serve path (docs/ANALYSIS.md
+    "Data-plane lint"): a closed-loop serve run with the MXNET_COPYTRACK
+    twin counting at the wire/batcher/device choke points — p50 client
+    latency minus mean per-request execute time (``hop_ms_p50``), plus
+    bytes-copied / serialize-calls / host-syncs per request. Records
+    today's hop cost as the committed denominator ROADMAP item 4's
+    zero-copy rewrite must beat by >=2x."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    model = os.environ.get("BENCH_SERVE_MODEL",
+                           "resnet18_v1" if platform == "tpu" else "mlp")
+    duration = float(os.environ.get("BENCH_WIRE_HOP_DURATION",
+                                    6 if platform == "tpu" else 3))
+    return serve_bench.run_wire_hop(model=model, duration=duration)
+
+
 def bench_health_overhead(platform):
     """Cost of the training-health plane (docs/OBSERVABILITY.md "Training
     health"): the same train-step loop with the divergence sentinel off vs
@@ -758,6 +781,18 @@ def bench_lm_long(platform):
 def main():
     from mxnet_tpu import platform as mxplatform
 
+    # --extras LEG[,LEG...]: run only the named legs (e.g. `bench.py
+    # --extras wire_hop` grabs a fresh hop-cost baseline without paying
+    # for the full training trajectory). Everything else self-reports as
+    # skipped so the one-line artifact keeps its shape.
+    only = None
+    argv = sys.argv[1:]
+    if "--extras" in argv:
+        i = argv.index("--extras")
+        names = argv[i + 1] if i + 1 < len(argv) else ""
+        only = {n.strip() for n in names.replace(",", " ").split()
+                if n.strip()}
+
     # The axon tunnel can go fully unresponsive for hours (observed
     # 2026-07-30: >3 h; jax.devices() then blocks forever). The platform
     # watchdog (mxnet_tpu/platform.py) turns that hang — or a real init
@@ -798,21 +833,30 @@ def main():
             return True
         return False
 
+    def skip_leg(section):
+        if only is not None and section not in only:
+            extra[f"{section}_skipped"] = "not selected by --extras"
+            return True
+        return over_budget(section)
+
     load0 = _loadavg()
-    ips, fp32_spread = bench_resnet(platform)
     extra = {"device_kind": device_kind,
              "n_runs": _n_runs(platform),
-             "fp32_spread": round(fp32_spread, 3),
              "loadavg_start": load0}
-    try:
-        bf16_ips, bf16_spread = bench_resnet(platform,
-                                             compute_dtype="bfloat16")
-        extra["resnet50_bf16_ips"] = round(bf16_ips, 2)
-        extra["resnet50_bf16_spread"] = round(bf16_spread, 3)
-    except Exception as e:  # never lose the primary metric
-        extra["resnet50_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
+    ips = None
+    if not skip_leg("resnet50_fp32"):
+        ips, fp32_spread = bench_resnet(platform)
+        extra["fp32_spread"] = round(fp32_spread, 3)
+    if not skip_leg("resnet50_bf16"):
+        try:
+            bf16_ips, bf16_spread = bench_resnet(platform,
+                                                 compute_dtype="bfloat16")
+            extra["resnet50_bf16_ips"] = round(bf16_ips, 2)
+            extra["resnet50_bf16_spread"] = round(bf16_spread, 3)
+        except Exception as e:  # never lose the primary metric
+            extra["resnet50_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_FP32_HIGH", "1") != "0" \
-            and not over_budget("resnet50_fp32_high"):
+            and not skip_leg("resnet50_fp32_high"):
         # fp32 storage with 3-pass bf16 matmul emulation (~1e-6 rel err) —
         # the TF32-class mode modern GPU "fp32" baselines actually run;
         # the primary metric above stays true-fp32 (HIGHEST, 6-pass)
@@ -829,13 +873,14 @@ def main():
             _j.config.update("jax_default_matmul_precision",
                              os.environ.get("MXNET_MATMUL_PRECISION",
                                             "highest"))
-    try:
-        piped = bench_resnet_piped(platform)
-        extra["resnet50_piped_ips"] = piped.pop("ips")
-        extra["resnet50_piped_breakdown"] = piped
-    except Exception as e:
-        extra["resnet50_piped_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("resnet50_piped_bf16"):
+    if not skip_leg("resnet50_piped"):
+        try:
+            piped = bench_resnet_piped(platform)
+            extra["resnet50_piped_ips"] = piped.pop("ips")
+            extra["resnet50_piped_breakdown"] = piped
+        except Exception as e:
+            extra["resnet50_piped_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not skip_leg("resnet50_piped_bf16"):
         try:
             # full breakdown, not just the scalar (VERDICT r4 weak #1: the
             # r4 bf16 number was physically odd and shipped with no defense)
@@ -848,14 +893,19 @@ def main():
     # its own guard so a bert-leg failure can't strip the LM analytic-MFU
     # columns of a successfully measured peak
     peak_eff = None
-    try:
-        peak = _measure_matmul_peak()
-    except Exception as e:
-        peak = float("nan")
-        extra["matmul_probe_error"] = f"{type(e).__name__}: {e}"[:200]
+    peak = float("nan")
+    want_mfu = only is None or bool(
+        {"bert_base_bf16", "lm_seq2048", "lm_seq4096"} & only)
+    if want_mfu:
+        try:
+            peak = _measure_matmul_peak()
+        except Exception as e:
+            extra["matmul_probe_error"] = f"{type(e).__name__}: {e}"[:200]
     if np.isfinite(peak):
         peak_eff = min(peak, NOMINAL_V5E_BF16_TFLOPS)
     try:
+        if skip_leg("bert_base_bf16"):
+            raise _SkipLeg
         bert = bench_bert(platform)
         # chip throughput drifts run-to-run (~±20% observed); a sustained
         # model rate is itself a lower bound on peak, so the MFU denominator
@@ -884,24 +934,34 @@ def main():
         obs_device.set_peak(tflops=peak_eff, gbps=NOMINAL_V5E_HBM_GBPS)
         _annotate_analytic(bert, peak_eff)
         extra["bert_base_bf16"] = bert
+    except _SkipLeg:
+        pass
     except Exception as e:
         extra["bert_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
+        if skip_leg("lm_seq2048"):
+            raise _SkipLeg
         lm = bench_lm_long(platform)
         for _impl in ("flash", "plain"):
             if isinstance(lm.get(_impl), dict) and peak_eff:
                 _annotate_analytic(lm[_impl], peak_eff)
         extra["lm_seq2048_bf16"] = lm
+    except _SkipLeg:
+        pass
     except Exception as e:
         extra["lm_seq2048_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
+        if skip_leg("update_engine"):
+            raise _SkipLeg
         # dispatch-overhead guarantee (docs/PERFORMANCE.md): compiled device
         # programs per Trainer.step update phase, fused engine vs eager loop
         extra["update_engine_dispatches_per_step"] = \
             bench_update_engine_dispatches()
+    except _SkipLeg:
+        pass
     except Exception as e:
         extra["update_engine_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("serve"):
+    if not skip_leg("serve"):
         try:
             # the inference half (docs/SERVING.md): closed-loop qps + tail
             # latency through engine→batcher→socket, so BENCH_*.json
@@ -909,7 +969,7 @@ def main():
             extra["serve"] = bench_serve(platform)
         except Exception as e:
             extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("cold_start"):
+    if not skip_leg("cold_start"):
         try:
             # persistent AOT program cache (docs/PERFORMANCE.md "Program
             # cache and cold start"): replica spawn-to-ready, cold vs
@@ -919,7 +979,7 @@ def main():
             extra["cold_start"] = bench_cold_start(platform)
         except Exception as e:
             extra["cold_start_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("serve_scale"):
+    if not skip_leg("serve_scale"):
         try:
             # serve throughput vs data-parallel replica groups on mesh
             # slices + measured autoscale-out under a load ramp
@@ -928,12 +988,12 @@ def main():
             extra["serve_scale"] = bench_serve_scale(platform)
         except Exception as e:
             extra["serve_scale_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("serve_ramp"):
+    if not skip_leg("serve_ramp"):
         try:
             extra["serve_ramp"] = bench_serve_ramp(platform)
         except Exception as e:
             extra["serve_ramp_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("obs_overhead"):
+    if not skip_leg("obs_overhead"):
         try:
             # tracing must be cheap enough to stay ON under load — measure
             # it, don't assume it (docs/OBSERVABILITY.md): same serve path,
@@ -941,7 +1001,7 @@ def main():
             extra["obs_overhead"] = bench_obs_overhead(platform)
         except Exception as e:
             extra["obs_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("prof_overhead"):
+    if not skip_leg("prof_overhead"):
         try:
             # the black-box plane (tail retention + continuous profiler)
             # must be cheap enough to stay always-on: same serve path,
@@ -949,7 +1009,7 @@ def main():
             extra["prof_overhead"] = bench_prof_overhead(platform)
         except Exception as e:
             extra["prof_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("health_overhead"):
+    if not skip_leg("health_overhead"):
         try:
             # the divergence sentinel must be cheap enough to leave ON for
             # every production fit (docs/OBSERVABILITY.md "Training
@@ -958,7 +1018,17 @@ def main():
             extra["health_overhead"] = bench_health_overhead(platform)
         except Exception as e:
             extra["health_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("elastic"):
+    if not skip_leg("wire_hop"):
+        try:
+            # per-request wire-hop cost with the MXNET_COPYTRACK twin on
+            # (docs/ANALYSIS.md "Data-plane lint"): p50 latency minus
+            # execute + bytes-copied/serialize-calls/host-syncs per
+            # request — the denominator the zero-copy rewrite (ROADMAP
+            # item 4) must beat by >=2x
+            extra["wire_hop"] = bench_wire_hop(platform)
+        except Exception as e:
+            extra["wire_hop_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not skip_leg("elastic"):
         try:
             # elastic training must be free when nothing fails: membership
             # overhead <5% gated, plus measured death-recovery and
@@ -970,7 +1040,7 @@ def main():
                 extra["elastic"]["elastic_recovery_s"]
         except Exception as e:
             extra["elastic_error"] = f"{type(e).__name__}: {e}"[:200]
-    if not over_budget("train_obs"):
+    if not skip_leg("train_obs"):
         try:
             # the training-fleet step accounting must be cheap enough to
             # leave on for every production fit: spans on both sides,
@@ -980,7 +1050,7 @@ def main():
         except Exception as e:
             extra["train_obs_error"] = f"{type(e).__name__}: {e}"[:200]
     if platform == "tpu" and os.environ.get("BENCH_LM_LONG4K", "1") != "0" \
-            and not over_budget("lm_seq4096"):
+            and not skip_leg("lm_seq4096"):
         # the long-context scaling point: seq 4096, flash only (plain's
         # S×S scores are ~3.2 GB f32 — the config flash exists for).
         # The axon remote-compile helper has crashed (HTTP 500) on the
@@ -1031,7 +1101,9 @@ def main():
         "obs_overhead": "obs_overhead",
         "prof_overhead": "prof_overhead",
         "health_overhead": "health_overhead",
+        "wire_hop": "wire_hop",
         "elastic": "elastic",
+        "train_obs": "train_obs",
     }
     leg_error_key = {"bert_base_bf16": "bert_error"}  # irregular names
     extra["legs_run"] = [l for l, k in leg_result_key.items() if k in extra]
@@ -1054,9 +1126,10 @@ def main():
                   f"{_steps_cfg(platform)[0]}, "
                   f"{_steps_cfg(platform)[1]}x{_steps_cfg(platform)[1]}, "
                   f"1 {platform} chip)",
-        "value": round(ips, 2),
+        "value": round(ips, 2) if ips is not None else None,
         "unit": "images/sec",
-        "vs_baseline": round(ips / BASELINE_IMG_PER_SEC_PER_GPU, 4),
+        "vs_baseline": (round(ips / BASELINE_IMG_PER_SEC_PER_GPU, 4)
+                        if ips is not None else None),
         "extra": extra,
     }))
 

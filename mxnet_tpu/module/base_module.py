@@ -542,7 +542,9 @@ class BaseModule:
                if n in exec_.aux_dict]
         if not targets:
             return
-        vals = kv.broadcast_arrays([t.asnumpy() for t in targets], root)
+        # intentional sync: elastic broadcast rides the host-side PS wire
+        # (boundary event at join/rejoin, not the per-step path)
+        vals = kv.broadcast_arrays([t.asnumpy() for t in targets], root)  # lint: disable=host-sync-on-hot-path
         if not root:
             for t, v in zip(targets, vals):
                 t._set_data(nd_array(np.asarray(v, t.dtype))._data)
@@ -619,7 +621,9 @@ class BaseModule:
         from ..ndarray import array as nd_array
 
         grads = [exec_.grad_dict[n] for n in names]
-        means, _n = kv.allreduce_mean([g.asnumpy() for g in grads])
+        # intentional sync: the elastic reduce is host-mediated by design
+        # (grads cross the PS wire as numpy; device reduce is kvstore ici)
+        means, _n = kv.allreduce_mean([g.asnumpy() for g in grads])  # lint: disable=host-sync-on-hot-path
         for g, m in zip(grads, means):
             g._set_data(nd_array(np.asarray(m, g.dtype))._data)
 

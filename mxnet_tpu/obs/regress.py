@@ -96,6 +96,14 @@ GAIN_SPECS = (
     # the fleet-elasticity number: what autoscale scale-out actually waits
     ("cold_start_to_ready_s", "extra.cold_start.cold_start_to_ready_s",
      None, False),
+    # per-request wire-hop cost with the MXNET_COPYTRACK twin counting
+    # (docs/ANALYSIS.md "Data-plane lint"): p50 client latency minus
+    # execute, and bytes crossing a copy per request — the committed
+    # denominators ROADMAP item 4's zero-copy rewrite must cut >=2x, so
+    # the rewrite lands as a classified improvement, not an anecdote
+    ("wire_hop_ms_p50", "extra.wire_hop.hop_ms_p50", None, False),
+    ("wire_bytes_copied_per_req",
+     "extra.wire_hop.bytes_copied_per_request", None, False),
 )
 
 

@@ -91,10 +91,83 @@ _ENV_REGISTRY = {
                              "dispatch policy (ops/attention.py)."),
     "MXNET_TEST_DEFAULT_CTX": (None, "Context for test_utils.default_context,"
                                " e.g. 'cpu' or 'tpu(0)'."),
-    "MXNET_TEST_SEED": (None, "Per-test seed used by the test fixtures "
-                        "(reference with_seed())."),
+    # read by tests/conftest.py, outside the linted package tree
+    "MXNET_TEST_SEED": (None, "Per-test seed used by the test "  # lint: disable=env-registry-drift
+                        "fixtures (reference with_seed())."),
     "MXNET_NO_NATIVE_BUILD": (None, "1 = never build/load the native C++ "
                               "components (PIL/python fallbacks)."),
+    # platform / compile (mxnet_tpu/__init__.py, platform.py, executor.py)
+    "MXNET_FORCE_PLATFORM": (None, "cpu|tpu — pin the jax backend at "
+                             "import time (images that preload jax set "
+                             "JAX_PLATFORMS too early for subprocesses)."),
+    "MXNET_COMPILE_CACHE": ("1", "0 = disable the persistent XLA "
+                            "compilation cache (keyed by HLO hash, so "
+                            "code changes never serve stale binaries)."),
+    "MXNET_COMPILE_CACHE_DIR": (None, "XLA compile-cache directory "
+                                "(default ~/.cache/mxnet_tpu_jax)."),
+    "MXNET_PLATFORM_TIMEOUT": ("90", "Accelerator-driver watchdog budget "
+                               "(seconds); every driver call must return "
+                               "or the tunnel is declared hung."),
+    "MXNET_GRAPH_LINT": ("off", "off|warn|error — graph-lint severity "
+                         "when an executor binds a symbolic graph."),
+    "MXNET_NP_SILENT_FALLBACK": (None, "1 = silence the once-per-name "
+                                 "warning when mxnet_tpu.numpy delegates "
+                                 "an op to real numpy (host round-trip)."),
+    "MXNET_FLASH_BLOCK_Q": (None, "Flash-attention Q block-length "
+                            "override (default: tuned per backend)."),
+    "MXNET_FLASH_BLOCK_K": (None, "Flash-attention K block-length "
+                            "override."),
+    "MXNET_FLASH_BWD": ("auto", "auto|flash|plain — flash-attention "
+                        "backward-pass implementation."),
+    "MXNET_FUSED_UPDATE": ("1", "0 = bypass the fused optimizer-update "
+                           "engine and run the eager per-array oracle "
+                           "(optimizer/fused.py)."),
+    "MXNET_FUSED_DONATE": (None, "Override buffer donation in the fused "
+                           "update engine (default: donate wherever "
+                           "aliasing is safe)."),
+    # telemetry core (obs/__init__.py, obs/trace.py, obs/context.py,
+    # serve/fleet.py)
+    "MXNET_OBS": (None, "1 = enable the telemetry plane at import "
+                  "(metrics registry, tracer, exporters)."),
+    "MXNET_OBS_JSONL": (None, "Telemetry JSONL stream path (implies "
+                        "MXNET_OBS=1); %p expands to the pid at the "
+                        "child's obs import."),
+    "MXNET_OBS_DIR": (None, "Fleet supervisor: directory for per-replica "
+                      "telemetry streams and blackbox bundles."),
+    "MXNET_OBS_BUFFER": ("65536", "Tracer ring capacity (retained "
+                         "spans)."),
+    "MXNET_OBS_SAMPLE": (None, "Head-based sampling probability for new "
+                         "trace roots, 0..1 (default 1.0; children "
+                         "inherit the root's verdict)."),
+    "MXNET_OBS_WIRE": ("1", "0 = never put trace context on the wire "
+                       "(escape hatch for old peers)."),
+    # sanitizers (tsan.py, copytrack.py — docs/ANALYSIS.md)
+    "MXNET_TSAN": (None, "1 = enable the lock-order/stall sanitizer: "
+                   "instrumented locks record acquisition order and a "
+                   "watchdog flags cycles and stalls (tsan.py)."),
+    "MXNET_TSAN_RAISE": (None, "1 = raise on a lock-order violation "
+                         "instead of warning once per pair."),
+    "MXNET_TSAN_STALL_S": ("20", "Stall-watchdog threshold (seconds a "
+                           "lock may be held/waited before a report)."),
+    "MXNET_COPYTRACK": (None, "1 = data-plane copy tracker: wire/batcher/"
+                        "device choke points count wire.bytes_copied, "
+                        "wire.serialize_calls and hotpath.host_syncs "
+                        "(the dataplane lint's runtime twin — "
+                        "analysis/dataplane.py; zero overhead when "
+                        "off)."),
+    # fault injection (chaos/ — docs/ROBUSTNESS.md)
+    "MXNET_CHAOS_KILL": (None, "Chaos: SIGKILL this process at counted "
+                         "guard-point hits, e.g. 'ckpt:pre_rename@3' "
+                         "(chaos/proc.py; the fleet supervisor forwards "
+                         "MXNET_CHAOS_KILL_REPLICA<i> to replica i)."),
+    "MXNET_CHAOS_RPC": (None, "Chaos: drop/delay/duplicate PS RPCs at "
+                        "exact occurrence counts, e.g. "
+                        "'push_seq:drop_reply@1;pull:delay@2:0.5' "
+                        "(chaos/rpc.py)."),
+    "MXNET_CHAOS_TUNNEL_HANG": (None, "Chaos: hang named platform guard "
+                                "points the way a dead accelerator "
+                                "tunnel does ('*' = all; "
+                                "chaos/platform.py)."),
     # device-plane observability (obs/device.py, docs/OBSERVABILITY.md)
     "MXNET_DEVICE_COST": (None, "1 = force XLA cost/memory capture at every "
                           "compile choke point (0 = veto); default follows "
@@ -245,6 +318,12 @@ _ENV_REGISTRY = {
     "MXNET_PS_ADDR": (None, "dist_async parameter-server host (falls back "
                       "to DMLC_PS_ROOT_URI)."),
     "MXNET_PS_PORT": ("9091", "dist_async parameter-server port."),
+    "MXNET_PS_PLATFORM": ("cpu", "jax platform for the standalone PS "
+                          "server process (weights are host-resident; "
+                          "cpu is the right default)."),
+    "MXNET_SERVE_PLATFORM": (None, "jax platform pin for a serve replica "
+                             "process (the PS server's MXNET_PS_PLATFORM "
+                             "idiom; unset = jax's own default)."),
     # elastic training (docs/ROBUSTNESS.md "Elastic training")
     "MXNET_ELASTIC": (None, "1 = elastic dist_sync: reductions ride the PS "
                       "wire scoped to the live membership generation; a "

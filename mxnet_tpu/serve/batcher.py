@@ -34,7 +34,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from .. import obs, tsan
+from .. import copytrack, obs, tsan
 from ..obs import context as obs_context
 from .engine import DeadlineExceeded, Draining, RequestRejected, ServeError
 
@@ -184,7 +184,10 @@ class DynamicBatcher:
                 raise RequestRejected(
                     f"queue over watermark ({self.max_queue} requests); "
                     "back off and retry")
-            if deadline is not None and deadline <= now:
+            # fresh clock read: ``deadline`` was built from ``now``, so
+            # comparing against ``now`` itself can never fire — a
+            # sub-resolution budget must still be dead on arrival
+            if deadline is not None and deadline <= time.monotonic():
                 self.shed += 1
                 self.shed_by_reason["deadline"] += 1
                 obs.inc("serve.shed_deadline")
@@ -335,6 +338,8 @@ class DynamicBatcher:
             else:
                 inputs = [np.concatenate([r.data[i] for r in batch], axis=0)
                           for i in range(len(batch[0].data))]
+                # per-batch assembly copy, counted for the wire_hop bench
+                copytrack.TRACKER.copied(sum(a.nbytes for a in inputs))
             with obs_context.use(lead_ctx):
                 outs, version = self.engine.infer(inputs, n_valid=rows)
             lo = 0

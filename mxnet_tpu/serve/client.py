@@ -154,6 +154,10 @@ class ServeClient:
                         except OSError:
                             pass
                         self._sock = None
+                    if attempt + 1 >= retries:
+                        break  # no retry left: surface the error NOW —
+                        # sleeping a backoff nobody follows only delays
+                        # the caller's failover past its hedge window
                     delay = self._backoff(attempt)
                     if obs.enabled():
                         obs.inc("serve.client.retries")

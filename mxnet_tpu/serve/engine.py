@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .. import obs
+from .. import copytrack, obs
 from ..base import MXNetError
 
 __all__ = ["InferenceEngine", "ServeError", "RequestRejected",
@@ -650,12 +650,17 @@ class InferenceEngine:
                 # MFU over device work only (block, no D2H yet) so the
                 # serve phase is comparable with forward/backward/update;
                 # the span itself still covers the host materialization
-                jax.block_until_ready(outs)
+                # (intentional sync: sampled timing boundary, not a stall)
+                copytrack.TRACKER.host_sync("serve.engine.block_until_ready")
+                jax.block_until_ready(outs)  # lint: disable=host-sync-on-hot-path
                 obs.device.annotate_span(sp, "serve.execute",
                                          time.monotonic() - t0, cost)
             # materialize on host: the wire sends numpy, and an unwaited
             # future would let the execute span under-report real latency
-            host = jax.device_get(list(outs))
+            # (intentional sync: THE accounted d2h hop — copytrack counts
+            # it so the wire_hop bench can subtract execute time)
+            copytrack.TRACKER.host_sync("serve.engine.device_get")
+            host = jax.device_get(list(outs))  # lint: disable=host-sync-on-hot-path
         if profiler.counting_dispatches():
             profiler.count_dispatch("d2h", len(host))
         if rec:

@@ -197,10 +197,7 @@ class ServeServer:
 
     def stop(self):
         self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._close_listener()
         # snapshot: handler threads concurrently .remove() from _conns, and
         # iterating the live list would skip (and leave open) neighbors of
         # a removed entry — a stopped server must look dead to EVERY client
@@ -231,15 +228,30 @@ class ServeServer:
         exactly what the fleet tests need from an in-process replica
         (serve/fleet.py LocalReplica.kill)."""
         self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._close_listener()
         for c in list(self._conns):
             try:
                 c.close()
             except OSError:
                 pass
+
+    def _close_listener(self):
+        # shutdown() before close(): close alone does NOT wake the accept
+        # loop blocked inside its 0.5s poll, and while that thread holds
+        # the fd the kernel keeps the listener ALIVE — new connects land
+        # in a zombie backlog and only see RST when the poll tick fires,
+        # so "this port is dead" took up to half a second to become true
+        # (the fleet router's dead-replica attempts randomly lost their
+        # 250ms hedge window to it). shutdown resets the backlog and
+        # raises the blocked accept immediately.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
     def drain(self, stop: bool = False, timeout: float = 30.0) -> bool:
         """Graceful shutdown, phase one: flip readiness off, let queued and
@@ -422,7 +434,7 @@ class ServeServer:
         except (ConnectionError, OSError):
             return
 
-    def _reply(self, conn, opcode: int, payload: bytes):
+    def _reply(self, conn, opcode: int, payload):
         kill_point("serve:pre_reply")  # chaos: server dies before the ack
         _send_msg(conn, opcode, "", payload)
 
@@ -585,7 +597,7 @@ class ServeServer:
                                      f"unknown opcode {opcode}"))
         return True
 
-    def _do_infer(self, payload) -> bytes:
+    def _do_infer(self, payload):
         if self._batcher is None:
             return _err_payload(STATUS_NOT_READY, "no model loaded")
         if self._draining:
@@ -626,8 +638,11 @@ class ServeServer:
             obs.tail.note("error")
             return _err_payload(STATUS_INTERNAL, str(e))
         with obs.trace.span("serve.serialize", outputs=len(outs)):
-            reply = (struct.pack("<BI", STATUS_OK, version)
-                     + _pack_arrays([np.ascontiguousarray(o) for o in outs]))
+            # status header and packed arrays travel as separate parts:
+            # _send_msg scatter-gathers them, so the reply is never
+            # re-copied into one contiguous buffer (data-plane lint)
+            reply = [struct.pack("<BI", STATUS_OK, version),
+                     _pack_arrays([np.ascontiguousarray(o) for o in outs])]
         # chaos: die with the answer computed but unsent — the INFER-specific
         # twin of serve:pre_reply (which also fires on probe replies, so a
         # fleet test could never target "kill mid-INFER-reply" with it)

@@ -74,6 +74,12 @@ def pytest_configure(config):
         "bitwise parity, replica restart warm-from-disk "
         "(docs/PERFORMANCE.md \"Program cache and cold start\"); run via "
         "`pytest -m progcache` or `make progcache`/`make coldstart`")
+    config.addinivalue_line(
+        "markers", "dataplane: data-plane lint tests — hot-path copy/"
+        "sync/allocation rules, resource lifetime, env-registry drift, "
+        "and the MXNET_COPYTRACK runtime twin (docs/ANALYSIS.md "
+        "\"Data-plane lint\"); run via `pytest -m dataplane` or "
+        "`make copytrack`")
 
 
 @pytest.fixture(autouse=True)

@@ -500,9 +500,14 @@ def test_kill_mid_infer_reply_fails_over_within_deadline(tmp_path):
             np.testing.assert_array_equal(outs[0], X)
         assert (time.monotonic() - t0) * 1e3 < deadline_ms
         assert router.failovers >= 1  # the mid-reply kill was absorbed
-        # the killed subprocess comes back
+        # the killed subprocess comes back. Wait for the RESTART, not just
+        # for 2 ready members: failover now resolves in milliseconds, so
+        # this check can run before the supervisor's first sweep even
+        # notices the corpse (state still "ready", restarts still 0).
         deadline = time.monotonic() + 90
-        while len(pool.ready_members()) < 2 and time.monotonic() < deadline:
+        while ((len(pool.ready_members()) < 2
+                or pool.members()[0].restarts < 1)
+               and time.monotonic() < deadline):
             time.sleep(0.2)
         assert len(pool.ready_members()) == 2
         assert pool.members()[0].restarts >= 1

@@ -7,7 +7,10 @@ Runs BOTH source-level linters and merges their reports:
   declared, no host calls on tensor inputs, no bare ``except:``);
 - ``mxnet_tpu.analysis.concurrency`` — lock-order cycles, blocking calls
   under locks, CV/thread discipline, wire-protocol registry checks
-  (docs/ANALYSIS.md "Concurrency lint").
+  (docs/ANALYSIS.md "Concurrency lint");
+- ``mxnet_tpu.analysis.dataplane`` — hot-path copy/sync/allocation
+  rules, resource lifetime, env-registry drift (docs/ANALYSIS.md
+  "Data-plane lint"; runtime twin ``MXNET_COPYTRACK=1``).
 
 Exit status 1 on any unwaived finding (waived concurrency findings are
 reported at info severity but never fail)::
@@ -21,7 +24,7 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from mxnet_tpu.analysis import concurrency, repo_lint  # noqa: E402
+from mxnet_tpu.analysis import concurrency, dataplane, repo_lint  # noqa: E402
 from mxnet_tpu.analysis.findings import Report  # noqa: E402
 
 
@@ -39,6 +42,7 @@ def main(argv=None) -> int:
     report = Report()
     report.extend(repo_lint.lint_paths(paths, exclude=args.exclude))
     report.extend(concurrency.lint_paths(paths, exclude=args.exclude))
+    report.extend(dataplane.lint_paths(paths, exclude=args.exclude))
     print(report.to_json() if args.json else report.format())
     bad = concurrency.unwaived(report)
     if len(bad) != len(report):

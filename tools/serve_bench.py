@@ -671,6 +671,70 @@ def run_obs_overhead(model="mlp", duration=4.0, sample=0.1, clients=4,
             "ok": bool(pct < threshold_pct)}
 
 
+def run_wire_hop(model="mlp", duration=4.0, clients=4, max_batch_size=8,
+                 request_rows=1):
+    """The measured wire-hop baseline for the zero-copy rewrite
+    (docs/ANALYSIS.md "Data-plane lint", ROADMAP item 4): a closed-loop
+    serve run with the MXNET_COPYTRACK twin counting at the wire/batcher/
+    device choke points. Reports the p50 client latency with the mean
+    per-request execute time subtracted (``hop_ms_p50`` — queueing +
+    framing + copies + syncs, the part a zero-copy rewrite can attack)
+    plus bytes-copied / serialize-calls / host-syncs per request. This is
+    the committed denominator a later rewrite must beat by >=2x."""
+    from mxnet_tpu import copytrack, obs
+
+    # same snapshot/restore discipline as run_obs_overhead: telemetry is
+    # needed for serve.execute_seconds, but the caller's stream survives
+    was_on = obs.enabled()
+    prev_rate = obs.context.sample_rate()
+    prev_stream = obs.trace.tracer.stream_path
+    track_was_on = copytrack.enabled()
+    obs.disable()
+    try:
+        obs.context.set_sample_rate(0.0)  # spans off; metrics are enough
+        obs.enable()
+        copytrack.enable()
+        copytrack.reset()
+        before = obs.metrics.snapshot()["histograms"].get(
+            "serve.execute_seconds", {})
+        res = run_bench(model=model, mode="closed", duration=duration,
+                        clients=clients, max_batch_size=max_batch_size,
+                        request_rows=request_rows)
+        after = obs.metrics.snapshot()["histograms"].get(
+            "serve.execute_seconds", {})
+        track = copytrack.snapshot()
+    finally:
+        if not track_was_on:
+            copytrack.disable()
+        obs.disable()
+        obs.context.set_sample_rate(prev_rate)
+        if was_on:
+            obs.enable(jsonl=prev_stream)
+        else:
+            obs.reset()
+    n = max(res["completed"], 1)
+    exec_s = after.get("sum", 0.0) - before.get("sum", 0.0)
+    exec_ms_per_req = 1e3 * exec_s / n
+    p50 = res["p50_ms"] or 0.0
+    sync_sites = track.get("hotpath.sync_sites", {})
+    return {
+        "model": model, "duration_s": duration, "clients": clients,
+        "request_rows": request_rows, "completed": res["completed"],
+        "qps": res["qps"], "p50_ms": p50, "p99_ms": res["p99_ms"],
+        "execute_ms_per_request": round(exec_ms_per_req, 3),
+        "hop_ms_p50": round(max(p50 - exec_ms_per_req, 0.0), 3),
+        "bytes_copied_per_request":
+            round(track.get("wire.bytes_copied", 0) / n, 1),
+        "serialize_calls_per_request":
+            round(track.get("wire.serialize_calls", 0) / n, 3),
+        "host_syncs_per_request":
+            round(track.get("hotpath.host_syncs", 0) / n, 3),
+        "sync_sites": dict(sorted(sync_sites.items(),
+                                  key=lambda kv: -kv[1])[:8]),
+        "bytes_copied_total": track.get("wire.bytes_copied", 0),
+    }
+
+
 def run_prof_overhead(model="mlp", duration=4.0, hz=None, clients=4,
                       max_batch_size=8, request_rows=1, threshold_pct=5.0,
                       segments=5):
@@ -1013,6 +1077,11 @@ def main(argv=None):
                          "JSON; warns when over the 5%% budget)")
     ap.add_argument("--sample", type=float, default=0.1,
                     help="head-sampling rate for --obs-overhead")
+    ap.add_argument("--wire-hop", action="store_true",
+                    help="closed-loop serve run with the MXNET_COPYTRACK "
+                         "twin on: p50 hop cost (execute subtracted) + "
+                         "bytes-copied/serialize-calls/host-syncs per "
+                         "request — the zero-copy rewrite's baseline")
     ap.add_argument("--prof-overhead", action="store_true",
                     help="measure the black-box plane's overhead: "
                          "closed-loop qps with everything off vs tail-mode "
@@ -1068,6 +1137,24 @@ def main(argv=None):
             print(f"WARNING: obs_overhead_pct={res['obs_overhead_pct']} "
                   f"exceeds the {res['threshold_pct']}% budget at "
                   f"sample={args.sample}", file=sys.stderr)
+        return 0
+
+    if args.wire_hop:
+        if args.connect:
+            ap.error("--wire-hop instruments an in-process stack and "
+                     "cannot target --connect")
+        res = run_wire_hop(model=args.model, duration=args.duration,
+                           clients=args.clients,
+                           max_batch_size=args.max_batch_size,
+                           request_rows=args.request_rows)
+        print(json.dumps(res, indent=1))
+        print(f"wire hop: p50 {res['hop_ms_p50']} ms "
+              f"(client p50 {res['p50_ms']} ms - execute "
+              f"{res['execute_ms_per_request']} ms), "
+              f"{res['bytes_copied_per_request']} B copied, "
+              f"{res['serialize_calls_per_request']} serialize calls, "
+              f"{res['host_syncs_per_request']} host syncs per request",
+              file=sys.stderr)
         return 0
 
     if args.prof_overhead:
