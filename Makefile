@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart train-obs copytrack
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic async perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart train-obs copytrack
 
 # repo self-lint: framework invariants + the concurrency-correctness pass
 # (lock-order cycles, blocking-under-lock, CV/thread discipline, wire
@@ -65,6 +65,15 @@ chaos-serve:
 elastic:
 	$(PYTHON) -m pytest tests/ -q -m elastic -p no:cacheprovider
 	$(PYTHON) tools/elastic_bench.py
+
+# bounded-staleness async training (docs/ROBUSTNESS.md "Asynchronous
+# training"): committed-clock protocol + gated pull, straggler-verdict
+# actuation (staleness widen / shard recut), hierarchical reduction,
+# async exactly-once across a PS SIGKILL, sync-vs-async convergence;
+# then the measured step-time decoupling leg
+async:
+	$(PYTHON) -m pytest tests/ -q -m async -p no:cacheprovider
+	$(PYTHON) tools/elastic_bench.py --async
 
 # dispatch-overhead guarantees (docs/PERFORMANCE.md): the perf-marked tests
 # assert a Trainer.step updates all params in <=2 compiled programs, then

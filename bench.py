@@ -701,6 +701,27 @@ def bench_train_obs(platform):
     return res
 
 
+def bench_async(platform):
+    """Bounded-staleness async plane (docs/ROBUSTNESS.md "Asynchronous
+    training"): the same straggler-shaped fleet under lockstep allreduce
+    vs the committed-clock gated-pull wire. The trajectory number is
+    ``async_step_decoupling`` — the slowest rank's median step time over
+    the fleet median — ~1.0 under sync (the straggler taxes every rank)
+    and >=2x under async (only the straggler pays)."""
+    del platform  # host-side plane: same measurement on any backend
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import elastic_bench
+
+    res = elastic_bench.run_async_bench(
+        workers=int(os.environ.get("BENCH_ASYNC_WORKERS", 3)))
+    assert res["ok"], (
+        f"async wire failed to decouple the fleet from its straggler: "
+        f"async_step_decoupling={res['async_step_decoupling']} "
+        f"(want >=2.0) vs sync {res['sync_step_decoupling']} (want ~1)")
+    return res
+
+
 def bench_update_engine_dispatches():
     """Compiled executions per optimizer step (tools/profile_step.py
     counters): the fused engine must stay at 1 program regardless of the
@@ -1040,6 +1061,18 @@ def main():
                 extra["elastic"]["elastic_recovery_s"]
         except Exception as e:
             extra["elastic_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not skip_leg("async"):
+        try:
+            # bounded-staleness async training must actually decouple the
+            # fleet from its slowest rank (docs/ROBUSTNESS.md
+            # "Asynchronous training"): sync lockstep vs the gated-pull
+            # wire under one slowed rank; extra.async_step_decoupling is
+            # the trajectory number (>=2x gated in the leg itself)
+            extra["async"] = bench_async(platform)
+            extra["async_step_decoupling"] = \
+                extra["async"]["async_step_decoupling"]
+        except Exception as e:
+            extra["async_error"] = f"{type(e).__name__}: {e}"[:200]
     if not skip_leg("train_obs"):
         try:
             # the training-fleet step accounting must be cheap enough to
@@ -1103,6 +1136,7 @@ def main():
         "health_overhead": "health_overhead",
         "wire_hop": "wire_hop",
         "elastic": "elastic",
+        "async": "async",
         "train_obs": "train_obs",
     }
     leg_error_key = {"bert_base_bf16": "bert_error"}  # irregular names

@@ -26,6 +26,13 @@ empty means every occurrence. Examples::
     MXNET_CHAOS_SLOW="0:data_wait::0.1"       # rank 0, every data_wait
     MXNET_CHAOS_SLOW="2:update@3,7:0.5"       # rank 2, 3rd and 7th update
 
+The seconds field also takes a ramp form ``base+step``: the delay starts
+at ``base`` on the rule's first matching occurrence and grows by ``step``
+per occurrence after it — a *worsening* straggler, so staleness-widening
+policies can be proven against deterioration, not just a constant lag::
+
+    MXNET_CHAOS_SLOW="1:forward@5-40:0.1+0.02"  # 0.1s at occ 5, +0.02/occ
+
 The rank is resolved from :func:`set_rank` (the elastic session calls it
 with the fleet rank) falling back to ``DMLC_WORKER_ID``. When the env var
 is unset the hook costs one truthiness check (fleetstats gates on the raw
@@ -47,16 +54,28 @@ __all__ = ["Rule", "configure", "reset", "enabled", "maybe_delay",
 class Rule:
     def __init__(self, rank: int, phase: str,
                  occurrences: Optional[Set[int]] = None,
-                 seconds: float = 0.0):
+                 seconds: float = 0.0, ramp: float = 0.0):
         self.rank = int(rank)
         self.phase = phase
         self.occurrences = set(occurrences) if occurrences else None
         self.seconds = float(seconds)
+        # per-occurrence growth (the ``base+step`` env form): a worsening
+        # straggler instead of a constant one
+        self.ramp = float(ramp)
+
+    def delay_for(self, occ: int) -> float:
+        """Injected seconds at 1-based occurrence ``occ``: constant, or
+        ``base + (occ - first_occurrence) * ramp`` for a ramp rule."""
+        if not self.ramp:
+            return self.seconds
+        first = min(self.occurrences) if self.occurrences else 1
+        return self.seconds + max(0, occ - first) * self.ramp
 
     def __repr__(self):
         occ = sorted(self.occurrences) if self.occurrences else "all"
-        return f"SlowRule(rank{self.rank}:{self.phase}@{occ}" \
-               f":{self.seconds}s)"
+        secs = (f"{self.seconds}+{self.ramp}" if self.ramp
+                else f"{self.seconds}")
+        return f"SlowRule(rank{self.rank}:{self.phase}@{occ}:{secs}s)"
 
 
 class _State(threading.local):
@@ -116,8 +135,19 @@ def parse_env(spec: str) -> List[Rule]:
         if not phase:
             raise ValueError(f"bad MXNET_CHAOS_SLOW entry {part!r}")
         try:
+            # ramp form base+step (a worsening straggler); plain floats —
+            # including exponent notation like 1e+3 — stay constant rules
+            base_s, plus, step_s = seconds.partition("+")
+            ramp = 0.0
+            if plus and base_s and step_s:
+                try:  # both halves must parse, else (e.g. "1e+3") it's
+                    _base, ramp = float(base_s), float(step_s)  # constant
+                except ValueError:
+                    ramp = 0.0
+                else:
+                    seconds = base_s
             rules.append(Rule(int(rank_s), phase, _parse_occs(occs),
-                              float(seconds)))
+                              float(seconds), ramp=ramp))
         except ValueError as e:
             raise ValueError(
                 f"bad MXNET_CHAOS_SLOW entry {part!r}: {e}") from e
@@ -170,10 +200,11 @@ def maybe_delay(phase: str) -> float:
         occ = _STATE.counters[key]
         if rule.occurrences is not None and occ not in rule.occurrences:
             continue
+        secs = rule.delay_for(occ)
         obs.event("chaos.slow", rank=my_rank, phase=phase,
-                  occurrence=occ, seconds=rule.seconds)
+                  occurrence=occ, seconds=secs)
         obs.inc("chaos.injected")
         obs.inc("chaos.slow.injected")
-        time.sleep(rule.seconds)
-        injected += rule.seconds
+        time.sleep(secs)
+        injected += secs
     return injected

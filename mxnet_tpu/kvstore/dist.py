@@ -34,11 +34,60 @@ from __future__ import annotations
 
 import os
 
+from .. import obs
 from ..base import MXNetError, get_env
 from . import elastic as elastic_mod
 from .kvstore import KVStore, _as_list
 
-__all__ = ["DistKVStore"]
+__all__ = ["DistKVStore", "hierarchical_allreduce"]
+
+
+def hierarchical_allreduce(session, key: str, flat, group_size: int,
+                           round_id: int, part: int, nparts: int,
+                           packer=None):
+    """Group-tree sum over the elastic wire (docs/ROBUSTNESS.md
+    "Asynchronous training"): three scoped reduces instead of one
+    all-to-one round —
+
+      1. group-local sum on ``key@g<gid>`` (``group_size`` contributors;
+         ``packer`` may 2-bit-compress this widest stage's wire bytes —
+         the dtype-16 framing from kvstore/compression.py, which the
+         server dequantizes on arrival),
+      2. cross-group sum on ``key@x`` (leaders only, one per group, with
+         each group's contributor count riding as an extra element),
+      3. broadcast back on ``key@b<gid>`` (the leader contributes the
+         fleet total, everyone else zeros).
+
+    ``round_id`` is the caller's explicit per-key counter: leaders run
+    one more scoped round than non-leaders, so the session's flat
+    ``_round`` cannot pace these. Returns ``(summed, contributors)``.
+    Raises :class:`~mxnet_tpu.kvstore.elastic.ElasticError` on a stage
+    timeout (a mid-round death) — callers fall back to the flat reduce.
+    """
+    import numpy as np
+
+    flat = np.ascontiguousarray(np.asarray(flat, np.float32).ravel())
+    G = max(2, int(group_size))
+    gid, lane = part // G, part % G
+    ngroups = (nparts + G - 1) // G
+    gsize = max(1, min(G, nparts - gid * G))
+    payload = packer(flat) if packer is not None else None
+    gsum, n1 = session.allreduce_scoped(f"{key}@g{gid}", flat, gsize,
+                                        round_id, payload=payload)
+    gsum = np.asarray(gsum, np.float32)
+    if lane == 0:
+        # the group's contributor count rides the cross-group vector so
+        # stage 3 can hand every rank the fleet-total divisor
+        ext = np.concatenate([gsum, np.float32([n1])])
+        xsum, _nx = session.allreduce_scoped(f"{key}@x", ext, ngroups,
+                                             round_id)
+        bcast_in = np.asarray(xsum, np.float32)
+    else:
+        bcast_in = np.zeros(flat.size + 1, np.float32)
+    total, _nb = session.allreduce_scoped(f"{key}@b{gid}", bcast_in,
+                                          gsize, round_id)
+    total = np.asarray(total, np.float32)
+    return total[:-1], max(1, int(round(float(total[-1]))))
 
 
 class DistKVStore(KVStore):
@@ -54,6 +103,25 @@ class DistKVStore(KVStore):
         self._gc = None
         self._elastic = None
         self._batch = {}  # pending local merges awaiting the fused collective
+        # bounded-staleness async session state (docs/ROBUSTNESS.md
+        # "Asynchronous training"): MXNET_ASYNC_STALENESS opts in — the
+        # committed step this rank last pushed (OP_CLOCK), the fleet
+        # clock bounds cached off every clock/pull reply, and the
+        # staleness-aware lr compensation toggle. Worker-side scaling
+        # (not server-side) keeps the WAL replay byte-exact.
+        env = get_env("MXNET_ASYNC_STALENESS", None)
+        self._async_staleness = int(env) if env is not None else None
+        self._async_step = 0
+        self._clock_floor = 0
+        self._clock_max = 0
+        self._clock_widen = 0
+        self._lr_comp = str(get_env("MXNET_ASYNC_LR_COMP", "1")).lower() \
+            not in ("0", "false", "")
+        # hierarchical reduction: group size (0/1 = flat), per-key round
+        # counters — leaders run one extra scoped round per step, so the
+        # session's flat counter cannot pace the tree stages
+        self._hier_group = get_env("MXNET_ASYNC_GROUP", 0, int) or 0
+        self._hier_rounds = {}
         addr = get_env("MXNET_PS_ADDR", get_env("DMLC_PS_ROOT_URI", None))
         port = int(get_env("MXNET_PS_PORT", get_env("DMLC_PS_ROOT_PORT", 9091, int), int) or 9091)
         if self._is_async:
@@ -167,6 +235,35 @@ class DistKVStore(KVStore):
         recut) off this."""
         return self._elastic
 
+    def step_complete(self, step: int):
+        """Commit "this rank FINISHED ``step``" to the PS committed-clock
+        table (``OP_CLOCK``) — the worker half of the bounded-staleness
+        protocol. ``Module.fit`` calls it after every optimizer step; a
+        no-op outside async-staleness mode. The ack carries the fleet
+        clock bounds, so this is also where the lr-compensation lag and
+        the gate's floor view refresh."""
+        if self._ps is None or self._async_staleness is None:
+            return
+        self._async_step = int(step)
+        floor, maxc, widen = self._ps.push_clock(self._rank, int(step))
+        self._clock_floor, self._clock_max = floor, maxc
+        self._clock_widen = widen
+        if obs.enabled():
+            obs.set_gauge("kvstore.async.clock_floor", floor)
+            obs.set_gauge(f"kvstore.async.rank{self._rank}_lag",
+                          max(0, maxc - int(step)))
+
+    def _lr_comp_scale(self) -> float:
+        """Staleness-aware lr compensation (worker-side so the server's
+        WAL replay stays byte-exact): a gradient computed ``lag`` steps
+        behind the fleet's fastest committed clock is scaled by
+        ``1 / (1 + lag)`` — stale directions count less, the async run's
+        effective step size tracks the sync run's."""
+        if self._async_staleness is None or not self._lr_comp:
+            return 1.0
+        lag = max(0, self._clock_max - self._async_step)
+        return 1.0 / (1.0 + lag)
+
     def _fused_flat_reduce(self, arrays, key: str, zero_local: bool):
         """One fused sum-reduction of many arrays: flatten-concat, reduce
         over the fleet (elastic generation-scoped reduce or the jax
@@ -183,7 +280,7 @@ class DistKVStore(KVStore):
         if zero_local:
             flat = np.zeros_like(flat)
         if self._elastic is not None:
-            summed, n = self._elastic.allreduce(key, flat)
+            summed, n = self._elastic_reduce(key, flat)
         else:
             from ..ndarray import NDArray
 
@@ -195,6 +292,29 @@ class DistKVStore(KVStore):
             out.append(summed[off:off + size].reshape(shape))
             off += size
         return out, n
+
+    def _elastic_reduce(self, key: str, flat):
+        """One elastic sum: the group-tree (``MXNET_ASYNC_GROUP`` > 1 and
+        a fleet larger than one group) or the flat generation-scoped
+        reduce. A tree-stage timeout (a mid-round death desyncs the
+        scoped contributor counts until the next epoch recut) falls back
+        to the flat reduce, which is membership-scoped and releases over
+        the survivors — degraded shape, same numerics."""
+        joined = getattr(self._elastic, "_joined", None)
+        if (self._hier_group > 1 and joined is not None
+                and joined.num_parts > self._hier_group):
+            rid = self._hier_rounds.get(key, 0)
+            self._hier_rounds[key] = rid + 1
+            try:
+                return hierarchical_allreduce(
+                    self._elastic, key, flat, self._hier_group, rid,
+                    joined.part_index, joined.num_parts)
+            except elastic_mod.StaleMemberError:
+                raise
+            except elastic_mod.ElasticError:
+                obs.inc("kvstore.hier.fallbacks")
+                obs.event("kvstore.hier.fallback", key=key, round=rid)
+        return self._elastic.allreduce(key, flat)
 
     def allreduce_mean(self, arrays):
         """Mean-allreduce a list of numpy arrays over the LIVE fleet in one
@@ -268,7 +388,12 @@ class DistKVStore(KVStore):
                 merged = vs[0]
                 for e in vs[1:]:
                     merged = merged + e
-                self._ps.push(str(k), merged.asnumpy(),
+                arr = merged.asnumpy()
+                scale = self._lr_comp_scale()
+                if scale != 1.0:
+                    arr = arr * scale
+                    obs.inc("kvstore.async.lr_comp_applied")
+                self._ps.push(str(k), arr,
                               compressor=getattr(self, "_gc", None))
             return
         if self._num_workers > 1:
@@ -389,7 +514,16 @@ class DistKVStore(KVStore):
         if self._ps is not None:
             keys, outs = _as_list(key), _as_list(out)
             for k, o in zip(keys, outs):
-                arr = self._ps.pull(str(k))
+                if self._async_staleness is not None:
+                    # staleness-gated: blocks server-side while this rank
+                    # would run more than s (+ policy widening) steps
+                    # ahead of the fleet's committed-clock floor
+                    arr, floor, maxc = self._ps.pull_stale(
+                        str(k), self._rank, self._async_step,
+                        self._async_staleness)
+                    self._clock_floor, self._clock_max = floor, maxc
+                else:
+                    arr = self._ps.pull(str(k))
                 for oo in _as_list(o):
                     from ..ndarray import array
 

@@ -60,15 +60,18 @@ __all__ = ["ElasticState", "ElasticWorkerSession", "Heartbeater", "JoinInfo",
            "heartbeat_interval", "miss_threshold", "capture_server_state",
            "install_server_state", "ELASTIC_OP_NAMES"]
 
-# Opcodes 16-20: the elastic-training range on the PS wire (0-9 = kvstore,
-# 32-42 = serve — same framing). Codes come from the declarative registry
+# Opcodes 16-26: the elastic-training range on the PS wire (0-9 = kvstore,
+# 32-43 = serve — same framing). Codes come from the declarative registry
 # (mxnet_tpu/wire.py), where collisions are impossible by construction.
-OP_HB, OP_JOIN, OP_REDUCE, OP_EPOCH, OP_LEAVE = PS_WIRE.codes(
-    "heartbeat", "join", "reduce", "epoch", "leave")
+(OP_HB, OP_JOIN, OP_REDUCE, OP_EPOCH, OP_LEAVE, OP_CLOCK, OP_CLOCK_PULL,
+ OP_PULL_STALE, OP_REDUCE_SCOPED) = PS_WIRE.codes(
+    "heartbeat", "join", "reduce", "epoch", "leave", "clock", "clock_pull",
+    "pull_stale", "reduce_scoped")
 
 ELASTIC_OP_NAMES = {code: name for code, name in PS_WIRE.names().items()
                     if code in (OP_HB, OP_JOIN, OP_REDUCE, OP_EPOCH,
-                                OP_LEAVE)}
+                                OP_LEAVE, OP_CLOCK, OP_CLOCK_PULL,
+                                OP_PULL_STALE, OP_REDUCE_SCOPED)}
 
 # OP_EPOCH payload carrying this epoch value means "block until my
 # quarantined membership is activated" (the rejoin wait).
@@ -125,13 +128,17 @@ class _Member:
 
 
 class _Round:
-    __slots__ = ("contribs", "stamps")
+    __slots__ = ("contribs", "stamps", "expected")
 
     def __init__(self):
         self.contribs: Dict[int, np.ndarray] = {}
         # cid -> arrival monotonic: the reduce wait-by-rank attribution
         # (who stood waiting vs who arrived last) reads these at release
         self.stamps: Dict[int, float] = {}
+        # scoped rounds (OP_REDUCE_SCOPED, the hierarchical-reduction
+        # transport) complete at this contributor count instead of the
+        # full live membership; 0 = membership-scoped (classic)
+        self.expected = 0
 
 
 class ElasticState:
@@ -157,6 +164,11 @@ class ElasticState:
         self._rounds: Dict = {}        # (key, round) -> _Round
         self._completed: "OrderedDict" = OrderedDict()  # LRU: retried rounds
         self._epoch_arrived: set = set()
+        # shard-recut rotation (the on_straggler data_wait actuation):
+        # each request_recut() bumps the salt, rotating part indices over
+        # the rank order at the NEXT epoch boundary — a pathological shard
+        # (cold cache, slow storage segment) moves off the blamed rank
+        self.shard_salt = 0
         self._last_release: Optional[dict] = None
         # callbacks poked (outside cv) after any membership change — the
         # PSServer hangs its barrier-release re-check here
@@ -178,12 +190,26 @@ class ElasticState:
         return len(self.active_members())
 
     def assignment(self, cid: int):
-        """(part_index, num_parts) over actives ordered by (rank, cid)."""
+        """(part_index, num_parts) over actives ordered by (rank, cid),
+        rotated by the recut salt (every worker applies the new cut at
+        the next epoch boundary — ``epoch_end`` reports it as changed)."""
         order = sorted(self.active_members(), key=lambda m: (m.rank, m.cid))
+        n = len(order)
         for i, m in enumerate(order):
             if m.cid == cid:
-                return i, len(order)
-        return 0, max(1, len(order))
+                return (i + self.shard_salt) % n, n
+        return 0, max(1, n)
+
+    def request_recut(self) -> int:
+        """Rotate the shard assignment at the next epoch boundary (the
+        on_straggler data_wait actuation — see docs/ROBUSTNESS.md
+        "Asynchronous training"). Returns the new salt."""
+        with self.cv:
+            self.shard_salt += 1
+            salt = self.shard_salt
+        obs.inc("elastic.shard_recuts")
+        obs.event("elastic.shard_recut_requested", salt=salt)
+        return salt
 
     def liveness_table(self):
         """[(rank, cid, state, heartbeat_age_s)] — the structured
@@ -359,9 +385,16 @@ class ElasticState:
 
     # -- generation-scoped reduce ---------------------------------------
     def reduce(self, cid: int, key: str, round_id: int, arr: np.ndarray,
-               timeout: float):
+               timeout: float, expected: int = 0):
         """Blocking sum-allreduce contribution. Returns
-        ``(status, generation, contributors, result)``."""
+        ``(status, generation, contributors, result)``.
+
+        ``expected > 0`` makes this a *scoped* round (hierarchical
+        reduction): it completes once that many distinct cids contributed
+        — the server does not know group membership, so a death inside
+        the group is covered by the caller's timeout + flat fallback
+        (deaths that shrink the whole fleet below ``expected`` still
+        release the round over the survivors)."""
         with self.cv:
             self.started = True
             m = self.members.get(cid)
@@ -373,6 +406,8 @@ class ElasticState:
             if done is not None:  # idempotent retry of a released round
                 return ST_OK, self.generation, done[0], done[1]
             r = self._rounds.setdefault(ck, _Round())
+            if expected:
+                r.expected = max(r.expected, int(expected))
             if cid not in r.contribs:
                 r.stamps[cid] = time.monotonic()
             r.contribs.setdefault(cid, arr)  # dedup a duplicated frame
@@ -392,7 +427,14 @@ class ElasticState:
         if r is None:
             return
         required = {m.cid for m in self.active_members()}
-        if not required or not required.issubset(r.contribs):
+        if r.expected:
+            # scoped round: N distinct contributors release it, capped at
+            # the live fleet size so deaths can still release the round
+            need = min(r.expected, len(required)) if required \
+                else r.expected
+            if len(r.contribs) < max(1, need):
+                return
+        elif not required or not required.issubset(r.contribs):
             return
         contribs = list(r.contribs.values())
         result = contribs[0].copy()
@@ -430,9 +472,10 @@ class ElasticState:
             m = self.members.get(last_cid)
             if m is not None:
                 obs.inc(f"kvstore.reduce_last_arriver.rank{m.rank}")
-        if set(r.contribs) != required:
+        if not r.expected and set(r.contribs) != required:
             # released over a different set than required right now — a
-            # member died mid-round (its gradient, if sent, still counts)
+            # member died mid-round (its gradient, if sent, still counts);
+            # scoped rounds complete under the full membership by design
             obs.inc("elastic.reduce_partial")
         self.cv.notify_all()
 
@@ -602,6 +645,17 @@ def capture_server_state(server):
         "seq": seq_entries,
         "num_workers": server._num_workers,
     }
+    # bounded-staleness async: the committed-clock table rides the
+    # snapshot (and kind-4 WAL records cover advances after it) so a
+    # SIGKILL mid-async-storm restarts with the staleness gate's view of
+    # the fleet intact — a zeroed clock floor would wrongly admit every
+    # fast rank an extra `s` steps ahead
+    with server._clock_cv:
+        if server._clock:
+            meta["clock"] = [[int(r), int(c)]
+                             for r, c in server._clock.items()]
+            meta["clock_cids"] = [[str(cid), int(r)]
+                                  for cid, r in server._clock_rank.items()]
     if server._optimizer is not None:
         # scalar counters the slots don't carry (reuse PR-2's capture)
         scal = capture_optimizer(None, server._optimizer, arrays)
@@ -642,6 +696,13 @@ def install_server_state(server, state) -> None:
     with server._seq_lock:
         for cid, key, seq in state.meta.get("seq", []):
             server._record_seq(int(cid), key, int(seq))
+    with server._clock_cv:
+        for rank, clock in state.meta.get("clock", []):
+            cur = server._clock.get(int(rank), 0)
+            server._clock[int(rank)] = max(cur, int(clock))
+        for cid, rank in state.meta.get("clock_cids", []):
+            server._clock_rank[int(cid)] = int(rank)
+        server._clock_cv.notify_all()
     spec = state.meta.get("opt_spec")
     if spec:
         server._set_optimizer_bytes(spec.encode("ascii"), warm=False)
@@ -684,7 +745,10 @@ class PushWAL:
     Record framing: ``u32 len | u32 crc32(body) | body`` with
     ``body = u8 kind | u64 cid | u64 seq | u16 klen | key | payload``
     (kind 0 = dense array payload, 1 = sparse (indices, rows) payload,
-    2 = key birth from OP_INIT — first-wins on replay, cid/seq unused).
+    2 = key birth from OP_INIT — first-wins on replay, cid/seq unused;
+    3 = optimizer spec; 4 = committed-clock advance from OP_CLOCK — the
+    key is the decimal rank, seq the step, and replay max-merges, so a
+    replayed record can never roll a clock back).
     A torn tail record (SIGKILL mid-append) fails the CRC and truncates
     the replay there — by construction that push was never acked, so the
     client retries it. Files rotate at each snapshot commit
@@ -1047,6 +1111,38 @@ class ElasticWorkerSession:
                       contributors=contributors)
             self.generation = gen
         self._round += 1
+        return _unpack_array(reply[13:]), contributors
+
+    def allreduce_scoped(self, key: str, arr: np.ndarray, expected: int,
+                         round_id: int, timeout: Optional[float] = None,
+                         payload: Optional[bytes] = None):
+        """Scoped sum: the round completes at ``expected`` distinct
+        contributors instead of the full live membership — the transport
+        under hierarchical reduction (``kvstore/dist.py``). ``round_id``
+        is explicit: group members and leaders run different numbers of
+        scoped rounds per step, so the session's flat counter cannot pace
+        them. ``payload`` optionally carries pre-packed array bytes (the
+        2-bit-compressed sparse wire from ``kvstore/compression.py``)."""
+        from .ps_server import _pack_array, _unpack_array
+
+        timeout = self._reduce_timeout if timeout is None else float(timeout)
+        body = (_pack_array(np.ascontiguousarray(arr))
+                if payload is None else payload)
+        req = (struct.pack("<QQdI", self.cid, int(round_id), timeout,
+                           int(expected)) + body)
+        with obs.trace.span("elastic.allreduce_scoped", key=key,
+                            round=int(round_id), expected=int(expected)):
+            _, _, reply = self._cli._rpc(OP_REDUCE_SCOPED, key, req,
+                                         timeout=timeout + 10.0)
+        st, gen, contributors = struct.unpack_from("<BQI", reply, 0)
+        if st == ST_STALE:
+            raise StaleMemberError(
+                f"scoped reduce for key {key!r} rejected: this worker is "
+                f"not a live member of generation {gen}")
+        if st != ST_OK:
+            raise ElasticError(
+                f"scoped reduce timed out for key {key!r} round "
+                f"{round_id} (expected {expected} contributors)")
         return _unpack_array(reply[13:]), contributors
 
     def epoch_end(self, epoch: int, timeout: Optional[float] = None

@@ -31,7 +31,7 @@ from .ps_server import (OP_BARRIER, OP_INIT, OP_PULL, OP_PULL_SPARSE,
                         OP_PUSH_SPARSE_SEQ, OP_SET_OPT, OP_SHUTDOWN,
                         OP_STATS, OP_TELEMETRY, _pack_array, _pack_sparse,
                         _recv_msg, _send_msg, _unpack_array)
-from .elastic import OP_HB
+from .elastic import OP_CLOCK, OP_CLOCK_PULL, OP_HB, OP_PULL_STALE, ST_OK
 
 
 class PSClient:
@@ -237,6 +237,57 @@ class PSClient:
                 f"sparse pull rejected for key {key!r} (uninitialized key "
                 "or out-of-range row index)")
         return _unpack_array(payload)
+
+    def push_clock(self, rank: int, step: int):
+        """Commit "this rank FINISHED step ``step``" (``OP_CLOCK``,
+        docs/ROBUSTNESS.md "Asynchronous training"). Max-merged and
+        WAL-covered server-side, so retries are harmless and the table
+        survives a server SIGKILL. Returns ``(floor, max_clock, widen)``
+        — the fleet clock bounds ride the ack, so every step's commit
+        doubles as the worker's staleness-view refresh."""
+        _, _, reply = self._rpc(
+            OP_CLOCK, "",
+            struct.pack("<QQQ", self._client_id, int(rank), int(step)))
+        st, floor, maxc, widen = struct.unpack_from("<BQQI", reply, 0)
+        if st != ST_OK:
+            raise MXNetError(f"clock push rejected for rank {rank}")
+        return floor, maxc, widen
+
+    def pull_clock(self):
+        """The committed-clock table (``OP_CLOCK_PULL``): ``(floor,
+        {rank: clock})`` — read-only; tests assert exactly-once clock
+        recovery with it."""
+        _, _, reply = self._rpc(OP_CLOCK_PULL, "")
+        st, floor, n = struct.unpack_from("<BQI", reply, 0)
+        if st != ST_OK:
+            raise MXNetError("clock pull failed")
+        table = {}
+        for i in range(n):
+            r, c = struct.unpack_from("<QQ", reply, 13 + 16 * i)
+            table[int(r)] = int(c)
+        return floor, table
+
+    def pull_stale(self, key: str, rank: int, step: int, staleness: int,
+                   timeout: float = 90.0):
+        """Staleness-gated pull (``OP_PULL_STALE``): blocks server-side
+        while this worker's committed clock ``step`` runs more than
+        ``staleness`` (+ any policy widening) ahead of the fleet's
+        committed-clock floor. The wait bound rides IN the request (the
+        OP_REDUCE discipline) and the socket timeout sits above it, so a
+        straggler-bound stall reports as a structured TimeoutError, not
+        a dropped connection. Returns ``(weights, floor, max_clock)``."""
+        payload = struct.pack("<QQQQd", self._client_id, int(rank),
+                              int(step), int(staleness), float(timeout))
+        _, _, reply = self._rpc(OP_PULL_STALE, key, payload,
+                                timeout=timeout + 10.0)
+        st, floor, maxc = struct.unpack_from("<BQQ", reply, 0)
+        if st != ST_OK:
+            raise TimeoutError(
+                f"staleness-gated pull for key {key!r} timed out: this "
+                f"rank's clock {step} is more than {staleness} steps "
+                f"ahead of the fleet floor {floor} (slowest rank is the "
+                "gate — see docs/ROBUSTNESS.md)")
+        return _unpack_array(reply[17:]), floor, maxc
 
     def set_optimizer(self, optimizer):
         # text wire format shared with the C++ server (native/ps/ps_server.cc)

@@ -49,8 +49,10 @@ from ..obs import context as obs_context
 from ..base import CODE_TO_DTYPE, DTYPE_TO_CODE, get_env
 from ..wire import PS_WIRE
 from . import elastic as elastic_mod
-from .elastic import (ELASTIC_OP_NAMES, OP_EPOCH, OP_HB, OP_JOIN, OP_LEAVE,
-                      OP_REDUCE, ST_ERROR, ST_OK, ST_QUARANTINED, ST_STALE)
+from .elastic import (ELASTIC_OP_NAMES, OP_CLOCK, OP_CLOCK_PULL, OP_EPOCH,
+                      OP_HB, OP_JOIN, OP_LEAVE, OP_PULL_STALE, OP_REDUCE,
+                      OP_REDUCE_SCOPED, ST_ERROR, ST_OK, ST_QUARANTINED,
+                      ST_STALE)
 
 # opcode constants come from the declarative registry (mxnet_tpu/wire.py):
 # codes, names, and exactly-once metadata live in ONE table that the
@@ -215,7 +217,8 @@ class PSServer:
 
     def __init__(self, host="0.0.0.0", port=9091, num_workers=1,
                  barrier_timeout=60.0, snapshot_dir=None,
-                 snapshot_period=None, hb_interval=None, miss_k=None):
+                 snapshot_period=None, hb_interval=None, miss_k=None,
+                 async_staleness=None):
         self._weights: Dict[str, np.ndarray] = {}
         self._locks: Dict[str, threading.Lock] = {}
         self._updater = None
@@ -277,6 +280,30 @@ class PSServer:
         self._hot_keys = _fleetstats.HotKeyTable()
         self._telemetry_tokens: "OrderedDict" = OrderedDict()
         self._telemetry_lock = tsan.lock("ps.telemetry")
+        # bounded-staleness async plane (docs/ROBUSTNESS.md "Asynchronous
+        # training"): per-rank committed clocks (rank -> last COMPLETED
+        # step), the cid->rank table that attributes them, and the
+        # per-rank staleness widening the straggler policy grants.
+        # Initialized BEFORE _init_durability(): snapshot restore
+        # (install_server_state) max-merges straight into these tables.
+        # Lock order: _clock_cv may take el.cv (floor computation), never
+        # the reverse — membership callbacks fire outside el.cv.
+        self._clock: Dict[int, int] = {}
+        self._clock_rank: Dict[int, int] = {}
+        self._staleness_widen: Dict[int, int] = {}
+        self._clock_cv = tsan.condition("ps.clock")
+        if async_staleness is None:
+            env = get_env("MXNET_ASYNC_STALENESS", None)
+            async_staleness = int(env) if env is not None else None
+        self._async_staleness = async_staleness
+        self._async_widen_step = get_env("MXNET_ASYNC_WIDEN", 2, int)
+        self._async_max_staleness = get_env(
+            "MXNET_ASYNC_MAX_STALENESS", 16, int)
+        if self._async_staleness is not None:
+            # actuation (ROADMAP open item 2): straggler verdicts change
+            # fleet behavior instead of only being reported. Registered
+            # only in async mode so sync fleets keep PR 15 behavior.
+            self.fleet.on_straggler(self._policy_on_straggler)
         self._started = time.monotonic()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -330,6 +357,10 @@ class PSServer:
         with self._barrier_cv:
             self._release_barrier_locked()
             self._barrier_cv.notify_all()
+        # a declared death moves the committed-clock floor (dead ranks
+        # stop holding it down) — staleness-gated pulls must re-check
+        with self._clock_cv:
+            self._clock_cv.notify_all()
 
     def _live_ranks(self):
         """Active members' ranks — the fleet aggregator's membership view
@@ -350,6 +381,94 @@ class PSServer:
                 if el.has_members():
                     return max(1, el.active_count())
         return self._num_workers
+
+    # ------------------------------------------------------------------
+    # bounded-staleness async clock plane (docs/ROBUSTNESS.md
+    # "Asynchronous training")
+    # ------------------------------------------------------------------
+    def _clock_floor_locked(self) -> int:
+        """Caller holds ``_clock_cv``. The committed-clock floor: min
+        committed step over LIVE ranks — a live rank that has not
+        committed yet floors at 0, so fast ranks cannot run away before
+        the fleet's first commits land. Dead/left ranks drop out the
+        moment liveness declares them (membership changes notify
+        ``_clock_cv`` for exactly this). Without a membership plane every
+        rank that ever committed counts."""
+        live = self._live_ranks()
+        if live:
+            return min(self._clock.get(r, 0) for r in live)
+        if not self._clock:
+            return 0
+        return min(self._clock.values())
+
+    def _clock_bounds_locked(self):
+        """Caller holds ``_clock_cv``: (floor, max clock, policy widen)."""
+        floor = self._clock_floor_locked()
+        maxc = max(self._clock.values()) if self._clock else 0
+        widen = max(self._staleness_widen.values(), default=0)
+        return floor, maxc, widen
+
+    def _advance_clock(self, cid: int, rank: int, step: int) -> bool:
+        """Commit "rank FINISHED step ``step``" — max-merge (a retried or
+        reordered frame can never roll a clock back) and wake every
+        staleness-gated pull. The advance rides the WAL (kind 4) before
+        the caller acks, so a SIGKILLed server warm-restarts
+        mid-async-storm with the clock table intact — the exactly-once
+        contract extends to clocks."""
+        with self._clock_cv:
+            advanced = step > self._clock.get(rank, -1)
+            if advanced:
+                self._clock[rank] = step
+                self._clock_cv.notify_all()
+            self._clock_rank[cid] = rank
+        if advanced and self._wal is not None:
+            # append OUTSIDE _clock_cv: the fsync must not serialize the
+            # gated-pull wakeups; still durable before the ack
+            self._wal.append(4, cid, step, str(rank), b"")
+        return advanced
+
+    def _policy_on_straggler(self, verdict: dict):
+        """``on_straggler`` actuation (async mode only — PR 15 built the
+        sensor, this closes the loop): a compute-blamed straggler WIDENS
+        the fleet's staleness bound (fast ranks run further ahead instead
+        of stalling at the gate), a data_wait-blamed one triggers a shard
+        recut (the pathological shard rotates off the rank at the next
+        epoch boundary), and a recovery withdraws the widening. Runs on
+        the heartbeat handler thread — exception containment lives in
+        ``FleetAggregator._judge``, and this hook must return promptly
+        (the SLOMonitor callback contract)."""
+        kind = verdict.get("kind")
+        rank = verdict.get("rank")
+        if rank is None:
+            return
+        if kind == "recovered":
+            with self._clock_cv:
+                narrowed = self._staleness_widen.pop(rank, None)
+                if narrowed is not None:
+                    self._clock_cv.notify_all()
+            if narrowed is not None:
+                obs.event("train.async.staleness_narrowed", rank=rank,
+                          was=narrowed)
+            return
+        if kind != "straggler":
+            return
+        blame = verdict.get("blame")
+        if blame == "data_wait" and self._elastic is not None:
+            self._elastic.request_recut()
+            obs.event("train.async.shard_recut", rank=rank, blame=blame)
+            return
+        base = self._async_staleness or 0
+        with self._clock_cv:
+            cur = self._staleness_widen.get(rank, 0)
+            new = min(cur + self._async_widen_step,
+                      max(0, self._async_max_staleness - base))
+            if new != cur:
+                self._staleness_widen[rank] = new
+                self._clock_cv.notify_all()
+        if new != cur:
+            obs.inc("train.async.staleness_widened")
+            obs.event("train.async.staleness_widened", rank=rank,
+                      widen=new, blame=blame or "compute")
 
     def _init_durability(self):
         from ..checkpoint.manager import CheckpointManager
@@ -409,6 +528,19 @@ class PSServer:
                 # snapshot must NOT rebuild the Updater — that would wipe
                 # the snapshot-restored slots (momentum etc.)
                 self._set_optimizer_bytes(bytes(payload), warm=False)
+            return
+        if kind == 4:  # committed-clock advance (OP_CLOCK): key is the
+            # decimal rank, seq the step — max-merge, so replaying a
+            # record older than the snapshot-restored clock is a no-op
+            # and a clock can never roll back across a warm restart
+            try:
+                rank = int(key)
+            except ValueError:
+                return
+            with self._clock_cv:
+                if seq > self._clock.get(rank, -1):
+                    self._clock[rank] = seq
+                self._clock_rank[cid] = rank
             return
         if key not in self._weights:
             return
@@ -748,6 +880,90 @@ class PSServer:
                 (cid,) = struct.unpack_from("<Q", payload, 0)
                 self._elastic.leave(cid)
             _send_msg(conn, OP_LEAVE, key, b"\x00")
+        elif opcode == OP_CLOCK:
+            # async committed-clock push: "rank r finished step t";
+            # max-merge + kind-4 WAL record via _advance_clock. Reply
+            # carries the fleet clock bounds so every step's commit
+            # doubles as the worker's view refresh (floor for the gate,
+            # max for lr compensation) — no extra RPC per step.
+            if len(payload) < 24:
+                _send_msg(conn, OP_CLOCK, key,
+                          struct.pack("<BQQI", ST_ERROR, 0, 0, 0))
+                return True
+            cid, rank, step = struct.unpack_from("<QQQ", payload, 0)
+            self._advance_clock(cid, int(rank), int(step))
+            with self._clock_cv:
+                floor, maxc, widen = self._clock_bounds_locked()
+            _send_msg(conn, OP_CLOCK, key,
+                      struct.pack("<BQQI", ST_OK, floor, maxc, widen))
+        elif opcode == OP_CLOCK_PULL:
+            # read-only committed-clock table dump — tests assert
+            # exactly-once clock recovery with it; retries harmless
+            with self._clock_cv:
+                floor = self._clock_floor_locked()
+                table = sorted(self._clock.items())
+            _send_msg(conn, OP_CLOCK_PULL, key,
+                      struct.pack("<BQI", ST_OK, floor, len(table))
+                      + b"".join(struct.pack("<QQ", r, c)
+                                 for r, c in table))
+        elif opcode == OP_PULL_STALE:
+            # staleness-gated pull (stale-synchronous-parallel): the
+            # puller declares its own committed clock and blocks while it
+            # would run more than s_eff steps ahead of the fleet's
+            # committed-clock floor (s_eff = requested bound + policy
+            # widening). The wait bound rides IN the request (the
+            # OP_REDUCE discipline) so the server answers ST_ERROR before
+            # the client socket timeout instead of dropping the
+            # connection.
+            if len(payload) < 40 or key not in self._weights:
+                _send_msg(conn, OP_PULL_STALE, key,
+                          struct.pack("<BQQ", ST_ERROR, 0, 0))
+                return True
+            cid, rank, step, stale, wait = struct.unpack_from(
+                "<QQQQd", payload, 0)
+            deadline = time.monotonic() + max(0.0, min(float(wait), 3600.0))
+            st, blocked = ST_OK, False
+            with self._clock_cv:
+                while True:
+                    floor, maxc, widen = self._clock_bounds_locked()
+                    if step <= floor + stale + widen:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        st = ST_ERROR
+                        obs.inc("kvstore.async.gate_timeouts")
+                        break
+                    blocked = True
+                    self._clock_cv.wait(timeout=remaining)
+            if blocked:
+                obs.inc("kvstore.async.gate_blocks")
+            if st != ST_OK:
+                _send_msg(conn, OP_PULL_STALE, key,
+                          struct.pack("<BQQ", st, floor, maxc))
+                return True
+            with self._locks.get(key, self._global_lock):
+                arr = self._weights[key]
+            _send_msg(conn, OP_PULL_STALE, key,
+                      [struct.pack("<BQQ", ST_OK, floor, maxc),
+                       _pack_array(arr)])
+        elif opcode == OP_REDUCE_SCOPED:
+            # scoped reduce: completes at an explicit contributor count
+            # instead of the full live membership — the group-local and
+            # cross-group stages of hierarchical reduction ride this
+            if self._elastic is None or len(payload) < 28:
+                _send_msg(conn, OP_REDUCE_SCOPED, key,
+                          struct.pack("<BQI", ST_ERROR, 0, 0))
+                return True
+            cid, round_id, wait, expected = struct.unpack_from(
+                "<QQdI", payload, 0)
+            arr = _unpack_array(payload[28:])
+            st, gen, n, result = self._elastic.reduce(
+                cid, key, round_id, arr,
+                timeout=max(1.0, min(float(wait), 3600.0)),
+                expected=int(expected))
+            head = struct.pack("<BQI", st, gen, n)
+            _send_msg(conn, OP_REDUCE_SCOPED, key,
+                      head + (_pack_array(result) if st == ST_OK else b""))
         elif opcode == OP_TELEMETRY:
             # training-fleet telemetry pull: this server's own part (its
             # kvstore.server.rpc lanes + STATS) plus every cached worker
@@ -843,6 +1059,18 @@ class PSServer:
                 for rank, cid, state, age in el.liveness_table()]
         out["fleet"] = self.fleet.stats()
         out["hot_keys"] = self._hot_keys.snapshot()
+        with self._clock_cv:
+            if self._clock or self._async_staleness is not None:
+                floor, maxc, widen = self._clock_bounds_locked()
+                out["async"] = {
+                    "staleness": self._async_staleness,
+                    "clock_floor": floor, "clock_max": maxc,
+                    "widen": widen,
+                    "clocks": {str(r): c
+                               for r, c in sorted(self._clock.items())},
+                    "staleness_widen": {
+                        str(r): w for r, w
+                        in sorted(self._staleness_widen.items())}}
         if include_metrics:
             out["metrics"] = obs.metrics.snapshot()
         return out

@@ -417,6 +417,13 @@ class BaseModule:
                     # above fold into this rank's current window; sealed
                     # windows ride the next heartbeat to the PS server
                     _fleetstats.step_complete(global_step)
+                    # bounded-staleness async (docs/ROBUSTNESS.md): commit
+                    # this rank's finished step to the PS committed-clock
+                    # table; a no-op outside async-staleness mode (and
+                    # kvstore may be a plain string spec here)
+                    tick = getattr(kvstore, "step_complete", None)
+                    if callable(tick):
+                        tick(global_step)
                     if manager is not None and manager.preempted.is_set():
                         # flush a final snapshot after the in-flight batch;
                         # with a non-positionable iterator no mid-epoch point

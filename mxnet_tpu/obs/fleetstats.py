@@ -781,7 +781,10 @@ class FleetAggregator:
                 try:
                     fn(dict(v))
                 except Exception:  # noqa: BLE001 — policy hooks must never
-                    pass           # take down the telemetry plane
+                    # take down the telemetry plane (the actuation hooks
+                    # run on the PS heartbeat handler thread); counted so
+                    # a silently-broken policy is visible in STATS
+                    inc("train.straggler.callback_errors")
 
     # -- answers ---------------------------------------------------------
     def parts(self, drain: bool = True) -> List[dict]:

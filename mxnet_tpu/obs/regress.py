@@ -104,6 +104,11 @@ GAIN_SPECS = (
     ("wire_hop_ms_p50", "extra.wire_hop.hop_ms_p50", None, False),
     ("wire_bytes_copied_per_req",
      "extra.wire_hop.bytes_copied_per_request", None, False),
+    # bounded-staleness async training (docs/ROBUSTNESS.md "Asynchronous
+    # training"): slowest rank's median step time over the fleet median
+    # under one slowed rank on the gated-pull wire — ~1 means lockstep
+    # coupling, >=2 means only the straggler pays for its own lag
+    ("async_step_decoupling", "extra.async_step_decoupling", None, True),
 )
 
 

@@ -226,7 +226,11 @@ _ENV_REGISTRY = {
     "MXNET_CHAOS_SLOW": (None, "Chaos: delay a named rank's step phase at "
                          "counted occurrences, e.g. '1:forward@5-40:0.25' "
                          "(chaos/slow.py — proves the straggler detector "
-                         "flags the injected rank AND phase)."),
+                         "flags the injected rank AND phase). The seconds "
+                         "field also takes a 'base+step' ramp, e.g. "
+                         "'1:forward@5-40:0.1+0.02' — a WORSENING "
+                         "straggler, for proving staleness-widening "
+                         "policies against deterioration."),
     # black-box plane (obs/tail.py, obs/profile.py, obs/blackbox.py —
     # docs/OBSERVABILITY.md "Tail sampling" / "Continuous profiling" /
     # "Flight recorder")
@@ -348,6 +352,29 @@ _ENV_REGISTRY = {
                                          "anyway (ranks then train "
                                          "DIVERGENT models — fit raises "
                                          "by default)."),
+    # bounded-staleness async training (docs/ROBUSTNESS.md "Asynchronous
+    # training")
+    "MXNET_ASYNC_STALENESS": (None, "Bounded-staleness async training: a "
+                              "worker more than this many steps ahead of "
+                              "the fleet's committed-clock floor blocks "
+                              "at pull (stale-synchronous-parallel; "
+                              "launch.py --async-staleness). Unset = "
+                              "classic unbounded dist_async."),
+    "MXNET_ASYNC_WIDEN": ("2", "Steps added to the staleness bound each "
+                          "time the straggler policy widens it for a "
+                          "compute-blamed rank (on_straggler actuation)."),
+    "MXNET_ASYNC_MAX_STALENESS": ("16", "Hard cap on the effective "
+                                  "staleness bound (base + policy "
+                                  "widening can never exceed it)."),
+    "MXNET_ASYNC_LR_COMP": ("1", "0 = disable worker-side staleness-aware "
+                            "lr compensation (gradients scaled by "
+                            "1/(1+lag) vs the fleet's max committed "
+                            "clock)."),
+    "MXNET_ASYNC_GROUP": (None, "Hierarchical reduction group size for "
+                          "elastic dist_sync (>1 = group-local scoped "
+                          "sum, leaders-only cross-group sum, group "
+                          "broadcast — the reduce plane stops being "
+                          "all-to-one). Unset/0 = flat reduce."),
     "MXNET_PS_SNAPSHOT_DIR": (None, "PS durable-state directory: atomic+"
                               "CRC snapshots + push WAL; warm restart "
                               "resumes from the newest valid snapshot "

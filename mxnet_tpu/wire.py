@@ -137,7 +137,7 @@ def check_disjoint(*registries: WireRegistry) -> None:
 
 
 # ---------------------------------------------------------------------------
-# the PS (training) plane: kvstore ops 0-9 + the elastic range 16-20,
+# the PS (training) plane: kvstore ops 0-9 + the elastic range 16-26,
 # all dispatched by kvstore/ps_server.py
 # ---------------------------------------------------------------------------
 
@@ -178,6 +178,25 @@ PS_WIRE = WireRegistry(
         # server stats snapshot (membership liveness, straggler verdicts,
         # hot keys, metrics under "metrics") — read-only, retries harmless
         OpSpec("stats", 22, "elastic"),
+        # bounded-staleness async training (docs/ROBUSTNESS.md
+        # "Asynchronous training"). clock: a worker commits "rank r
+        # finished step t" — max-merge, so a retried frame is harmless
+        # (idempotent), and the table must survive a server SIGKILL
+        # mid-async-storm (kind-4 WAL record before the ack)
+        OpSpec("clock", 23, "elastic", mutating=True, dedup="idempotent",
+               wal=True),
+        # read-only committed-clock table dump (floor + per-rank clocks):
+        # tests and operators assert exactly-once clock recovery with it
+        OpSpec("clock_pull", 24, "elastic"),
+        # staleness-gated pull: blocks (wait bound rides IN the request —
+        # the OP_REDUCE discipline) while the puller would run more than
+        # `s` steps ahead of the fleet's committed clock floor
+        OpSpec("pull_stale", 25, "elastic"),
+        # scoped reduce: like "reduce" but the round completes at an
+        # explicit contributor count instead of the full live membership —
+        # the transport under hierarchical (group-tree) reduction
+        OpSpec("reduce_scoped", 26, "elastic", mutating=True,
+               dedup="idempotent"),
     ])
 
 

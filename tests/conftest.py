@@ -75,6 +75,12 @@ def pytest_configure(config):
         "(docs/PERFORMANCE.md \"Program cache and cold start\"); run via "
         "`pytest -m progcache` or `make progcache`/`make coldstart`")
     config.addinivalue_line(
+        "markers", "async: bounded-staleness async-training tests — "
+        "committed clocks, the staleness-gated pull, straggler-verdict "
+        "actuation (widen/recut), hierarchical reduction, async vs sync "
+        "convergence (docs/ROBUSTNESS.md \"Asynchronous training\"); run "
+        "via `pytest -m async` or `make async`")
+    config.addinivalue_line(
         "markers", "dataplane: data-plane lint tests — hot-path copy/"
         "sync/allocation rules, resource lifetime, env-registry drift, "
         "and the MXNET_COPYTRACK runtime twin (docs/ANALYSIS.md "

@@ -265,6 +265,132 @@ def run_straggler_bench(workers: int = 3, window: int = 4, factor: float = 1.5,
             obs.disable()
 
 
+def run_async_bench(workers: int = 3, steps: int = 24, staleness: int = 16,
+                    base_s: float = 0.005, slow_s: float = 0.04,
+                    hb_interval: float = 0.05) -> dict:
+    """Async decoupling leg (docs/ROBUSTNESS.md "Asynchronous training"):
+    the same fleet shape twice — ``workers`` ranks, the last one's compute
+    ``slow_s`` vs everyone's ``base_s`` — once over lockstep elastic
+    allreduce (sync) and once over the bounded-staleness PS wire (push +
+    committed-clock + staleness-gated pull). Reports per-mode
+    **step_decoupling** = the slowest rank's median step time over the
+    fleet's median rank's median step time: ~1.0 under lockstep (every
+    rank pays the straggler's bill) and >>1 under async (only the
+    straggler pays — the gate binds fast ranks only once they outrun the
+    committed-clock floor by more than ``staleness``). The async number
+    is the dossier's ``extra.async_step_decoupling`` (higher is better)."""
+    import numpy as np
+
+    from mxnet_tpu.kvstore.elastic import ElasticWorkerSession
+    from mxnet_tpu.kvstore.ps_client import PSClient
+    from mxnet_tpu.kvstore.ps_server import PSServer
+
+    slow_rank = workers - 1
+    grad = np.ones(256, np.float32)
+
+    def _rank_medians(times):
+        return [sorted(ts)[len(ts) // 2] if ts else 0.0 for ts in times]
+
+    def _decoupling(times):
+        med = _rank_medians(times)
+        fleet_med = sorted(med)[len(med) // 2]
+        return max(med) / max(fleet_med, 1e-9)
+
+    t0 = time.perf_counter()
+
+    # -- sync: lockstep allreduce — the straggler gates every rank -------
+    srv = PSServer(host="127.0.0.1", port=0, hb_interval=hb_interval,
+                   miss_k=3)
+    srv.start()
+    sessions = []
+    sync_times = [[] for _ in range(workers)]
+    try:
+        sessions = [ElasticWorkerSession("127.0.0.1", srv.port, rank=r,
+                                         hb_interval=hb_interval)
+                    for r in range(workers)]
+        for s in sessions:
+            s.ensure_joined(wait_for_expected=False)
+
+        def _sync_loop(r):
+            for _ in range(steps):
+                ts = time.perf_counter()
+                time.sleep(slow_s if r == slow_rank else base_s)
+                sessions[r].allreduce("bench_async", grad, timeout=60)
+                sync_times[r].append(time.perf_counter() - ts)
+
+        threads = [threading.Thread(target=_sync_loop, args=(r,),
+                                    daemon=True) for r in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+    finally:
+        for s in sessions:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001
+                pass
+        srv.stop()
+
+    # -- async: push + clock + gated pull — only the straggler pays ------
+    srv = PSServer(host="127.0.0.1", port=0, hb_interval=hb_interval,
+                   miss_k=3, async_staleness=staleness)
+    srv.start()
+    async_times = [[] for _ in range(workers)]
+    clis = []
+    try:
+        clis = [PSClient("127.0.0.1", srv.port, timeout=30, retries=3,
+                         retry_interval=0.1) for _ in range(workers)]
+        clis[0].init("bench_async", np.zeros(256, np.float32))
+
+        def _async_loop(r):
+            cli = clis[r]
+            for step in range(1, steps + 1):
+                ts = time.perf_counter()
+                time.sleep(slow_s if r == slow_rank else base_s)
+                cli.push("bench_async", grad)
+                cli.push_clock(r, step)
+                cli.pull_stale("bench_async", r, step, staleness,
+                               timeout=60)
+                async_times[r].append(time.perf_counter() - ts)
+
+        threads = [threading.Thread(target=_async_loop, args=(r,),
+                                    daemon=True) for r in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+    finally:
+        for c in clis:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        srv.stop()
+
+    sync_dec = round(_decoupling(sync_times), 3)
+    async_dec = round(_decoupling(async_times), 3)
+    return {
+        "workers": workers,
+        "steps": steps,
+        "staleness": staleness,
+        "slow_rank": slow_rank,
+        "base_s": base_s,
+        "slow_s": slow_s,
+        "sync_rank_median_s": [round(m, 4)
+                               for m in _rank_medians(sync_times)],
+        "async_rank_median_s": [round(m, 4)
+                                for m in _rank_medians(async_times)],
+        "sync_step_decoupling": sync_dec,
+        "async_step_decoupling": async_dec,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        # lockstep smears the straggler over the fleet (ratio ~1); the
+        # gated wire must isolate it (>=2x, and strictly above sync)
+        "ok": (async_dec >= 2.0 and sync_dec <= 1.5
+               and async_dec > sync_dec),
+    }
+
+
 def run_train_obs_overhead(steps: int = 250, warmup: int = 30,
                            repeats: int = 7, batch: int = 64,
                            threshold_pct: float = 5.0) -> dict:
@@ -383,9 +509,15 @@ def main(argv=None) -> int:
                     help="run ONLY the train-telemetry overhead leg "
                          "(fit-shaped loop, interleaved off/on, <5%% "
                          "gated)")
+    ap.add_argument("--async", dest="async_leg", action="store_true",
+                    help="run ONLY the bounded-staleness decoupling leg "
+                         "(sync lockstep vs async gated-pull under one "
+                         "slowed rank; reports step_decoupling per mode)")
     args = ap.parse_args(argv)
     if args.straggler:
         res = run_straggler_bench(workers=args.workers)
+    elif args.async_leg:
+        res = run_async_bench(workers=args.workers)
     elif args.train_obs:
         res = run_train_obs_overhead()
     else:

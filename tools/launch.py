@@ -45,13 +45,17 @@ def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _start_ps_server(port: int, num_workers: int, elastic: bool = False):
+def _start_ps_server(port: int, num_workers: int, elastic: bool = False,
+                     async_staleness=None):
     """Prefer the native C++ server; fall back to the python twin. Elastic
     mode needs the python server — the membership/heartbeat opcodes (16-20,
-    kvstore/elastic.py) are not in the C++ twin."""
+    kvstore/elastic.py) are not in the C++ twin — and so does
+    bounded-staleness async mode (the clock/gated-pull opcodes 23-25)."""
     native = os.path.join(_repo_root(), "native", "build", "mxtpu_ps_server")
     env = dict(os.environ)
-    if os.path.exists(native) and not elastic:
+    if async_staleness is not None:
+        env["MXNET_ASYNC_STALENESS"] = str(async_staleness)
+    if os.path.exists(native) and not elastic and async_staleness is None:
         cmd = [native, "--port", str(port), "--num-workers", str(num_workers)]
     else:
         cmd = [sys.executable, "-m", "mxnet_tpu.kvstore.ps_server",
@@ -76,7 +80,8 @@ def _start_ps_server(port: int, num_workers: int, elastic: bool = False):
 
 
 def launch_local(num_workers: int, num_servers: int, command: list,
-                 env_extra=None, elastic: bool = False) -> int:
+                 env_extra=None, elastic: bool = False,
+                 async_staleness=None) -> int:
     """Spawn everything on localhost; returns the first nonzero worker rc."""
     base_env = dict(os.environ)
     base_env.update(env_extra or {})
@@ -89,11 +94,17 @@ def launch_local(num_workers: int, num_servers: int, command: list,
         # process is required even for sync mode
         base_env["MXNET_ELASTIC"] = "1"
         num_servers = max(1, num_servers)
+    if async_staleness is not None:
+        # bounded-staleness dist_async (docs/ROBUSTNESS.md "Asynchronous
+        # training"): needs the python PS (clock opcodes) — like --elastic
+        base_env["MXNET_ASYNC_STALENESS"] = str(int(async_staleness))
+        num_servers = max(1, num_servers)
 
     ps_proc = None
     if num_servers > 0:
         ps_port = _free_port()
-        ps_proc = _start_ps_server(ps_port, num_workers, elastic=elastic)
+        ps_proc = _start_ps_server(ps_port, num_workers, elastic=elastic,
+                                   async_staleness=async_staleness)
         base_env["MXNET_PS_ADDR"] = "127.0.0.1"
         base_env["MXNET_PS_PORT"] = str(ps_port)
     else:
@@ -136,6 +147,12 @@ def main(argv=None) -> int:
                    help="elastic training: PS-backed generation-scoped "
                    "sync, worker heartbeats, survivable barriers "
                    "(docs/ROBUSTNESS.md); implies a python PS process")
+    p.add_argument("--async-staleness", type=int, default=None,
+                   metavar="N",
+                   help="bounded-staleness dist_async: workers more than "
+                   "N steps ahead of the fleet's committed-clock floor "
+                   "block at pull (docs/ROBUSTNESS.md \"Asynchronous "
+                   "training\"); implies a python PS process")
     p.add_argument("--launcher", default="local",
                    choices=["local", "ssh", "mpi", "yarn", "sge"])
     p.add_argument("command", nargs=argparse.REMAINDER)
@@ -149,7 +166,8 @@ def main(argv=None) -> int:
     if not args.command:
         p.error("no command given")
     return launch_local(args.num_workers, args.num_servers, args.command,
-                        elastic=args.elastic)
+                        elastic=args.elastic,
+                        async_staleness=args.async_staleness)
 
 
 if __name__ == "__main__":
