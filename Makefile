@@ -5,7 +5,7 @@
 PYTHON ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic async perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart train-obs copytrack
+.PHONY: lint lint-tests test test-fast chaos chaos-serve elastic async perf obs health serve serve-bench serve_mesh dossier tsan prof progcache coldstart train-obs copytrack decode
 
 # repo self-lint: framework invariants + the concurrency-correctness pass
 # (lock-order cycles, blocking-under-lock, CV/thread discipline, wire
@@ -153,6 +153,15 @@ serve:
 # load generator: closed-loop + open-loop p50/p99 vs offered load
 serve-bench:
 	$(PYTHON) tools/serve_bench.py --model mlp --duration 5
+
+# autoregressive decode engine (docs/SERVING.md "Autoregressive decode"):
+# paged-KV alloc/free/leak units, the two-program compile bound proof,
+# continuous-batch join/leave, the streaming wire roundtrip with chaos
+# drop/dup and the mid-stream kill, progcache-warm replica; then the
+# open-loop decode bench (tokens/s + per-token p99 under churn)
+decode:
+	$(PYTHON) -m pytest tests/ -q -m decode -p no:cacheprovider
+	$(PYTHON) tools/serve_bench.py --decode --duration 4
 
 # mesh-sharded serving + elastic autoscale suite on the 8-device CPU mesh:
 # tensor-parallel engines, replica groups on mesh slices, quarantine→

@@ -504,6 +504,31 @@ def bench_serve(platform):
             "buckets": res.get("buckets")}
 
 
+def bench_decode(platform):
+    """Autoregressive decode trajectory (docs/SERVING.md "Autoregressive
+    decode"): concurrent token streams with churn (early hang-ups, a
+    hopeless-deadline lane) through the paged-KV two-program engine and
+    the streaming wire. Headline gains: ``decode_tokens_per_s`` and
+    ``decode_p99_per_token_ms`` (client-observed inter-token tail); the
+    compiled-program bound and zero residual pages are asserted, so a
+    retrace or page leak fails the leg instead of skewing it."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    duration = float(os.environ.get("BENCH_DECODE_DURATION",
+                                    8 if platform == "tpu" else 4))
+    res = serve_bench.run_decode_bench(
+        duration=duration,
+        clients=int(os.environ.get("BENCH_DECODE_CLIENTS", 6)))
+    assert res["program_bound_ok"], (
+        f"{res['compiled_programs']} decode programs for "
+        f"{len(res['buckets'])} buckets — the two-program bound broke")
+    assert res["pages_leaked"] == 0, (
+        f"{res['pages_leaked']} KV pages leaked after the drive")
+    return res
+
+
 def bench_cold_start(platform):
     """Replica cold start, cold vs warmed persistent program cache
     (docs/PERFORMANCE.md "Program cache and cold start"): two ProcReplica
@@ -990,6 +1015,16 @@ def main():
             extra["serve"] = bench_serve(platform)
         except Exception as e:
             extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+    if not skip_leg("decode"):
+        try:
+            # the autoregressive half of serving (docs/SERVING.md
+            # "Autoregressive decode"): concurrent token streams with
+            # churn through the paged-KV engine + streaming wire —
+            # decode_tokens_per_s / decode_p99_per_token_ms are the
+            # trajectory numbers next to serve_qps
+            extra["decode"] = bench_decode(platform)
+        except Exception as e:
+            extra["decode_error"] = f"{type(e).__name__}: {e}"[:200]
     if not skip_leg("cold_start"):
         try:
             # persistent AOT program cache (docs/PERFORMANCE.md "Program
@@ -1128,6 +1163,7 @@ def main():
         "lm_seq2048": "lm_seq2048_bf16",
         "lm_seq4096": "lm_seq4096_bf16",
         "serve": "serve",
+        "decode": "decode",
         "cold_start": "cold_start",
         "serve_scale": "serve_scale",
         "serve_ramp": "serve_ramp",

@@ -98,6 +98,7 @@ HOT_ROOTS: Set[Tuple[str, str]] = {
     ("PSServer", "_handle_loop"),
     ("PSServer", "_handle_one"),
     ("Router", "infer"),
+    ("DecodeScheduler", "step"),
     ("BaseModule", "fit"),
 }
 

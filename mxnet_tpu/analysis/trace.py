@@ -278,6 +278,57 @@ class TraceLinter:
                          "to buckets); check for direct _jitted calls"))
         return findings
 
+    def check_decode_engine(self, engine, baseline: int = 0
+                            ) -> List[Finding]:
+        """Prove the decode engine's two-program bound
+        (``serve/decode.py``): across ANY traffic mix the engine may
+        compile at most one prefill program per prompt bucket plus ONE
+        shared decode-step program. A repeated signature means a retrace
+        behind the engine's back; more prefill programs than buckets (or
+        a second step program) means a dynamic shape is leaking into a
+        trace — every extra program is a multi-second compile stall in a
+        latency-bound token loop. An empty finding list IS the proof
+        tests assert on."""
+        findings: List[Finding] = []
+        log = engine.compile_log[baseline:]
+        if not log:
+            return findings
+        sigs = [e["sig"] for e in log]
+        dupes = {repr(s) for s in sigs if sigs.count(s) > 1}
+        if dupes:
+            findings.append(Finding(
+                "decode-retrace-churn", Severity.ERROR,
+                f"{len(dupes)} decode signature(s) compiled more than once "
+                f"(e.g. {sorted(dupes)[0][:120]}); the per-signature "
+                "program cache is being bypassed",
+                node=type(engine).__name__,
+                fix_hint="keep parameter avals stable and never resize the "
+                         "page pool or slot count after construction"))
+        n_prefill = len({repr(e["sig"]) for e in log
+                         if e["kind"] == "prefill"})
+        n_step = len({repr(e["sig"]) for e in log if e["kind"] == "step"})
+        if n_prefill > len(engine.buckets):
+            findings.append(Finding(
+                "decode-retrace-churn", Severity.ERROR,
+                f"{n_prefill} prefill programs exceed the bucket bound "
+                f"({len(engine.buckets)} buckets); ragged prompt lengths "
+                "are escaping bucketing",
+                node=type(engine).__name__,
+                fix_hint="route all prompts through engine.prefill (it "
+                         "pads to prompt_buckets); check for direct "
+                         "_prefill_fn calls"))
+        if n_step > 1:
+            findings.append(Finding(
+                "decode-retrace-churn", Severity.ERROR,
+                f"{n_step} decode-step programs compiled; the step must "
+                "be ONE fixed-shape program regardless of which slots "
+                "are live",
+                node=type(engine).__name__,
+                fix_hint="keep the step batch at the fixed slot count and "
+                         "park inactive slots on the scratch page instead "
+                         "of reshaping the batch"))
+        return findings
+
     # ------------------------------------------------------------- public
     def lint(self, block, *example_inputs) -> Report:
         report = Report(self.scan_source(block))

@@ -25,7 +25,9 @@ from ..gluon.parameter import Parameter
 
 __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "BERTEncoder", "BERTModel", "TransformerLM", "bert_base", "bert_large",
-           "bert_tiny", "transformer_lm", "bert_sharding_rules"]
+           "bert_tiny", "transformer_lm", "bert_sharding_rules",
+           "decode_config", "decode_params", "prefill_layer", "decode_layer",
+           "lm_prefill", "lm_decode_step", "sample_token"]
 
 
 class MultiHeadAttention(HybridBlock):
@@ -257,3 +259,196 @@ def bert_large(vocab_size=30522, **kw):
 
 def transformer_lm(vocab_size=32000, **kw):
     return TransformerLM(vocab_size=vocab_size, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Causal-LM decode interface (serve/decode.py consumes this)
+#
+# The gluon forward above is the TRAINING path: full (B, S) sequences, no
+# cache. Generation wants the incremental form — prefill the prompt once,
+# then one position per step against cached K/V. These are pure JAX
+# functions over a flat param dict (extracted once from an initialized
+# TransformerLM) so the decode engine can jit exactly two programs around
+# them and compose its own attention (dense reference here, paged flash in
+# ops/flash_attention.py) without re-tracing any gluon machinery.
+# ---------------------------------------------------------------------------
+
+_LN_EPS = 1e-5  # nn.LayerNorm default
+
+
+def decode_config(lm: "TransformerLM") -> dict:
+    """Static shape/config facts of an LM, for building decode programs."""
+    enc = lm.encoder
+    cell = enc.cells[0]
+    att = cell.attention
+    return {
+        "vocab": lm.decoder._units,
+        "units": att._units,
+        "heads": att._heads,
+        "head_dim": att._units // att._heads,
+        "layers": len(enc.cells),
+        "max_length": enc._max_length,
+    }
+
+
+def decode_params(lm: "TransformerLM") -> dict:
+    """Extract a flat numpy param dict from an initialized TransformerLM.
+
+    The block must have run at least one forward pass (deferred init).
+    Layout: top-level embed/pos/final-LN/decoder arrays plus one dict per
+    layer under ``"layers"``.
+    """
+
+    def _np(p: Parameter) -> np.ndarray:
+        return p.data().asnumpy()
+
+    layers = []
+    for cell in lm.encoder.cells:
+        att, ffn = cell.attention, cell.ffn
+        layers.append({
+            "qkv_w": _np(att.qkv.weight), "qkv_b": _np(att.qkv.bias),
+            "proj_w": _np(att.proj.weight), "proj_b": _np(att.proj.bias),
+            "ln1_g": _np(cell.ln1.gamma), "ln1_b": _np(cell.ln1.beta),
+            "ffn1_w": _np(ffn.ffn_1.weight), "ffn1_b": _np(ffn.ffn_1.bias),
+            "ffn2_w": _np(ffn.ffn_2.weight), "ffn2_b": _np(ffn.ffn_2.bias),
+            "ln2_g": _np(cell.ln2.gamma), "ln2_b": _np(cell.ln2.beta),
+        })
+    return {
+        "embed": _np(lm.word_embed.weight),
+        "pos": _np(lm.encoder.position_weight),
+        "final_g": _np(lm.final_ln.gamma), "final_b": _np(lm.final_ln.beta),
+        "dec_w": _np(lm.decoder.weight), "dec_b": _np(lm.decoder.bias),
+        "layers": layers,
+    }
+
+
+def _ln(x, g, b):
+    import jax.numpy as jnp
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + _LN_EPS) * g + b
+
+
+def _dense(x, w, b):
+    # gluon Dense stores weight as (out, in): y = x @ w.T + b
+    return x @ w.T + b
+
+
+def _gelu(x):
+    import jax.nn
+    return jax.nn.gelu(x, approximate=True)
+
+
+def _split_heads(qkv, heads, head_dim):
+    import jax.numpy as jnp
+    # qkv (..., 3U) -> q, k, v each (..., H, D)
+    parts = qkv.reshape(qkv.shape[:-1] + (3, heads, head_dim))
+    return (jnp.squeeze(p, axis=-3)
+            for p in jnp.split(parts, 3, axis=-3))
+
+
+def prefill_layer(cfg, lp, x, mask):
+    """One post-LN block over a full prompt. x (B, S, U), mask (S, S) or
+    (B, S, S) additive-boolean (True = attend). Returns (x', k, v) with
+    k/v shaped (B, S, H, D)."""
+    import jax
+    import jax.numpy as jnp
+    h, d = cfg["heads"], cfg["head_dim"]
+    q, k, v = _split_heads(_dense(x, lp["qkv_w"], lp["qkv_b"]), h, d)
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask[:, None] if mask.ndim == 3 else mask,
+                       scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    att = _dense(ctx.reshape(x.shape), lp["proj_w"], lp["proj_b"])
+    x = _ln(x + att, lp["ln1_g"], lp["ln1_b"])
+    out = _dense(_gelu(_dense(x, lp["ffn1_w"], lp["ffn1_b"])),
+                 lp["ffn2_w"], lp["ffn2_b"])
+    return _ln(x + out, lp["ln2_g"], lp["ln2_b"]), k, v
+
+
+def decode_layer(cfg, lp, x, attend):
+    """One post-LN block for a single new position per sequence.
+
+    x (B, U); ``attend(q, k_new, v_new) -> ctx`` supplies attention over
+    the cached history (q/k_new/v_new/ctx all (B, H, D)) — the dense
+    reference passes a mask-and-softmax closure, the decode engine passes
+    a paged-KV closure that also writes k_new/v_new into the page pool.
+    Returns (x', k_new, v_new)."""
+    h, d = cfg["heads"], cfg["head_dim"]
+    q, k, v = _split_heads(_dense(x, lp["qkv_w"], lp["qkv_b"]), h, d)
+    ctx = attend(q, k, v)
+    att = _dense(ctx.reshape(x.shape), lp["proj_w"], lp["proj_b"])
+    x = _ln(x + att, lp["ln1_g"], lp["ln1_b"])
+    out = _dense(_gelu(_dense(x, lp["ffn1_w"], lp["ffn1_b"])),
+                 lp["ffn2_w"], lp["ffn2_b"])
+    return _ln(x + out, lp["ln2_g"], lp["ln2_b"]), k, v
+
+
+def lm_prefill(cfg, params, tokens):
+    """Causal forward over a prompt batch. tokens (B, S) int32.
+
+    Returns (logits (B, S, V), k (L, B, S, H, D), v (L, B, S, H, D)) —
+    the dense KV state ``lm_decode_step`` consumes. Padded positions are
+    harmless: causal masking means row i only sees columns <= i, and the
+    caller reads logits at its true last position."""
+    import jax.numpy as jnp
+    b, s = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:s]
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    ks, vs = [], []
+    for lp in params["layers"]:
+        x, k, v = prefill_layer(cfg, lp, x, causal)
+        ks.append(k)
+        vs.append(v)
+    x = _ln(x, params["final_g"], params["final_b"])
+    logits = _dense(x, params["dec_w"], params["dec_b"])
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def lm_decode_step(cfg, params, tokens, kv, positions):
+    """One decode step over dense KV (the paged engine's reference).
+
+    tokens (B,) int32; kv = (k, v) each (L, B, S, H, D) with S the cache
+    capacity; positions (B,) int32 — the index being written this step.
+    Returns (logits (B, V), (k, v) updated)."""
+    import jax
+    import jax.numpy as jnp
+    k_all, v_all = kv
+    b = tokens.shape[0]
+    rows = jnp.arange(b)
+    x = params["embed"][tokens] + params["pos"][positions]
+    scale = 1.0 / math.sqrt(cfg["head_dim"])
+    cols = jnp.arange(k_all.shape[2])
+    for i, lp in enumerate(params["layers"]):
+        def attend(q, k_new, v_new, _i=i):
+            nonlocal k_all, v_all
+            k_all = k_all.at[_i, rows, positions].set(k_new)
+            v_all = v_all.at[_i, rows, positions].set(v_new)
+            scores = jnp.einsum("bhd,bshd->bhs", q, k_all[_i],
+                                preferred_element_type=jnp.float32) * scale
+            live = cols[None, :] <= positions[:, None]  # (B, S)
+            scores = jnp.where(live[:, None], scores, -1e30)
+            p = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+            return jnp.einsum("bhs,bshd->bhd", p, v_all[_i])
+
+        x, _, _ = decode_layer(cfg, lp, x, attend)
+    x = _ln(x, params["final_g"], params["final_b"])
+    return _dense(x, params["dec_w"], params["dec_b"]), (k_all, v_all)
+
+
+def sample_token(logits, rng, temperature):
+    """On-device sampling: temperature > 0 draws from softmax(logits / t),
+    temperature <= 0 is greedy argmax. ``temperature`` may be scalar or
+    per-row (B,). Returns int32 (B,)."""
+    import jax
+    import jax.numpy as jnp
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32),
+                         logits.shape[:-1])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.maximum(t, 1e-4)[..., None]
+    drawn = jax.random.categorical(
+        rng, logits.astype(jnp.float32) / safe_t).astype(jnp.int32)
+    return jnp.where(t > 0, drawn, greedy)

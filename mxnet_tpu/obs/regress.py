@@ -91,6 +91,14 @@ GAIN_SPECS = (
      "extra.lm_seq4096_bf16.flash.spread", True),
     ("serve_qps", "extra.serve.serve_qps", None, True),
     ("serve_p99_ms", "extra.serve.serve_p99_ms", None, False),
+    # autoregressive decode (docs/SERVING.md "Autoregressive decode"):
+    # fleet token throughput and the client-observed inter-token p99
+    # under concurrent streams WITH churn — the streaming-UX trajectory
+    # numbers; the leg itself gates the program bound and page leaks
+    ("decode_tokens_per_s", "extra.decode.decode_tokens_per_s",
+     None, True),
+    ("decode_p99_per_token_ms", "extra.decode.decode_p99_per_token_ms",
+     None, False),
     # replica spawn → readiness-probe-OK with a WARMED persistent program
     # cache (progcache.py; the cold twin rides extra.cold_start.cold_s) —
     # the fleet-elasticity number: what autoscale scale-out actually waits

@@ -45,7 +45,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "decode_attention", "flash_decode_attention"]
 
 _NEG_INF = -1e30  # avoids -inf NaN propagation inside the kernel
 _LOG2E = math.log2(math.e)
@@ -517,3 +518,151 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     out, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
                                       block_q=block_q, block_k=block_k)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Decode-shape attention over a paged KV cache (serve/decode.py)
+#
+# One query position per sequence against its page table. The Pallas
+# kernel never gathers: scalar-prefetched page tables drive the K/V
+# BlockSpec index_map, so grid step (b, j) streams page ``table[b, j]``
+# straight from the pool — attention IS the gather. Off-TPU (and for the
+# reference/parity tests) the XLA path materializes the gather instead.
+# ---------------------------------------------------------------------------
+
+
+def _decode_attention_xla(q, k_pages, v_pages, page_table, lengths, scale):
+    """Gather-then-attend reference. q (B, H, D); k/v_pages
+    (P, page, H, D); page_table (B, max_pages) int32; lengths (B,) int32.
+    Returns (B, H, D)."""
+    b, h, d = q.shape
+    page = k_pages.shape[1]
+    k = k_pages[page_table]  # (B, max_pages, page, H, D)
+    v = v_pages[page_table]
+    s = k.shape[1] * page
+    k = k.reshape(b, s, h, d)
+    v = v.reshape(b, s, h, d)
+    prec = _dot_prec(q.dtype)
+    scores = jnp.einsum("bhd,bshd->bhs", q, k,
+                        preferred_element_type=jnp.float32,
+                        precision=prec) * scale
+    live = jnp.arange(s)[None, :] < lengths[:, None]  # (B, S)
+    scores = jnp.where(live[:, None], scores, _NEG_INF)
+    # _NEG_INF (not -inf) keeps fully-masked rows (inactive decode slots,
+    # length 0) finite — uniform garbage the caller discards, never NaN
+    p = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhs,bshd->bhd", p, v,
+                      preferred_element_type=jnp.float32,
+                      precision=prec).astype(q.dtype)
+
+
+def flash_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                           scale=None, interpret=False):
+    """Pallas paged decode attention. Shapes as ``decode_attention``.
+
+    Grid (B, max_pages): the page axis is innermost-sequential, so the
+    per-sequence online-softmax statistics (log2 domain, f32) live in VMEM
+    scratch across page steps; ``pl.when`` skips pages past the
+    sequence's length, and the last step normalizes."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    n_pages, page = k_pages.shape[:2]
+    max_pages = page_table.shape[1]
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    s2_scale = scale * _LOG2E
+    prec = _dot_prec(q.dtype)
+
+    def kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref):
+        seq = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            o_ref[...] = jnp.zeros_like(o_ref)
+
+        length = len_ref[seq]
+        n_live = (length + page - 1) // page
+
+        @pl.when(j < n_live)
+        def _block():
+            qv = q_ref[0]                                  # (H, D)
+            # (1, 0, 2) keeps the minor dim — Mosaic-friendly transpose
+            kt = jnp.transpose(k_ref[0], (1, 0, 2))        # (H, page, D)
+            vt = jnp.transpose(v_ref[0], (1, 0, 2))        # (H, page, D)
+            sc = lax.dot_general(                           # (H, page), log2
+                qv, kt, (((1,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+                precision=prec) * s2_scale
+            cols = j * page + lax.broadcasted_iota(jnp.int32, (h, page), 1)
+            sc = jnp.where(cols < length, sc, _NEG_INF)
+            m_prev = m_ref[:, 0]                                    # (H,)
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(sc - m_new[:, None])
+            p = jnp.where(sc <= _NEG_INF / 2, 0.0, p)               # (H, page)
+            l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=-1)
+            pv = lax.dot_general(                           # (H, D)
+                p.astype(vt.dtype), vt, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32, precision=prec)
+            o_ref[0] = o_ref[0] * alpha[:, None] + pv
+            m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+        @pl.when(j == max_pages - 1)
+        def _norm():
+            # length-0 rows (inactive slots) never accumulate: clamp keeps
+            # their garbage finite instead of 0/0
+            o_ref[0] = o_ref[0] / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda sq, j, pt, ln: (sq, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda sq, j, pt, ln: (pt[sq, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, h, d),
+                         lambda sq, j, pt, ln: (pt[sq, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda sq, j, pt, ln: (sq, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),   # running max (log2)
+            pltpu.VMEM((h, 128), jnp.float32),   # running denominator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), jnp.float32),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)
+    return out.astype(q.dtype)
+
+
+def decode_attention(q, k_pages, v_pages, page_table, lengths, scale=None):
+    """Single-position attention against a paged KV cache.
+
+    q (B, H, D) — one query position per live sequence; k_pages/v_pages
+    (P, page_size, H, D) — the device page pool; page_table
+    (B, max_pages) int32 — page ids in position order (pad unused slots
+    with any valid page, e.g. scratch page 0); lengths (B,) int32 —
+    positions visible per sequence (0 = inactive row, output garbage).
+    Returns (B, H, D).
+
+    ``MXNET_DECODE_ATTN`` picks the path: ``auto`` (default — Pallas on
+    TPU, XLA elsewhere), ``xla``, or ``pallas``.
+    """
+    impl = os.environ.get("MXNET_DECODE_ATTN", "auto")
+    scale = float(scale) if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    use_pallas = impl == "pallas" or (impl == "auto" and not _use_interpret())
+    if use_pallas:
+        return flash_decode_attention(q, k_pages, v_pages, page_table,
+                                      lengths, scale=scale,
+                                      interpret=_use_interpret())
+    return _decode_attention_xla(q, k_pages, v_pages, page_table, lengths,
+                                 scale)

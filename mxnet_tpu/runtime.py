@@ -309,6 +309,30 @@ _ENV_REGISTRY = {
                                    "InferenceEngine.warmup's concurrent "
                                    "per-bucket compiles (default "
                                    "min(buckets, cores); 1 = serial)."),
+    # autoregressive decode engine (serve/decode.py, docs/SERVING.md
+    # "Autoregressive decode")
+    "MXNET_DECODE_SLOTS": ("8", "Decode-step batch width: concurrent "
+                           "generations per replica. Fixed at engine "
+                           "construction — the step is ONE compiled "
+                           "program, idle slots park on the scratch "
+                           "page."),
+    "MXNET_DECODE_PAGE_SIZE": ("16", "KV-cache page size in tokens. "
+                               "Every prompt bucket is a multiple of it, "
+                               "so prefill scatters whole pages."),
+    "MXNET_DECODE_PAGES": ("64", "KV page-pool capacity (page 0 is the "
+                           "reserved scratch page, so usable pages are "
+                           "N-1). Sizing: slots × ceil(max_tokens/"
+                           "page_size) covers worst-case residency."),
+    "MXNET_DECODE_MAX_NEW": ("64", "Default max new tokens per "
+                             "generation when the request does not cap "
+                             "it."),
+    "MXNET_DECODE_TIMEOUT": ("30.0", "Default per-generation deadline "
+                             "seconds when the request carries none — "
+                             "an abandoned stream can hold KV pages at "
+                             "most this long."),
+    "MXNET_DECODE_ATTN": ("auto", "Paged decode-attention backend: "
+                          "auto (Pallas on TPU, XLA gather elsewhere), "
+                          "pallas, or xla."),
     # distributed (DMLC_* names kept for launcher compat)
     "DMLC_ROLE": (None, "worker|server|scheduler — set by tools/launch.py."),
     "DMLC_PS_ROOT_URI": (None, "Coordinator/PS host (reference ps-lite env)."),

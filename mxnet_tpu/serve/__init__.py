@@ -68,6 +68,8 @@ from .client import ServeClient
 from .fleet import (CircuitBreaker, FleetServer, LocalReplica, ProcReplica,
                     ReplicaPool, Router)
 from .autoscale import Autoscaler, AutoscalePolicy
+from .kvcache import PageLeakError, PagePool, PagesExhausted
+from .decode import DecodeEngine, DecodeScheduler, default_decode_buckets
 
 __all__ = ["load", "load_params", "ship_programs", "programs_dir_for",
            "InferenceEngine", "DynamicBatcher",
@@ -75,7 +77,9 @@ __all__ = ["load", "load_params", "ship_programs", "programs_dir_for",
            "RequestRejected", "DeadlineExceeded", "Draining",
            "default_buckets", "CircuitBreaker", "FleetServer",
            "LocalReplica", "ProcReplica", "ReplicaPool", "Router",
-           "Autoscaler", "AutoscalePolicy"]
+           "Autoscaler", "AutoscalePolicy",
+           "DecodeEngine", "DecodeScheduler", "default_decode_buckets",
+           "PagePool", "PageLeakError", "PagesExhausted"]
 
 
 def _newest_epoch(path: str) -> int:

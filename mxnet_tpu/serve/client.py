@@ -42,11 +42,14 @@ from ..kvstore.ps_server import (_pack_arrays, _recv_msg, _send_msg,
                                  _unpack_arrays)
 from .engine import (DeadlineExceeded, Draining, RequestRejected, ServeError)
 from .server import (OP_ABORT_RELOAD, OP_COMMIT_RELOAD, OP_DRAIN, OP_DUMP,
-                     OP_HEALTH, OP_INFER, OP_PREPARE_RELOAD, OP_READY,
-                     OP_RELOAD, OP_SHUTDOWN, OP_STATS, OP_TELEMETRY,
-                     SERVE_OP_NAMES, STATUS_BAD_REQUEST, STATUS_DEADLINE,
-                     STATUS_DRAINING, STATUS_INTERNAL, STATUS_NOT_READY,
-                     STATUS_OK, STATUS_REJECTED, _INFER_HDR)
+                     OP_HEALTH, OP_INFER, OP_INFER_STREAM,
+                     OP_PREPARE_RELOAD, OP_READY, OP_RELOAD, OP_SHUTDOWN,
+                     OP_STATS, OP_STREAM_END, OP_STREAM_ERROR,
+                     OP_STREAM_TOKEN, OP_TELEMETRY, SERVE_OP_NAMES,
+                     STATUS_BAD_REQUEST, STATUS_DEADLINE, STATUS_DRAINING,
+                     STATUS_INTERNAL, STATUS_NOT_READY, STATUS_OK,
+                     STATUS_REJECTED, _INFER_HDR, _STREAM_HDR,
+                     _TOKEN_FRAME)
 
 __all__ = ["ServeClient"]
 
@@ -91,6 +94,14 @@ class ServeClient:
     def _backoff(self, attempt: int) -> float:
         return capped_backoff(attempt, self._retry_interval,
                               self._retry_max_interval)
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _rpc(self, opcode: int, payload: bytes = b"",
              deadline: Optional[float] = None,
@@ -234,6 +245,171 @@ class ServeClient:
         outs, _ = _unpack_arrays(reply[4:])
         result = outs[0] if len(outs) == 1 else outs
         return (result, version) if return_version else result
+
+    def generate(self, tokens, *, max_new_tokens: Optional[int] = None,
+                 deadline_ms: Optional[float] = None, priority: int = 1,
+                 temperature: float = 0.0,
+                 rpc_timeout: Optional[float] = None):
+        """Stream one autoregressive generation, yielding int token ids
+        as the server emits them (``OP_INFER_STREAM`` → chunked
+        TOKEN/END/ERROR reply sequence). The typed serve errors
+        (:class:`RequestRejected`, :class:`DeadlineExceeded`,
+        :class:`Draining`, :class:`ServeError`) can raise MID-iteration —
+        a deadline that expires or a shed that lands while tokens are
+        already flowing surfaces at the next ``next()``, not only at
+        submit. Closing the generator early hangs up the connection —
+        the server's client-lost path cancels the generation at the next
+        step boundary and reclaims its KV pages.
+
+        Retry policy: unlike stateless ``infer``, the request frame is
+        only retried while NO reply chunk has arrived. Once the first
+        chunk lands the stream is committed — re-sending after observed
+        tokens could interleave two generations — so a broken wire
+        mid-stream surfaces as ``ServeError("stream broken after N
+        tokens")`` instead of retrying.
+
+        The connection lock is held for the whole stream (the wire is
+        strictly serial per socket), so issuing another RPC on this
+        client from the SAME thread while iterating would deadlock —
+        finish or close the generator first.
+        """
+        prompt = np.ascontiguousarray(
+            np.asarray(tokens, dtype=np.int32).reshape(-1))
+        payload = (_STREAM_HDR.pack(float(deadline_ms or 0.0),
+                                    min(max(int(priority), 0), 255),
+                                    int(max_new_tokens or 0),
+                                    float(temperature))
+                   + _pack_arrays([prompt]))
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms else None)
+        # same trace-birth rule as infer(): the root born here rides the
+        # wire to the replica, so its decode spans join this trace
+        ctx = None
+        root_here = False
+        if obs.enabled():
+            ctx = obs_context.current()
+            if ctx is None:
+                ctx = obs_context.new_root()
+                root_here = True
+        t0 = time.monotonic()
+        try:
+            yield from self._generate_stream(payload, ctx, deadline,
+                                             rpc_timeout)
+        except BaseException as e:
+            if root_here:
+                outcome = "deadline" if isinstance(e, DeadlineExceeded) \
+                    else "shed" if isinstance(e, (RequestRejected,
+                                                  Draining)) \
+                    else "cancelled" if isinstance(e, GeneratorExit) \
+                    else "error"
+                obs.tail.finish_root(ctx, time.monotonic() - t0,
+                                     outcome=outcome)
+            raise
+        if root_here:
+            obs.tail.finish_root(ctx, time.monotonic() - t0)
+        if obs.enabled():
+            obs.observe("serve.client.infer_stream_seconds",
+                        time.monotonic() - t0)
+
+    def _generate_stream(self, payload: bytes, ctx, deadline, timeout):
+        """The wire half of :meth:`generate`: send the request (with the
+        pre-commit retry loop), then relay the chunk sequence."""
+        opname = SERVE_OP_NAMES.get(OP_INFER_STREAM, "infer_stream")
+        # the lock spans the whole send -> chunk... -> terminal-frame
+        # conversation: chunks from a peer RPC interleaving on the socket
+        # would be garbage. Socket timeouts bound every hold; generator
+        # close() releases it via the with-block.
+        with self._lock:
+            dup = None
+            last_err = None
+            for attempt in range(self._retries):
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise DeadlineExceeded(
+                        f"deadline expired during {opname} retries "
+                        f"(last error: {last_err})")
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    if timeout is not None:
+                        self._sock.settimeout(timeout)
+                    key = obs_context.inject_key("", ctx)
+                    dup = chaos_rpc.on_send(OP_INFER_STREAM, "")
+                    _send_msg(self._sock, OP_INFER_STREAM, key, payload)  # lint: disable=blocking-call-under-lock
+                    if dup == "dup":
+                        _send_msg(self._sock, OP_INFER_STREAM, key, payload)  # lint: disable=blocking-call-under-lock
+                    break
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    self._drop_sock()
+                    if attempt + 1 >= self._retries:
+                        obs.inc("serve.client.failures")
+                        raise ServeError(
+                            f"serve rpc {opname} failed after "
+                            f"{self._retries} attempts: {last_err}")
+                    delay = self._backoff(attempt)
+                    if obs.enabled():
+                        obs.inc("serve.client.retries")
+                        obs.observe("serve.client.backoff_seconds", delay)
+                        obs.trace.event("serve.client.retry", op=opname,
+                                        attempt=attempt, error=str(e))
+                    time.sleep(delay)  # lint: disable=blocking-call-under-lock
+            n = 0
+            err = None
+            try:
+                while True:
+                    opcode, _key, chunk = _recv_msg(self._sock)  # lint: disable=blocking-call-under-lock
+                    chaos_rpc.on_reply(opcode, "")
+                    if opcode == OP_STREAM_TOKEN:
+                        n += 1
+                        tok, _idx = _TOKEN_FRAME.unpack_from(chunk, 0)
+                        yield int(tok)
+                    elif opcode == OP_STREAM_END:
+                        break
+                    elif opcode == OP_STREAM_ERROR:
+                        status = chunk[0] if len(chunk) else \
+                            STATUS_INTERNAL
+                        msg = bytes(chunk[1:]).decode("utf-8", "replace") \
+                            or "generation failed"
+                        err = _STATUS_ERRORS.get(status, ServeError)(msg)
+                        break
+                    else:
+                        self._drop_sock()
+                        raise ServeError(
+                            f"unexpected opcode {opcode} in stream reply")
+                # terminal frame seen: after draining a chaos-dup echo the
+                # wire is frame-aligned again and the socket stays usable
+                if dup == "dup":
+                    self._drain_echo()  # lint: disable=blocking-call-under-lock
+                if timeout is not None:
+                    self._sock.settimeout(self._timeout)
+            except GeneratorExit:
+                # the consumer abandoned a live stream: hanging up is the
+                # cancel signal — the server's client-lost path closes the
+                # generation and reclaims its KV pages at the next step
+                # boundary. The socket is desynced (chunks in flight), so
+                # it cannot be reused.
+                self._drop_sock()
+                obs.inc("serve.client.stream_cancelled")
+                raise
+            except (ConnectionError, OSError, struct.error) as e:
+                self._drop_sock()
+                obs.inc("serve.client.stream_broken")
+                raise ServeError(f"stream broken after {n} tokens: {e}")
+            if obs.enabled() and n:
+                obs.inc("serve.client.stream_tokens", n)
+            if err is not None:
+                raise err
+
+    def _drain_echo(self) -> None:
+        """Consume and discard one full chunk sequence — the server's
+        answer to a chaos-duplicated INFER_STREAM frame — so the socket
+        is frame-aligned for the next RPC. Called under the connection
+        lock (from the stream that owns it)."""
+        while True:
+            opcode, _key, _chunk = _recv_msg(self._sock)  # lint: disable=blocking-call-under-lock
+            chaos_rpc.on_reply(opcode, "")
+            if opcode in (OP_STREAM_END, OP_STREAM_ERROR):
+                return
 
     def health(self) -> bool:
         """Liveness probe (True = the process answers)."""

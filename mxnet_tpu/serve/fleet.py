@@ -1227,6 +1227,99 @@ class Router:
                                    priority=priority))
         return fut
 
+    def generate(self, tokens, *, max_new_tokens: Optional[int] = None,
+                 deadline_ms: Optional[float] = None, priority: int = 1,
+                 temperature: float = 0.0):
+        """Route one streaming generation to a replica and relay its
+        tokens (the ``DecodeScheduler.generate`` duck-type — a
+        FleetServer front mounts this as its INFER_STREAM source).
+
+        Failover is only legal BEFORE the first token: a shed or hard
+        failure with nothing streamed moves to the next candidate like
+        ``infer``, but once a replica has emitted a chunk the generation
+        is COMMITTED there — a retry elsewhere would splice a different
+        token sequence into the same stream — so a mid-stream failure
+        propagates to the caller as the typed error. Streams do not hold
+        the reload-flip gate (a generation can outlive a flip); a flip
+        that restarts the serving replica surfaces as a mid-stream
+        ``ServeError``, which the caller handles exactly like any other
+        broken stream."""
+        prompt = np.ascontiguousarray(np.asarray(tokens, dtype=np.int32))
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms else None)
+        cands = self._candidates()
+        if not cands:
+            raise RequestRejected("no ready replicas")
+        shed_err = None
+        hard_err = None
+        for i, m in enumerate(cands):
+            if i:
+                self.failovers += 1
+                obs.inc("fleet.failovers")
+            rem = None if deadline is None else deadline - time.monotonic()
+            if rem is not None and rem <= 0:
+                raise DeadlineExceeded(
+                    "deadline expired during fleet failover")
+            br = self._breaker(m)
+            if not br.allow():
+                shed_err = shed_err or RequestRejected(
+                    f"replica {m.idx} circuit breaker open")
+                continue
+            rpc_timeout = self._client_timeout if rem is None \
+                else min(self._client_timeout, rem + 0.5)
+            committed = False
+            try:
+                # no span across the yields (a span must not stay open
+                # while the generator is suspended) — the client's wire
+                # key already carries the active context to the replica
+                with self._conn(m) as cli:
+                    it = cli.generate(
+                        prompt, max_new_tokens=max_new_tokens,
+                        deadline_ms=rem * 1e3 if rem is not None else None,
+                        priority=priority, temperature=temperature,
+                        rpc_timeout=rpc_timeout)
+                    try:
+                        first = next(it)
+                    except StopIteration:
+                        br.success()  # empty stream is still an answer
+                        m.rpcs += 1
+                        return
+                    br.success()
+                    m.rpcs += 1
+                    committed = True
+                    obs.trace.event("fleet.route_stream", replica=m.idx,
+                                    priority=priority)
+                    yield first
+                    yield from it
+                    return
+            except (RequestRejected, Draining) as e:
+                br.success()  # an answering replica is a healthy replica
+                m.sheds += 1
+                shed_err = e
+            except DeadlineExceeded:
+                # pre-commit: no health verdict, free the probe slot
+                # (post-commit success() already closed it — harmless);
+                # either way the budget is gone, so no failover
+                br.release()
+                raise
+            except (ServeError, ConnectionError, OSError) as e:
+                if committed:
+                    # the stream is committed to this replica: surface
+                    # the break instead of splicing another generation
+                    raise
+                if br.failure():
+                    obs.inc("fleet.breaker_trips")
+                    obs.event("fleet.breaker_trip", replica=m.idx)
+                    obs.tail.note(breaker=True)
+                m.errors += 1
+                m.last_error = f"{type(e).__name__}: {e}"
+                hard_err = e
+        if hard_err is not None:
+            raise ServeError(
+                f"all {len(cands)} replicas failed; last: {hard_err}")
+        raise shed_err if shed_err is not None \
+            else RequestRejected("no replica accepted the stream")
+
     def ready(self) -> bool:
         return self._gate.is_set() and bool(self._pool.ready_members())
 

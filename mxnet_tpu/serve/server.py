@@ -86,9 +86,10 @@ from .engine import (DeadlineExceeded, Draining, InferenceEngine,
 __all__ = ["ServeServer", "OP_INFER", "OP_HEALTH", "OP_READY", "OP_RELOAD",
            "OP_STATS", "OP_DRAIN", "OP_SHUTDOWN", "OP_PREPARE_RELOAD",
            "OP_COMMIT_RELOAD", "OP_ABORT_RELOAD", "OP_TELEMETRY", "OP_DUMP",
-           "SERVE_OP_NAMES", "STATUS_OK", "STATUS_REJECTED",
-           "STATUS_DEADLINE", "STATUS_BAD_REQUEST", "STATUS_DRAINING",
-           "STATUS_INTERNAL", "STATUS_NOT_READY"]
+           "OP_INFER_STREAM", "OP_STREAM_TOKEN", "OP_STREAM_END",
+           "OP_STREAM_ERROR", "SERVE_OP_NAMES", "STATUS_OK",
+           "STATUS_REJECTED", "STATUS_DEADLINE", "STATUS_BAD_REQUEST",
+           "STATUS_DRAINING", "STATUS_INTERNAL", "STATUS_NOT_READY"]
 
 # serve opcode range: disjoint from the kvstore PS opcodes by
 # construction — both planes declare their rows in mxnet_tpu/wire.py and
@@ -98,10 +99,12 @@ from ..wire import SERVE_WIRE
 
 (OP_INFER, OP_HEALTH, OP_READY, OP_RELOAD, OP_STATS, OP_DRAIN,
  OP_SHUTDOWN, OP_PREPARE_RELOAD, OP_COMMIT_RELOAD,
- OP_ABORT_RELOAD, OP_TELEMETRY, OP_DUMP) = SERVE_WIRE.codes(
+ OP_ABORT_RELOAD, OP_TELEMETRY, OP_DUMP, OP_INFER_STREAM,
+ OP_STREAM_TOKEN, OP_STREAM_END, OP_STREAM_ERROR) = SERVE_WIRE.codes(
     "infer", "health", "ready", "reload", "stats", "drain",
     "serve_shutdown", "prepare_reload", "commit_reload", "abort_reload",
-    "telemetry", "dump")
+    "telemetry", "dump", "infer_stream", "stream_token", "stream_end",
+    "stream_error")
 
 SERVE_OP_NAMES = dict(SERVE_WIRE.names())
 
@@ -114,6 +117,13 @@ _chaos_rpc.OP_NAMES.update(SERVE_OP_NAMES)
  STATUS_DRAINING, STATUS_INTERNAL, STATUS_NOT_READY) = range(7)
 
 _INFER_HDR = struct.Struct("<dB")  # deadline_ms (0 = none), priority
+# INFER_STREAM request: deadline_ms (0 = none), priority,
+# max_new_tokens (0 = server default), temperature — then packed arrays
+# (one 1-D int32 prompt). Reply is a chunk sequence on the same
+# connection: STREAM_TOKEN (u32 token | u32 index) per token, closed by
+# STREAM_END (u8 status | u32 n_tokens) or STREAM_ERROR (_err_payload).
+_STREAM_HDR = struct.Struct("<dBIf")
+_TOKEN_FRAME = struct.Struct("<II")
 
 
 def _err_payload(status: int, msg: str) -> bytes:
@@ -132,6 +142,7 @@ class ServeServer:
     def __init__(self, engine: Optional[InferenceEngine] = None,
                  host: str = "127.0.0.1", port: int = 0, *,
                  batcher: Optional[DynamicBatcher] = None,
+                 decode=None,
                  max_linger_ms: float = 2.0, max_queue: int = 256,
                  lanes: int = 2, default_timeout: float = 30.0):
         self._engine = engine
@@ -143,6 +154,10 @@ class ServeServer:
                 lanes=lanes)
         else:
             self._batcher = None
+        # streaming generation source (OP_INFER_STREAM): a
+        # decode.DecodeScheduler, or — on a FleetServer — absent, in which
+        # case the Router batcher's own generate() relays replica streams
+        self._decode = decode
         self._default_timeout = float(default_timeout)
         self._draining = False
         self._started = time.monotonic()
@@ -220,6 +235,8 @@ class ServeServer:
             obs.event("serve.handler_threads_leaked", count=leaked)
         if self._batcher is not None:
             self._batcher.close(timeout=5)
+        if self._decode is not None:
+            self._decode.close(timeout=5)
 
     def abort(self):
         """Crash-style stop: sever the listener and every live connection
@@ -262,6 +279,8 @@ class ServeServer:
         ok = True
         if self._batcher is not None:
             ok = self._batcher.drain(timeout=timeout)
+        if self._decode is not None:
+            ok = self._decode.drain(timeout=timeout) and ok
         if stop:
             self.stop()
         return ok
@@ -346,6 +365,8 @@ class ServeServer:
             out["engine"] = self._engine.stats()
         if self._batcher is not None:
             out["batcher"] = self._batcher.stats()
+        if self._decode is not None:
+            out["decode"] = self._decode.stats()
         return out
 
     def telemetry(self, drain: bool = True,
@@ -397,7 +418,8 @@ class ServeServer:
                 key, wctx = obs_context.extract_key(key)
                 rec = obs.enabled()
                 root_here = False
-                if wctx is None and rec and opcode == OP_INFER:
+                if wctx is None and rec and opcode in (OP_INFER,
+                                                       OP_INFER_STREAM):
                     wctx = obs_context.new_root()
                     root_here = True
                 t0 = time.monotonic() if rec else 0.0
@@ -441,6 +463,8 @@ class ServeServer:
     def _handle_one(self, conn, opcode: int, key: str, payload) -> bool:
         if opcode == OP_INFER:
             self._reply(conn, OP_INFER, self._do_infer(payload))
+        elif opcode == OP_INFER_STREAM:
+            return self._do_infer_stream(conn, payload)
         elif opcode == OP_HEALTH:
             # liveness only: answering at all is the signal
             self._reply(conn, OP_HEALTH, struct.pack("<B", STATUS_OK))
@@ -448,13 +472,16 @@ class ServeServer:
             # the fleet front (serve/fleet.py FleetServer) has no engine:
             # the Router IS the batcher, and its ready() gates on live
             # replicas instead of a loaded model
-            if self._batcher is None or (
-                    self._engine is None
-                    and not hasattr(self._batcher, "ready")):
+            # a decode-only replica (no batch engine) is ready while its
+            # scheduler accepts work
+            src = self._batcher if self._batcher is not None \
+                else self._decode
+            if src is None or (self._engine is None
+                               and not hasattr(src, "ready")):
                 status = STATUS_NOT_READY
             elif self._draining:
                 status = STATUS_DRAINING
-            elif self._engine is None and not self._batcher.ready():
+            elif self._engine is None and not src.ready():
                 status = STATUS_NOT_READY
             else:
                 status = STATUS_OK
@@ -464,7 +491,7 @@ class ServeServer:
             if self._engine is not None:
                 version = self._engine.version
             else:
-                version = int(getattr(self._batcher, "version", 0) or 0)
+                version = int(getattr(src, "version", 0) or 0)
             self._reply(conn, OP_READY,
                         struct.pack("<BI", status, version))
         elif opcode == OP_RELOAD:
@@ -595,6 +622,80 @@ class ServeServer:
             self._reply(conn, opcode,
                         _err_payload(STATUS_BAD_REQUEST,
                                      f"unknown opcode {opcode}"))
+        return True
+
+    def _do_infer_stream(self, conn, payload) -> bool:
+        """Relay one generation as a chunked reply sequence. The token
+        source is uniform: ``DecodeScheduler.generate`` on a replica,
+        ``Router.generate`` on a fleet front — both yield ints and raise
+        the typed serve errors, possibly mid-stream. Returns False (drop
+        the connection) only when the CLIENT died mid-stream — the
+        generator's close() cancels the generation so its KV pages are
+        reclaimed at the next step boundary."""
+        src = self._decode if self._decode is not None else self._batcher
+        gen_fn = getattr(src, "generate", None)
+        if gen_fn is None:
+            self._reply(conn, OP_STREAM_ERROR, _err_payload(
+                STATUS_NOT_READY, "no decode path loaded"))
+            return True
+        if self._draining:
+            self._shed_draining += 1
+            obs.inc("serve.shed_draining")
+            obs.tail.note("shed")
+            self._reply(conn, OP_STREAM_ERROR, _err_payload(
+                STATUS_DRAINING, "endpoint draining"))
+            return True
+        try:
+            deadline_ms, priority, max_new, temp = \
+                _STREAM_HDR.unpack_from(payload, 0)
+            arrays, _ = _unpack_arrays(payload[_STREAM_HDR.size:])
+            tokens = np.asarray(arrays[0]).reshape(-1)
+        except (struct.error, IndexError, KeyError, ValueError) as e:
+            self._reply(conn, OP_STREAM_ERROR, _err_payload(
+                STATUS_BAD_REQUEST, f"malformed INFER_STREAM frame: {e}"))
+            return True
+        gen = gen_fn(tokens,
+                     max_new_tokens=int(max_new) or None,
+                     deadline_ms=deadline_ms or None,
+                     priority=int(priority),
+                     temperature=float(temp))
+        n = 0
+        try:
+            try:
+                for tok in gen:
+                    n += 1
+                    # chaos: die with tokens streamed but the generation
+                    # still resident — the page-reclaim proof's kill point
+                    kill_point("serve:mid_stream")
+                    _send_msg(conn, OP_STREAM_TOKEN, "",
+                              _TOKEN_FRAME.pack(int(tok) & 0xFFFFFFFF, n))
+                _send_msg(conn, OP_STREAM_END, "",
+                          struct.pack("<BI", STATUS_OK, n))
+            except RequestRejected as e:
+                obs.tail.note("shed")
+                _send_msg(conn, OP_STREAM_ERROR, "",
+                          _err_payload(STATUS_REJECTED, str(e)))
+            except DeadlineExceeded as e:
+                obs.tail.note("deadline")
+                _send_msg(conn, OP_STREAM_ERROR, "",
+                          _err_payload(STATUS_DEADLINE, str(e)))
+            except Draining as e:
+                obs.tail.note("shed")
+                _send_msg(conn, OP_STREAM_ERROR, "",
+                          _err_payload(STATUS_DRAINING, str(e)))
+            except ServeError as e:
+                obs.tail.note("error")
+                _send_msg(conn, OP_STREAM_ERROR, "",
+                          _err_payload(STATUS_INTERNAL, str(e)))
+        except (ConnectionError, OSError):
+            # the CLIENT vanished mid-stream: nothing to reply to — just
+            # make sure the generation leaves the batch
+            obs.inc("serve.stream_client_lost")
+            return False
+        finally:
+            gen.close()
+            if n:
+                obs.inc("serve.stream_tokens", n)
         return True
 
     def _do_infer(self, payload):

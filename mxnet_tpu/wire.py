@@ -227,6 +227,21 @@ SERVE_WIRE = WireRegistry(
         # bundle is built from the always-on ring without draining
         # anything, so retries are harmless by construction
         OpSpec("dump", 43, "serve"),
+        # autoregressive streaming lane (serve/decode.py): one
+        # infer_stream request fans into a chunked token/end/error reply
+        # sequence on the same connection. Generation mutates no served
+        # state (KV pages are scoped to the stream and reclaimed on any
+        # exit), so the request op stays non-mutating; a duplicated
+        # request (chaos dup) just streams the same tokens twice and the
+        # client drains the echo.
+        OpSpec("infer_stream", 44, "serve"),
+        # chunk frames: direction="reply" — many frames answer ONE
+        # infer_stream request, so the protocol linter's one-handler-
+        # branch-per-request rule must not expect dispatch branches for
+        # them. Chaos drop/dup address them by these names.
+        OpSpec("stream_token", 45, "serve", direction="reply"),
+        OpSpec("stream_end", 46, "serve", direction="reply"),
+        OpSpec("stream_error", 47, "serve", direction="reply"),
     ])
 
 

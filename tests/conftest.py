@@ -86,6 +86,12 @@ def pytest_configure(config):
         "and the MXNET_COPYTRACK runtime twin (docs/ANALYSIS.md "
         "\"Data-plane lint\"); run via `pytest -m dataplane` or "
         "`make copytrack`")
+    config.addinivalue_line(
+        "markers", "decode: autoregressive decode-engine tests — paged "
+        "KV cache alloc/free/leak, the two-program compile bound, "
+        "continuous-batch join/leave, streaming wire roundtrip, "
+        "progcache-warm replica (docs/SERVING.md \"Autoregressive "
+        "decode\"); run via `pytest -m decode` or `make decode`")
 
 
 @pytest.fixture(autouse=True)

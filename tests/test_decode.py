@@ -1,0 +1,863 @@
+"""Autoregressive decode-engine suite (``pytest -m decode`` / ``make decode``).
+
+Covers the docs/SERVING.md "Autoregressive decode" contracts:
+
+1. paged KV cache — ``pages_for``/bucket math, all-or-nothing allocation,
+   LIFO reuse, double-free/unknown-free leak guards, the reserved scratch
+   page, and the ``decode.kv_pages_used`` gauge;
+2. continuous batching — token-level join/leave between steps, priority
+   lanes, the batcher shed discipline (aggregate == sum(by_reason)),
+   mid-generation deadline/cancel/page-exhaustion retirement, and the
+   page-leak-free guarantee (every exit funnels through ``_retire``);
+3. the two-program bound — ``warmup()`` compiles exactly one prefill per
+   prompt bucket plus ONE decode-step program, ANY traffic mix compiles
+   nothing further, and ``TraceLinter.check_decode_engine`` returning an
+   empty list IS the proof;
+4. numerics — the paged engine's greedy stream is bitwise-identical to a
+   dense full-forward reference, prefill matches the training-path
+   forward, and the decode-shape attention kernels (XLA gather vs the
+   Pallas kernel in interpret mode) agree with a naive reference;
+5. the streaming wire — TOKEN/END/ERROR chunk framing, typed shed errors
+   mid-stream, pre-commit retry vs post-commit "stream broken", chaos
+   drop/dup on the stream opcode, client hang-up reclaiming pages, and
+   the fleet front relaying replica streams with failover-before-first-
+   token plus one merged client→front→replica trace timeline;
+6. process-level chaos — a replica SIGKILLed mid-stream (``serve:
+   mid_stream`` kill point) surfaces as the post-commit stream error;
+   a progcache-warmed replica performs ZERO fresh XLA compiles.
+"""
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, obs
+from mxnet_tpu.analysis.findings import Severity
+from mxnet_tpu.analysis.trace import TraceLinter
+from mxnet_tpu.chaos import rpc as chaos_rpc
+from mxnet_tpu.models.transformer import (decode_config, decode_params,
+                                          lm_prefill, sample_token,
+                                          transformer_lm)
+from mxnet_tpu.obs import context as obs_context
+from mxnet_tpu.serve import (DeadlineExceeded, DecodeEngine, DecodeScheduler,
+                             Draining, PageLeakError, PagePool,
+                             PagesExhausted, RequestRejected, ServeClient,
+                             ServeError, ServeServer, default_decode_buckets)
+from mxnet_tpu.serve.fleet import FleetServer, ReplicaPool, Router
+from mxnet_tpu.serve.kvcache import SCRATCH_PAGE, pages_for
+
+pytestmark = pytest.mark.decode
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos_rpc.reset()
+    yield
+    chaos_rpc.reset()
+    obs.disable()
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# fixtures: one tiny LM + one warmed engine + one wire stack per module
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lm():
+    model = transformer_lm(vocab_size=97, units=32, hidden_size=64,
+                           num_layers=2, num_heads=4, max_length=64,
+                           dropout=0.0)
+    model.initialize()
+    model(nd.zeros((1, 8)))  # deferred-init shape inference
+    return model
+
+
+@pytest.fixture(scope="module")
+def engine(lm):
+    eng = DecodeEngine(lm, slots=4, page_size=8, num_pages=16,
+                       prompt_buckets=[8, 16])
+    eng._warmup_fresh = eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def stack(engine):
+    """Started decode server + client sharing the module engine."""
+    sched = DecodeScheduler(engine, max_new_tokens=6)
+    srv = ServeServer(engine=None, decode=sched, port=0)
+    srv.start()
+    cli = ServeClient("127.0.0.1", srv.port, retries=2)
+    yield engine, sched, srv, cli
+    cli.close()
+    srv.stop()
+    engine.pool.assert_baseline()
+
+
+def _wait(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# 1. paged KV cache
+# ---------------------------------------------------------------------------
+
+def test_pages_for_and_default_buckets():
+    assert pages_for(0, 16) == 0
+    assert pages_for(1, 16) == 1
+    assert pages_for(16, 16) == 1
+    assert pages_for(17, 16) == 2
+    # powers of two from page_size, then the exact cap
+    assert default_decode_buckets(100, 16) == [16, 32, 64, 112]
+    assert default_decode_buckets(64, 16) == [16, 32, 64]
+    assert default_decode_buckets(8, 8) == [8]
+    for b in default_decode_buckets(100, 16):
+        assert b % 16 == 0
+
+
+def test_page_pool_alloc_free_lifo_reuse():
+    pool = PagePool(8, 4)
+    assert pool.capacity() == 7  # page 0 reserved for scratch
+    pool.alloc("a", 3)
+    ta = list(pool.table("a"))
+    assert len(ta) == 3 and SCRATCH_PAGE not in ta
+    pool.alloc("b", 2)
+    assert pool.used() == 5 and pool.available() == 2
+    pool.free("a")
+    pool.alloc("c", 3)
+    # LIFO free list: c reuses a's pages (hot KV pages stay hot)
+    assert set(pool.table("c")) == set(ta)
+    pool.free("b")
+    pool.free("c")
+    pool.assert_baseline()
+    st = pool.stats()
+    assert st["peak_used"] == 5 and st["used"] == 0
+
+
+def test_page_pool_all_or_nothing_and_leak_guards():
+    pool = PagePool(4, 2)  # capacity 3
+    pool.alloc("a", 2)
+    with pytest.raises(PagesExhausted):
+        pool.alloc("b", 2)  # only 1 free: must take NOTHING
+    assert pool.used() == 2 and pool.sequences() == 1  # "b" took nothing
+    with pytest.raises(PageLeakError):
+        pool.free("never-allocated")
+    pool.free("a")
+    with pytest.raises(PageLeakError):
+        pool.free("a")  # double free
+    with pytest.raises(PageLeakError):
+        pool.table("a")
+    pool.alloc("c", 1)
+    with pytest.raises(PageLeakError):
+        pool.assert_baseline()
+    pool.free("c")
+    pool.assert_baseline()
+    assert PagesExhausted.__mro__[1] is RequestRejected  # shed, not bug
+
+
+def test_page_pool_gauge_tracks_usage():
+    obs.enable()
+    pool = PagePool(8, 4)
+    pool.alloc("a", 3)
+    assert obs.metrics.snapshot()["gauges"]["decode.kv_pages_used"] == 3
+    pool.free("a")
+    assert obs.metrics.snapshot()["gauges"]["decode.kv_pages_used"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. continuous batching (duck-typed engine: deterministic + optionally slow)
+# ---------------------------------------------------------------------------
+
+class _FakeDecodeEngine:
+    """Scheduler-facing engine stub: token streams are a pure function of
+    the prompt (prefill = sum(prompt) % 1000, then +1 mod 997 per step),
+    so join/leave mixing is decidable without racing real XLA."""
+
+    def __init__(self, slots=2, page_size=4, num_pages=64, max_length=64,
+                 delay=0.0):
+        self.slots = slots
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_length = max_length
+        self.max_pages = min(pages_for(max_length, page_size),
+                             num_pages - 1)
+        self.buckets = default_decode_buckets(
+            min(max_length, (num_pages - 1) * page_size), page_size)
+        self.pool = PagePool(num_pages, page_size)
+        self.delay = delay
+        self.compile_log = []
+        self.prefill_order = []
+
+    def bucket_for(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise RequestRejected(f"prompt length {n} exceeds max bucket")
+
+    def prefill(self, tokens, page_ids, *, temperature=0.0, seed=0):
+        if self.delay:
+            time.sleep(self.delay)
+        tok = int(np.sum(tokens) % 1000)
+        self.prefill_order.append(tok)
+        return tok
+
+    def step(self, tokens, positions, page_tables, lengths, temps, *,
+             seed=0):
+        if self.delay:
+            time.sleep(self.delay)
+        return ((np.asarray(tokens, np.int64) + 1) % 997).astype(np.int32)
+
+    def warmup(self):
+        return 0
+
+    def stats(self):
+        return {"fake": True}
+
+
+def _fake_seq(prompt, n):
+    out = [int(np.sum(prompt) % 1000)]
+    while len(out) < n:
+        out.append((out[-1] + 1) % 997)
+    return out
+
+
+def test_continuous_batching_join_leave():
+    eng = _FakeDecodeEngine(slots=2, delay=0.005)
+    sched = DecodeScheduler(eng, max_new_tokens=8)
+    try:
+        prompts = [[1], [2, 3], [4, 5, 6], [7]]
+        wants = [5, 9, 3, 7]
+        got = [None] * 4
+
+        def run(i):
+            got[i] = list(sched.generate(prompts[i],
+                                         max_new_tokens=wants[i]))
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for i, t in enumerate(threads):
+            t.start()
+            time.sleep(0.01 * i)  # stagger: join/leave mid-batch
+        for t in threads:
+            t.join(10)
+        for i in range(4):
+            assert got[i] == _fake_seq(prompts[i], wants[i]), i
+        st = sched.stats()
+        assert st["completed"] == 4
+        assert st["tokens_out"] == sum(wants)
+        assert st["shed"] == sum(st["shed_by_reason"].values()) == 0
+        assert st["occupancy"] > 0
+        eng.pool.assert_baseline()
+    finally:
+        sched.close()
+    assert sched.stopped_clean
+
+
+def test_priority_lane_admitted_first():
+    eng = _FakeDecodeEngine(slots=1, delay=0.03)
+    sched = DecodeScheduler(eng, lanes=2, max_new_tokens=12)
+    try:
+        sched.submit([1], max_new_tokens=12)       # occupies the slot
+        _wait(lambda: eng.prefill_order == [1], msg="first admit")
+        sched.submit([2], priority=1, max_new_tokens=2)
+        sched.submit([3], priority=0, max_new_tokens=2)
+        _wait(lambda: sched.stats()["completed"] == 3, timeout=10,
+              msg="all complete")
+        # lane 0 drains first: [3] jumps the earlier-submitted [2]
+        assert eng.prefill_order == [1, 3, 2]
+        eng.pool.assert_baseline()
+    finally:
+        sched.close()
+
+
+def test_shed_discipline_aggregate_equals_by_reason():
+    eng = _FakeDecodeEngine(slots=1, delay=0.05)
+    sched = DecodeScheduler(eng, max_queue=2, max_new_tokens=30)
+    try:
+        h0 = sched.submit([1], max_new_tokens=30)
+        _wait(lambda: sched.stats()["active"] == 1
+              and sched.stats()["queued"] == 0, msg="h0 admitted")
+        # dead on arrival (queue has room, so it reaches the deadline check)
+        with pytest.raises(DeadlineExceeded):
+            sched.submit([9], deadline_ms=0.0)
+        h1 = sched.submit([2], max_new_tokens=5)
+        h2 = sched.submit([3], max_new_tokens=5)
+        with pytest.raises(RequestRejected):
+            sched.submit([4])  # queue over watermark
+        for h in (h0, h1, h2):
+            h.cancel()
+        assert sched.drain(timeout=10)
+        with pytest.raises(Draining):
+            sched.submit([5])
+        st = sched.stats()
+        assert st["shed"] == sum(st["shed_by_reason"].values()) == 3
+        assert st["shed_by_reason"]["deadline"] == 1
+        assert st["shed_by_reason"]["queue_full"] == 1
+        assert st["shed_by_reason"]["draining"] == 1
+        eng.pool.assert_baseline()
+    finally:
+        sched.close()
+
+
+def test_deadline_expires_mid_generation():
+    eng = _FakeDecodeEngine(slots=1, delay=0.03)
+    sched = DecodeScheduler(eng)
+    try:
+        got = []
+        with pytest.raises(DeadlineExceeded):
+            for tok in sched.generate([1, 2, 3], max_new_tokens=100,
+                                      deadline_ms=150):
+                got.append(tok)
+        assert got  # tokens WERE flowing before the deadline landed
+        assert sched.stats()["shed_by_reason"]["deadline"] == 1
+        eng.pool.assert_baseline()
+    finally:
+        sched.close()
+
+
+def test_cancel_reclaims_pages_and_batch_keeps_running():
+    eng = _FakeDecodeEngine(slots=2, delay=0.02)
+    sched = DecodeScheduler(eng)
+    try:
+        gen = sched.generate([5, 6], max_new_tokens=50)
+        assert next(gen) == _fake_seq([5, 6], 1)[0]
+        next(gen)
+        gen.close()  # hang-up is the cancel signal
+        _wait(lambda: eng.pool.used() == 0, msg="page reclaim")
+        assert sched.stats()["cancelled"] == 1
+        # the scheduler is still healthy for the next stream
+        assert list(sched.generate([7], max_new_tokens=3)) == \
+            _fake_seq([7], 3)
+    finally:
+        sched.close()
+
+
+def test_page_exhaustion_queues_then_sheds_running_stream():
+    # capacity 2 pages of 4 positions, max_length 8
+    eng = _FakeDecodeEngine(slots=2, page_size=4, num_pages=3,
+                            max_length=8, delay=0.02)
+    sched = DecodeScheduler(eng)
+    try:
+        # A's bucket-8 prompt takes BOTH pages at admission; B must wait
+        # queued (admission exhaustion is not a shed) until A retires
+        got_a, got_b = [], []
+
+        def run_a():
+            got_a.extend(sched.generate([1, 2, 3, 4, 5],
+                                        max_new_tokens=3))
+
+        def run_b():
+            got_b.extend(sched.generate([9], max_new_tokens=2))
+
+        ta = threading.Thread(target=run_a)
+        tb = threading.Thread(target=run_b)
+        ta.start()
+        _wait(lambda: eng.pool.used() == 2, msg="A admitted")
+        tb.start()
+        ta.join(10)
+        tb.join(10)
+        assert got_a == _fake_seq([1, 2, 3, 4, 5], 3)
+        assert got_b == _fake_seq([9], 2)
+        assert sched.stats()["shed_by_reason"]["pages"] == 0
+        eng.pool.assert_baseline()
+    finally:
+        sched.close()
+
+    # mid-generation growth past the pool sheds the RUNNING stream with
+    # reason "pages" and frees its pages so the batch keeps stepping
+    eng2 = _FakeDecodeEngine(slots=1, page_size=4, num_pages=3,
+                             max_length=64)
+    sched2 = DecodeScheduler(eng2)
+    try:
+        got = []
+        with pytest.raises(PagesExhausted):
+            for tok in sched2.generate([1, 2, 3], max_new_tokens=40):
+                got.append(tok)
+        assert got  # it was generating before the pool ran dry
+        assert sched2.stats()["shed_by_reason"]["pages"] == 1
+        eng2.pool.assert_baseline()
+    finally:
+        sched2.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. the two-program bound (real engine)
+# ---------------------------------------------------------------------------
+
+def test_two_program_bound_over_mixed_traffic(stack):
+    eng, sched, _srv, _cli = stack
+    assert eng.buckets == [8, 16]
+    # warmup compiled one program per bucket + ONE step program, once
+    assert eng._warmup_fresh == len(eng.buckets) + 1
+    assert eng.warmup() == 0  # idempotent: nothing left to compile
+    n_before = len(eng.compile_log)
+    for n in (3, 5, 8, 9, 13, 16):
+        prompt = np.arange(1, n + 1, dtype=np.int64) % 90 + 1
+        toks = list(sched.generate(prompt, max_new_tokens=4))
+        assert len(toks) == 4
+    # ANY prompt-length mix retraces nothing
+    assert len(eng.compile_log) == n_before
+    sigs = {repr(e["sig"]) for e in eng.compile_log}
+    assert len(sigs) == len(eng.buckets) + 1
+    assert len({repr(e["sig"]) for e in eng.compile_log
+                if e["kind"] == "step"}) == 1
+    # the linter's empty finding list IS the proof
+    assert TraceLinter().check_decode_engine(eng) == []
+    eng.pool.assert_baseline()
+    with pytest.raises(RequestRejected):
+        eng.bucket_for(17)  # over the largest bucket: shed, not compile
+
+
+def test_check_decode_engine_flags_churn():
+    class _Churn:
+        buckets = [8]
+        compile_log = [
+            {"sig": ("prefill", ((1, 8), "int32")), "kind": "prefill"},
+            {"sig": ("prefill", ((1, 8), "int32")), "kind": "prefill"},
+            {"sig": ("prefill", ((1, 16), "int32")), "kind": "prefill"},
+            {"sig": ("step", ((4,), "int32")), "kind": "step"},
+            {"sig": ("step", ((8,), "int32")), "kind": "step"},
+        ]
+
+    findings = TraceLinter().check_decode_engine(_Churn())
+    rules = [f.rule_id for f in findings]
+    assert rules.count("decode-retrace-churn") == 3  # dup + buckets + step
+    assert all(f.severity == Severity.ERROR for f in findings)
+    # clean engines stay clean under the baseline slice
+    assert TraceLinter().check_decode_engine(
+        _Churn(), baseline=len(_Churn.compile_log)) == []
+
+
+# ---------------------------------------------------------------------------
+# 4. numerics
+# ---------------------------------------------------------------------------
+
+def test_prefill_matches_training_forward(lm):
+    toks = np.random.randint(1, 97, size=(2, 8))
+    ref = lm(nd.array(toks)).asnumpy()
+    cfg, params = decode_config(lm), decode_params(lm)
+    logits, _k, _v = lm_prefill(cfg, params, toks.astype(np.int32))
+    np.testing.assert_allclose(np.asarray(logits), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_sample_token_greedy_and_temperature():
+    import jax
+    import jax.numpy as jnp
+    logits = jnp.asarray([[0.1, 3.0, -1.0], [5.0, 0.0, 0.0]], jnp.float32)
+    key = jax.random.PRNGKey(0)
+    out = np.asarray(sample_token(logits, key, 0.0))
+    assert out.tolist() == [1, 0] and out.dtype == np.int32
+    # per-row temperature: row 0 greedy, row 1 drawn (valid + reproducible)
+    t = jnp.asarray([0.0, 1.0], jnp.float32)
+    a = np.asarray(sample_token(logits, key, t))
+    b = np.asarray(sample_token(logits, key, t))
+    assert a[0] == 1 and 0 <= a[1] < 3
+    assert a.tolist() == b.tolist()
+
+
+def test_decode_attention_parity():
+    from mxnet_tpu.ops.flash_attention import (_decode_attention_xla,
+                                               decode_attention,
+                                               flash_decode_attention)
+    rng = np.random.RandomState(3)
+    n_pages, page, heads, dim, max_pages = 7, 4, 2, 8, 4
+    q = rng.randn(3, heads, dim).astype(np.float32)
+    k_pages = rng.randn(n_pages, page, heads, dim).astype(np.float32)
+    v_pages = rng.randn(n_pages, page, heads, dim).astype(np.float32)
+    table = np.zeros((3, max_pages), np.int32)
+    table[0, :2] = [1, 2]
+    table[1, :4] = [3, 4, 5, 6]
+    lengths = np.array([5, 13, 0], np.int32)  # row 2 inactive
+
+    def ref_row(i):
+        ln = int(lengths[i])
+        ks = np.concatenate([k_pages[p] for p in table[i]], 0)[:ln]
+        vs = np.concatenate([v_pages[p] for p in table[i]], 0)[:ln]
+        s = np.einsum("hd,lhd->hl", q[i], ks) / math.sqrt(dim)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        return np.einsum("hl,lhd->hd", p, vs)
+
+    for fn in (lambda *a: _decode_attention_xla(*a, 1.0 / math.sqrt(dim)),
+               decode_attention,
+               lambda *a: flash_decode_attention(*a, interpret=True)):
+        out = np.asarray(fn(q, k_pages, v_pages, table, lengths))
+        assert out.shape == q.shape
+        for i in (0, 1):  # inactive row 2 is garbage by contract
+            np.testing.assert_allclose(out[i], ref_row(i), rtol=2e-5,
+                                       atol=2e-5)
+
+
+def test_engine_greedy_matches_dense_reference(stack, lm):
+    """The paged two-program engine is bitwise-identical to a dense
+    full-forward-per-token reference (no paging, no batching)."""
+    _eng, sched, _srv, _cli = stack
+    cfg, params = decode_config(lm), decode_params(lm)
+    prompt = [1, 2, 3, 4, 5]
+    got = list(sched.generate(np.asarray(prompt, np.int32),
+                              max_new_tokens=6))
+    toks, ref = list(prompt), []
+    for _ in range(6):
+        logits, _k, _v = lm_prefill(
+            cfg, params, np.asarray([toks], np.int32))
+        nxt = int(np.argmax(np.asarray(logits[0, len(toks) - 1])))
+        ref.append(nxt)
+        toks.append(nxt)
+    assert got == ref
+
+
+def test_concurrent_streams_bitwise_equal_sequential(stack):
+    """Greedy decoding is invariant to batch composition: tokens never
+    depend on which other streams share the step program."""
+    _eng, sched, _srv, _cli = stack
+    prompts = [np.array([1, 2, 3, 4, 5], np.int32),
+               np.array([10, 11, 12], np.int32)]
+    got = [None, None]
+
+    def run(i):
+        got[i] = list(sched.generate(prompts[i], max_new_tokens=6))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    for i in (0, 1):
+        assert got[i] == list(sched.generate(prompts[i],
+                                             max_new_tokens=6)), i
+
+
+# ---------------------------------------------------------------------------
+# 5. streaming wire
+# ---------------------------------------------------------------------------
+
+def test_wire_stream_roundtrip_bitwise(stack):
+    eng, sched, _srv, cli = stack
+    toks = list(cli.generate([1, 2, 3, 4, 5], max_new_tokens=6))
+    ref = list(sched.generate(np.array([1, 2, 3, 4, 5], np.int32),
+                              max_new_tokens=6))
+    assert toks == ref and len(toks) == 6
+    assert cli.ready()  # a decode-only replica is ready
+    assert "decode" in cli.stats()
+    eng.pool.assert_baseline()
+
+
+def test_wire_client_hangup_reclaims_pages(stack):
+    eng, _sched, _srv, cli = stack
+    gen = cli.generate([1, 2, 3], max_new_tokens=50)
+    next(gen)
+    gen.close()  # hang-up IS the cancel signal
+    _wait(lambda: eng.pool.used() == 0, msg="server-side page reclaim")
+    assert cli.ready()  # client reconnects transparently after the drop
+
+
+def test_wire_deadline_is_typed_mid_stream(stack):
+    eng, _sched, _srv, cli = stack
+    # tiny deadline: sheds either at submit or mid-generation — both must
+    # surface as DeadlineExceeded through the STREAM_ERROR frame
+    with pytest.raises(DeadlineExceeded):
+        for _ in cli.generate([1, 2, 3], max_new_tokens=60,
+                              deadline_ms=2):
+            pass
+    _wait(lambda: eng.pool.used() == 0, msg="page reclaim after shed")
+
+
+def test_wire_chaos_drop_request_retries_precommit(stack):
+    _eng, sched, _srv, cli = stack
+    chaos_rpc.configure([chaos_rpc.Rule("infer_stream", "drop_request",
+                                        {1})])
+    toks = list(cli.generate([1, 2, 3, 4, 5], max_new_tokens=6))
+    assert toks == list(sched.generate(
+        np.array([1, 2, 3, 4, 5], np.int32), max_new_tokens=6))
+
+
+def test_wire_chaos_dup_is_drained_frame_aligned(stack):
+    _eng, sched, _srv, cli = stack
+    ref = list(sched.generate(np.array([1, 2, 3, 4, 5], np.int32),
+                              max_new_tokens=6))
+    chaos_rpc.configure([chaos_rpc.Rule("infer_stream", "dup", {1})])
+    assert list(cli.generate([1, 2, 3, 4, 5], max_new_tokens=6)) == ref
+    chaos_rpc.configure([])
+    # the duplicate's echo was drained: the socket is still frame-aligned
+    assert cli.ready()
+    assert list(cli.generate([1, 2, 3, 4, 5], max_new_tokens=6)) == ref
+
+
+def test_wire_draining_refuses_streams():
+    eng = _FakeDecodeEngine(slots=2)
+    sched = DecodeScheduler(eng, max_new_tokens=4)
+    srv = ServeServer(engine=None, decode=sched, port=0)
+    srv.start()
+    cli = ServeClient("127.0.0.1", srv.port, retries=2)
+    try:
+        assert list(cli.generate([1, 2])) == _fake_seq([1, 2], 4)
+        cli.drain()
+        with pytest.raises(Draining):
+            list(cli.generate([1, 2]))
+        eng.pool.assert_baseline()
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_fleet_stream_relay_failover_and_merged_timeline():
+    scheds = []
+
+    def factory():
+        eng = _FakeDecodeEngine(slots=2)
+        s = DecodeScheduler(eng, max_new_tokens=6)
+        scheds.append(s)
+        srv = ServeServer(engine=None, decode=s, port=0)
+        srv.start()
+        return srv
+
+    pool = ReplicaPool.local(factory, 2, probe_interval=0.2)
+    pool.start()
+    router = Router(pool, breaker_cooldown=0.3)
+    front = FleetServer(router, port=0)
+    front.start()
+    cli = ServeClient("127.0.0.1", front.port, retries=2)
+    try:
+        _wait(cli.ready, timeout=10, msg="fleet ready")
+        ref = _fake_seq([1, 2, 3, 4, 5], 6)
+        # relay is bitwise on BOTH replicas (round-robin)
+        assert list(cli.generate([1, 2, 3, 4, 5])) == ref
+        assert list(cli.generate([1, 2, 3, 4, 5])) == ref
+
+        # one merged timeline: client root → front serve.rpc → replica
+        # decode spans, all on ONE trace id
+        obs.enable()
+        root = obs_context.new_root(sampled=True)
+        with obs_context.use(root):
+            assert list(cli.generate([1, 2, 3, 4, 5])) == ref
+        evs = obs.trace.drain()
+        gen_tids = {(e.get("args") or {}).get("trace_id") for e in evs
+                    if e["name"] == "decode.generate"}
+        tok_tids = {(e.get("args") or {}).get("trace_id") for e in evs
+                    if e["name"] == "decode.token"}
+        rpc_tids = {(e.get("args") or {}).get("trace_id") for e in evs
+                    if e["name"] == "serve.rpc"
+                    and (e.get("args") or {}).get("trace_id")}
+        assert gen_tids == {root.trace_id}
+        assert tok_tids == {root.trace_id}
+        assert root.trace_id in rpc_tids
+        assert any(e["name"] == "fleet.route_stream" for e in evs)
+        obs.disable()
+
+        # failover happens only BEFORE the first token is committed
+        pool.kill(0)
+        ok = 0
+        deadline = time.monotonic() + 10
+        while ok < 4 and time.monotonic() < deadline:
+            try:
+                assert list(cli.generate([7, 8, 9],
+                                         max_new_tokens=4)) == \
+                    _fake_seq([7, 8, 9], 4)
+                ok += 1
+            except ServeError:
+                time.sleep(0.1)
+        assert ok == 4
+        assert router.failovers >= 1
+    finally:
+        cli.close()
+        front.stop()
+        pool.stop()
+    for s in scheds:
+        assert s.engine.pool.used() == 0  # no page outlives its stream
+
+
+# ---------------------------------------------------------------------------
+# 6. process-level chaos + progcache warm start (subprocess legs)
+# ---------------------------------------------------------------------------
+
+_TINY_REPLICA = """\
+import sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu.ndarray as nd
+from mxnet_tpu.models.transformer import transformer_lm
+from mxnet_tpu.serve.decode import DecodeEngine, DecodeScheduler
+from mxnet_tpu.serve.server import ServeServer
+
+lm = transformer_lm(vocab_size=61, units=16, hidden_size=32, num_layers=1,
+                    num_heads=2, max_length=32, dropout=0.0)
+lm.initialize()
+lm(nd.zeros((1, 8)))
+eng = DecodeEngine(lm, slots=2, page_size=8, num_pages=9,
+                   prompt_buckets=[8])
+sched = DecodeScheduler(eng, max_new_tokens=16)
+srv = ServeServer(engine=None, decode=sched, port=0)
+srv.start()
+print("PORT %d" % srv.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+_WARM_REPLICA = """\
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu.ndarray as nd
+from mxnet_tpu.models.transformer import transformer_lm
+from mxnet_tpu.serve.decode import DecodeEngine, DecodeScheduler
+
+lm = transformer_lm(vocab_size=61, units=16, hidden_size=32, num_layers=1,
+                    num_heads=2, max_length=32, dropout=0.0)
+lm.initialize()
+lm(nd.zeros((1, 8)))
+eng = DecodeEngine(lm, slots=2, page_size=8, num_pages=9,
+                   prompt_buckets=[8], progcache_dir=sys.argv[1])
+fresh = eng.warmup()
+# warmed programs must EXECUTE correctly, not just deserialize
+sched = DecodeScheduler(eng, max_new_tokens=4)
+toks = list(sched.generate(np.array([1, 2, 3], np.int32), max_new_tokens=4))
+sched.close()
+print(json.dumps({"fresh": fresh, "hits": eng.cache_hits,
+                  "programs": len(eng.compile_log), "tokens": toks}))
+"""
+
+
+def _proc_env(**extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # XLA:CPU refuses executable export under the forced 8-device flag
+    # the in-process conftest sets — strip it for subprocess replicas
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    env.update(extra)
+    return env
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_replica_sigkill_mid_stream_is_post_commit_error(tmp_path):
+    """A replica SIGKILLed between token sends (`serve:mid_stream@3`)
+    surfaces as the committed-stream error — never a silent retry that
+    would interleave two generations."""
+    script = tmp_path / "replica.py"
+    script.write_text(_TINY_REPLICA)
+    proc = subprocess.Popen(
+        [sys.executable, str(script)], cwd=REPO,
+        env=_proc_env(MXNET_CHAOS_KILL="serve:mid_stream@3"),
+        stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+        cli = ServeClient("127.0.0.1", port, timeout=120.0, retries=2)
+        got = []
+        try:
+            with pytest.raises(ServeError,
+                               match="stream broken after 2 tokens"):
+                for tok in cli.generate([1, 2, 3], max_new_tokens=10):
+                    got.append(tok)
+            assert len(got) == 2  # exactly the tokens sent pre-kill
+        finally:
+            cli.close()
+        proc.wait(timeout=10)
+        assert proc.returncode == -9  # SIGKILL, not a clean exit
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+@pytest.mark.progcache
+@pytest.mark.slow
+def test_progcache_warmed_replica_zero_fresh_compiles(tmp_path):
+    """Cold replica populates the shared program cache; a warm restart
+    performs ZERO fresh XLA compiles (every program deserialized) and
+    produces the same greedy tokens."""
+    script = tmp_path / "warm.py"
+    script.write_text(_WARM_REPLICA)
+    cache_dir = tmp_path / "progcache"
+    cache_dir.mkdir()
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, str(script), str(cache_dir)], cwd=REPO,
+            env=_proc_env(), capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        import json
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold = run()
+    assert cold["fresh"] == 2  # one prefill bucket + ONE step program
+    if not list(cache_dir.glob("*.mxprog")):
+        pytest.skip("backend refused AOT export; nothing persisted")
+    warm = run()
+    assert warm["fresh"] == 0
+    assert warm["hits"] == 2
+    assert warm["programs"] == 2
+    assert len(warm["tokens"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# 7. flagship: concurrent wire streams with churn (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_flagship_concurrent_streams_with_churn(stack):
+    """8 concurrent wire clients over 4 slots — two hang up early, one
+    carries a hopeless deadline — and every COMPLETED stream is bitwise
+    equal to its solo sequential run, with zero residual pages and the
+    program bound intact over the whole session."""
+    eng, sched, srv, _cli = stack
+    prompts = [np.arange(1, n + 1, dtype=np.int64) % 90 + 1
+               for n in (3, 5, 7, 9, 11, 13, 4, 6)]
+    results = [None] * 8
+
+    def run(i):
+        cli = ServeClient("127.0.0.1", srv.port, retries=2)
+        try:
+            if i in (2, 5):  # churn: hang up after 2 tokens
+                gen = cli.generate(prompts[i], max_new_tokens=40)
+                next(gen)
+                next(gen)
+                gen.close()
+                results[i] = "cancelled"
+            elif i == 7:  # churn: hopeless deadline
+                try:
+                    for _ in cli.generate(prompts[i], max_new_tokens=40,
+                                          deadline_ms=2):
+                        pass
+                    results[i] = "finished"
+                except DeadlineExceeded:
+                    results[i] = "deadline"
+            else:
+                results[i] = list(cli.generate(prompts[i],
+                                               max_new_tokens=6))
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert results[2] == results[5] == "cancelled"
+    assert results[7] == "deadline"
+    for i in (0, 1, 3, 4, 6):
+        ref = list(sched.generate(prompts[i], max_new_tokens=6))
+        assert results[i] == ref, i
+    _wait(lambda: eng.pool.used() == 0, msg="full page reclaim")
+    assert TraceLinter().check_decode_engine(eng) == []
+    st = sched.stats()
+    assert st["shed"] == sum(st["shed_by_reason"].values())

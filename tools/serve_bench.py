@@ -351,6 +351,141 @@ def run_cold_bench(model="mlp", max_batch_size=8, timeout=180.0,
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_decode_bench(duration=4.0, clients=6, slots=4, page_size=8,
+                     num_pages=64, max_new_tokens=24, churn=True):
+    """Autoregressive decode bench (docs/SERVING.md "Autoregressive
+    decode"): a tiny transformer LM behind the paged-KV two-program
+    engine and the streaming wire, driven by ``clients`` concurrent
+    streams with mid-run churn (periodic early hang-ups and one hopeless
+    deadline lane) so join/leave and page reclaim are part of the
+    measured path, not a separate test.
+
+    Headline numbers: ``decode_tokens_per_s`` (fleet token throughput)
+    and ``decode_p99_per_token_ms`` (client-observed inter-token gap —
+    the streaming UX tail, excluding the first token which carries queue
+    wait + prefill and is reported separately as ``ttft_ms_p50``). The
+    compiled-program bound and the zero-residual-pages check ride along
+    as canaries: a retrace or a page leak fails the run, it doesn't just
+    skew it."""
+    from mxnet_tpu import nd, serve
+    from mxnet_tpu.models.transformer import transformer_lm
+    from mxnet_tpu.serve.decode import DecodeEngine, DecodeScheduler
+
+    lm = transformer_lm(vocab_size=257, units=64, hidden_size=128,
+                        num_layers=2, num_heads=4, max_length=128,
+                        dropout=0.0)
+    lm.initialize()
+    lm(nd.zeros((1, 8)))
+    eng = DecodeEngine(lm, slots=slots, page_size=page_size,
+                       num_pages=num_pages)
+    eng.warmup()  # compiles never pollute token-gap numbers
+    sched = DecodeScheduler(eng, max_new_tokens=max_new_tokens)
+    srv = serve.ServeServer(engine=None, decode=sched, port=0)
+    srv.start()
+
+    lock = threading.Lock()
+    gaps: list = []          # inter-token gaps, first token excluded
+    ttfts: list = []         # submit -> first token
+    tokens = [0]
+    completed = [0]
+    cancelled = [0]
+    shed = [0]
+    errors = [0]
+    stop_at = time.perf_counter() + duration
+
+    def worker(wid):
+        rng = np.random.RandomState(100 + wid)
+        cli = serve.ServeClient("127.0.0.1", srv.port)
+        my_gaps, my_ttfts = [], []
+        rounds = 0
+        try:
+            while time.perf_counter() < stop_at:
+                rounds += 1
+                n = int(rng.randint(3, 33))
+                prompt = rng.randint(1, 250, size=n).astype(np.int32)
+                mode = "normal"
+                if churn and wid == 0 and rounds % 3 == 2:
+                    mode = "cancel"
+                elif churn and wid == 1 and rounds % 5 == 3:
+                    mode = "deadline"
+                try:
+                    if mode == "cancel":
+                        gen = cli.generate(prompt,
+                                           max_new_tokens=max_new_tokens)
+                        next(gen)
+                        next(gen)
+                        gen.close()  # hang-up: server reclaims the pages
+                        with lock:
+                            cancelled[0] += 1
+                            tokens[0] += 2
+                        continue
+                    dl = 1.0 if mode == "deadline" else None
+                    t_sent = time.perf_counter()
+                    t_prev = t_sent
+                    got = 0
+                    for _tok in cli.generate(prompt,
+                                             max_new_tokens=max_new_tokens,
+                                             deadline_ms=dl):
+                        now = time.perf_counter()
+                        if got == 0:
+                            my_ttfts.append(now - t_sent)
+                        else:
+                            my_gaps.append(now - t_prev)
+                        t_prev = now
+                        got += 1
+                    with lock:
+                        completed[0] += 1
+                        tokens[0] += got
+                except (serve.DeadlineExceeded, serve.RequestRejected,
+                        serve.Draining):
+                    with lock:
+                        shed[0] += 1
+                except serve.ServeError:
+                    with lock:
+                        errors[0] += 1
+        finally:
+            cli.close()
+            with lock:
+                gaps.extend(my_gaps)
+                ttfts.extend(my_ttfts)
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(clients)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=duration + 60)
+    wall = time.perf_counter() - t_start
+    st = sched.stats()
+    srv.stop()
+    sigs = {repr(e["sig"]) for e in eng.compile_log}
+    gaps.sort()
+    ttfts.sort()
+    return {
+        "duration_s": round(wall, 2), "clients": clients, "slots": slots,
+        "page_size": page_size, "num_pages": num_pages,
+        "max_new_tokens": max_new_tokens,
+        "streams_completed": completed[0],
+        "streams_cancelled": cancelled[0],
+        "shed": shed[0], "errors": errors[0],
+        "tokens_out": tokens[0],
+        "decode_tokens_per_s": round(tokens[0] / wall, 2),
+        "ttft_ms_p50": (round(_percentile(ttfts, 0.50) * 1e3, 3)
+                        if ttfts else None),
+        "decode_p50_per_token_ms": (round(_percentile(gaps, 0.50) * 1e3, 3)
+                                    if gaps else None),
+        "decode_p99_per_token_ms": (round(_percentile(gaps, 0.99) * 1e3, 3)
+                                    if gaps else None),
+        "occupancy": round(st["occupancy"], 3),
+        "scheduler_steps": st["steps"],
+        "compiled_programs": len(eng.compile_log),
+        "buckets": list(eng.buckets),
+        "program_bound_ok": len(sigs) == len(eng.buckets) + 1,
+        "pages_leaked": eng.pool.used(),
+    }
+
+
 def _serve_rules(model):
     """Tensor-parallel sharding specs for the bench models: the mlp gets
     the classic Megatron split (fc1 row-parallel, fc2 column-parallel —
@@ -1096,6 +1231,12 @@ def main(argv=None):
                          "report cold_start_to_ready_s both ways (always "
                          "prints JSON; exits 1 when the warm leg performed "
                          "any fresh XLA compile — the key-stability gate)")
+    ap.add_argument("--decode", action="store_true",
+                    help="autoregressive decode bench: concurrent token "
+                         "streams with churn through the paged-KV engine "
+                         "and the streaming wire; reports tokens/s + "
+                         "per-token p99 (always prints JSON; exits 1 on "
+                         "a program-bound break or a page leak)")
     ap.add_argument("--scale", action="store_true",
                     help="mesh-scaling bench: closed-loop qps through "
                          "tensor-parallel replica groups on dp 1/2/4 mesh "
@@ -1181,6 +1322,26 @@ def main(argv=None):
                   f"{res['fresh_compiles_warm']} fresh XLA compile(s) "
                   f"(cold: {res['fresh_compiles_cold']}) — program-cache "
                   "keys are unstable across processes", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.decode:
+        if args.connect:
+            ap.error("--decode builds an in-process decode stack and "
+                     "cannot target --connect")
+        res = run_decode_bench(duration=args.duration,
+                               clients=args.clients)
+        print(json.dumps(res, indent=1))
+        print(f"decode: {res['decode_tokens_per_s']} tok/s, "
+              f"per-token p50 {res['decode_p50_per_token_ms']} ms / "
+              f"p99 {res['decode_p99_per_token_ms']} ms, ttft p50 "
+              f"{res['ttft_ms_p50']} ms, occupancy {res['occupancy']}, "
+              f"{res['compiled_programs']} programs for "
+              f"{len(res['buckets'])} buckets", file=sys.stderr)
+        if not res["program_bound_ok"] or res["pages_leaked"]:
+            print("WARNING: decode invariant broke — "
+                  f"program_bound_ok={res['program_bound_ok']} "
+                  f"pages_leaked={res['pages_leaked']}", file=sys.stderr)
             return 1
         return 0
 
